@@ -1,0 +1,74 @@
+"""Headline benchmark: GPT-2-125M SPMD training throughput per chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference publishes no in-repo number for its north-star config
+("Ray Train GPT-2 DDP tokens/sec/chip", BASELINE.md "Gaps" section). We use
+the public NCCL/A100 equivalent — GPT-2-124M torch DDP on A100-40GB sustains
+~60k tokens/s/GPU (nanoGPT-class training, bf16, flash attention) — as the
+per-chip baseline the north star asks us to match on TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC_PER_CHIP = 60_000.0
+
+
+def main():
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.train.spmd import compile_gpt2_train, default_optimizer
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = build_mesh(MeshConfig(dp=n), devices=devices)
+
+    seq_len = 1024
+    per_chip_batch = 8
+    batch = per_chip_batch * n
+    cfg = gpt2.GPT2Config.preset("gpt2-125m", max_seq_len=seq_len)
+
+    train = compile_gpt2_train(cfg, mesh, optimizer=default_optimizer(total_steps=100))
+    state = train.init_fn(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    tokens = jax.device_put(
+        rng.integers(0, cfg.vocab_size, (batch, seq_len + 1), dtype=np.int32),
+        train.batch_sharding)
+    data = {"tokens": tokens}
+
+    # warmup / compile
+    for _ in range(3):
+        state, metrics = train.step_fn(state, data)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = train.step_fn(state, data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq_len
+    tps_per_chip = tokens_per_step * iters / dt / n
+    mfu = (gpt2.flops_per_token(cfg, seq_len) * tps_per_chip) / 197e12  # v5e bf16 peak
+
+    print(json.dumps({
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+        "value": round(tps_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tps_per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3),
+        "extra": {"n_chips": n, "seq_len": seq_len, "per_chip_batch": per_chip_batch,
+                  "step_ms": round(dt / iters * 1e3, 2), "approx_mfu": round(mfu, 3),
+                  "loss": float(metrics["loss"])},
+    }))
+
+
+if __name__ == "__main__":
+    main()

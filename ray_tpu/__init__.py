@@ -1,0 +1,28 @@
+"""ray_tpu: TPU-native distributed AI runtime.
+
+A brand-new framework with the capabilities of the reference (Ray): task/actor
+core runtime, placement groups, collectives, Train/Serve/Data/Tune libraries —
+re-architected around JAX/XLA/pjit/Pallas and TPU pod scheduling.
+"""
+
+from ray_tpu._version import version as __version__
+
+# Core runtime API is imported lazily so that pure-compute users (models/ops/
+# parallel) don't pay for it, and vice versa.
+_CORE_API = (
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "method", "get_runtime_context", "nodes",
+    "available_resources", "cluster_resources", "ObjectRef", "actor",
+)
+
+
+def __getattr__(name):
+    if name in _CORE_API:
+        from ray_tpu.core import api as _api
+
+        return getattr(_api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_CORE_API))

@@ -1,0 +1,360 @@
+"""Public task/actor API: init, @remote, get/put/wait, actors, kill.
+
+Surface parity with the reference's Python API
+(`python/ray/_private/worker.py` ray.init/get/put/wait/kill,
+`python/ray/remote_function.py`, `python/ray/actor.py`) on a new runtime.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu.core.client import CoreClient
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.object_ref import ObjectRef
+
+_client: Optional[CoreClient] = None
+_head_proc: Optional[subprocess.Popen] = None
+_lock = threading.RLock()
+
+DEFAULT_TASK_OPTIONS = {
+    "num_cpus": 1.0, "num_tpu_chips": 0, "resources": None, "max_retries": 3,
+    "num_returns": 1, "name": None, "placement_group": None,
+}
+DEFAULT_ACTOR_OPTIONS = {
+    "num_cpus": 0.0, "num_tpu_chips": 0, "resources": None, "max_restarts": 0,
+    "max_concurrency": 1, "name": None, "namespace": "default",
+    "lifetime": None, "get_if_exists": False, "placement_group": None,
+}
+
+
+def _global_client() -> CoreClient:
+    if _client is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _client
+
+
+def _attach_existing_client(client: CoreClient) -> None:
+    """Used by worker processes so user code can call the API inside tasks."""
+    global _client
+    _client = client
+
+
+def is_initialized() -> bool:
+    return _client is not None
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
+         num_tpu_chips: Optional[int] = None, resources: Optional[dict] = None,
+         object_store_bytes: int = 2 << 30, max_workers: Optional[int] = None,
+         namespace: str = "default") -> dict:
+    """Start (or join) a cluster and connect this process as the driver."""
+    global _client, _head_proc
+    with _lock:
+        if _client is not None:
+            return _client.node_info
+        if address is None and (env_addr := os.environ.get("RAY_TPU_ADDRESS")):
+            address = env_addr
+        if address is None:
+            session = f"s{uuid.uuid4().hex[:12]}"
+            cmd = [sys.executable, "-m", "ray_tpu.core.head_main",
+                   "--session", session,
+                   "--object-store-bytes", str(object_store_bytes)]
+            if num_cpus is not None:
+                cmd += ["--num-cpus", str(num_cpus)]
+            if num_tpu_chips is not None:
+                cmd += ["--num-tpu-chips", str(num_tpu_chips)]
+            if resources is not None:
+                cmd += ["--resources", json.dumps(resources)]
+            if max_workers is not None:
+                cmd += ["--max-workers", str(max_workers)]
+            from ray_tpu.core.resources import strip_device_env
+
+            head_env = strip_device_env(dict(os.environ))
+            # the head still advertises the node's TPU resources; detection is
+            # env-based and does not need the device env
+            if num_tpu_chips is None and os.environ.get(
+                    "JAX_PLATFORMS", "").startswith(("tpu", "axon")):
+                head_env.setdefault("RAY_TPU_NUM_CHIPS", "1")
+            _head_proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                          stderr=None, text=True, env=head_env)
+            line = _head_proc.stdout.readline()
+            if not line.startswith("RAY_TPU_HEAD_PORT="):
+                raise RuntimeError(f"head failed to start: {line!r}")
+            port = int(line.split("=", 1)[1])
+            host = "127.0.0.1"
+        else:
+            host, port_s = address.rsplit(":", 1)
+            port = int(port_s)
+            session = None
+        client = CoreClient(host, port, session or "joined", is_driver=True)
+        client.start()
+        if session is None:
+            client.store.session = client.node_info["session"]
+        _client = client
+        atexit.register(shutdown)
+        return client.node_info
+
+
+def shutdown() -> None:
+    global _client, _head_proc
+    with _lock:
+        if _client is not None:
+            _client.shutdown()
+            _client = None
+        if _head_proc is not None:
+            _head_proc.terminate()
+            try:
+                _head_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                _head_proc.kill()
+            _head_proc = None
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def _auto_init():
+    if _client is None:
+        init()
+
+
+# ----------------------------------------------------------------- objects
+def put(value: Any) -> ObjectRef:
+    _auto_init()
+    return _global_client().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        timeout: Optional[float] = None) -> Any:
+    # no auto-init: a ref can only come from a live cluster; auto-starting a
+    # fresh one here would block forever on a foreign ref
+    single = isinstance(refs, ObjectRef)
+    out = _global_client().get([refs] if single else list(refs), timeout=timeout)
+    return out[0] if single else out
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    return _global_client().wait(list(refs), num_returns=num_returns,
+                                 timeout=timeout)
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    _global_client().free(list(refs))
+
+
+# ------------------------------------------------------------------- tasks
+def _build_resources(opts: dict) -> dict:
+    res = {"CPU": float(opts.get("num_cpus", 1.0) or 0.0)}
+    if opts.get("num_tpu_chips"):
+        res["TPU"] = float(opts["num_tpu_chips"])
+    if opts.get("resources"):
+        res.update(opts["resources"])
+    return {k: v for k, v in res.items() if v}
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: dict):
+        self._fn = fn
+        self._options = options
+        self._fn_key = None
+        self._client = None
+        functools.update_wrapper(self, fn)
+
+    def _ensure_exported(self):
+        client = _global_client()
+        if self._fn_key is None or self._client is not client:
+            self._fn_key = client.fn_manager.export(self._fn)
+            self._client = client
+        return self._fn_key
+
+    def remote(self, *args, **kwargs):
+        _auto_init()
+        fn_key = self._ensure_exported()
+        opts = dict(self._options)
+        pg = opts.get("placement_group")
+        task_opts = {"resources": _build_resources(opts),
+                     "max_retries": opts.get("max_retries", 3),
+                     "placement_group": pg.id.binary() if pg is not None else None,
+                     "name": opts.get("name") or getattr(self._fn, "__name__", "task")}
+        refs = _global_client().submit_task(
+            fn_key, args, kwargs, task_opts,
+            num_returns=opts.get("num_returns", 1))
+        return refs[0] if opts.get("num_returns", 1) == 1 else refs
+
+    def options(self, **overrides) -> "RemoteFunction":
+        rf = RemoteFunction(self._fn, {**self._options, **overrides})
+        return rf
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__} cannot be called directly; "
+            "use .remote()")
+
+    def __reduce__(self):
+        # ship only the definition; the export cache is rebuilt per-process
+        return (RemoteFunction, (self._fn, self._options))
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._handle._call(self._name, args, kwargs)
+
+    def options(self, **overrides):
+        return self  # per-call options (concurrency groups etc.): later
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, methods: dict):
+        self._actor_id = actor_id
+        self._methods = methods
+
+    def _call(self, method: str, args, kwargs) -> ObjectRef:
+        return _global_client().call_actor(self._actor_id, method, args, kwargs)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._methods:
+            raise AttributeError(f"actor has no method {name!r}")
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._methods))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+
+class ActorClass:
+    def __init__(self, cls, options: dict):
+        self._cls = cls
+        self._options = options
+        self._cls_key = None
+        self._client = None
+
+    def _methods_meta(self) -> dict:
+        return {name: {} for name in dir(self._cls)
+                if callable(getattr(self._cls, name, None))
+                and not name.startswith("__")}
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        _auto_init()
+        client = _global_client()
+        if self._cls_key is None or self._client is not client:
+            self._cls_key = client.fn_manager.export(self._cls)
+            self._client = client
+        opts = dict(self._options)
+        pg = opts.get("placement_group")
+        actor_opts = {"resources": _build_resources({**opts, "num_cpus": opts.get("num_cpus", 0.0)}),
+                      "placement_group": pg.id.binary() if pg is not None else None,
+                      "max_restarts": opts.get("max_restarts", 0),
+                      "max_concurrency": opts.get("max_concurrency", 1),
+                      "name": opts.get("name"),
+                      "namespace": opts.get("namespace", "default"),
+                      "lifetime": opts.get("lifetime"),
+                      "get_if_exists": opts.get("get_if_exists", False)}
+        actor_id = client.create_actor(self._cls_key, args, kwargs, actor_opts,
+                                       self._methods_meta())
+        return ActorHandle(actor_id, self._methods_meta())
+
+    def options(self, **overrides) -> "ActorClass":
+        return ActorClass(self._cls, {**self._options, **overrides})
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("actor class cannot be instantiated directly; "
+                        "use .remote()")
+
+    def __reduce__(self):
+        return (ActorClass, (self._cls, self._options))
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes (with or without options)."""
+
+    def wrap(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return wrap(args[0])
+    return wrap
+
+
+def method(**options):
+    def deco(fn):
+        fn._ray_tpu_method_options = options
+        return fn
+
+    return deco
+
+
+def kill(handle: ActorHandle, *, no_restart: bool = True) -> None:
+    _global_client().kill_actor(handle._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    pass  # best-effort task cancellation: implemented with task events later
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    _auto_init()
+    meta = _global_client().head_request("get_named_actor", name=name,
+                                         namespace=namespace)
+    if meta is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(ActorID(meta["actor_id"]), meta["methods"])
+
+
+# ------------------------------------------------------------------- state
+def nodes() -> list:
+    return _global_client().head_request("list_state", kind="nodes")
+
+
+def cluster_resources() -> dict:
+    return _global_client().head_request("cluster_info")["total_resources"]
+
+
+def available_resources() -> dict:
+    return _global_client().head_request("cluster_info")["available_resources"]
+
+
+class RuntimeContext:
+    def __init__(self, client: CoreClient):
+        self._client = client
+
+    @property
+    def worker_id(self):
+        return self._client.worker_id
+
+    @property
+    def node_id(self):
+        return self._client.node_info.get("node_id")
+
+    @property
+    def session(self):
+        return self._client.session
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_global_client())
+
+
+actor = remote  # alias

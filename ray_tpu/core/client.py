@@ -1,0 +1,340 @@
+"""CoreClient: the per-process runtime embedded in drivers and workers.
+
+Capability-equivalent of the reference's core worker
+(`src/ray/core_worker/core_worker.h:168`) Python-side: task submission,
+object put/get/wait, actor calls over direct worker<->worker connections,
+blocked/unblocked notifications to the scheduler. The asyncio loop runs in a
+background thread; the public API is synchronous (like `ray.get`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import protocol, serialization
+from ray_tpu.core.exceptions import (ActorDiedError, GetTimeoutError,
+                                     ObjectLostError, RayTpuError)
+from ray_tpu.core.function_manager import FunctionManager
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.store import INLINE_THRESHOLD, ObjectMeta, SharedMemoryStore
+from ray_tpu.core.serialization import SerializedObject
+
+ARGS_INLINE_LIMIT = 512 * 1024  # args bigger than this go through the store
+
+
+class CoreClient:
+    def __init__(self, head_host: str, head_port: int, session: str,
+                 is_driver: bool, handlers: Optional[dict] = None):
+        self.head_host, self.head_port = head_host, head_port
+        self.session = session
+        self.is_driver = is_driver
+        self.worker_id = WorkerID.generate()
+        # capacity enforcement/spill is the head's job; client stores only
+        # create/attach segments
+        self.store = SharedMemoryStore(session, capacity_bytes=1 << 62)
+        self.local_metas: Dict[ObjectID, ObjectMeta] = {}
+        self._registered: set = set()     # object ids known to head
+        self.fn_manager = FunctionManager(self)
+        self._extra_handlers = handlers or {}
+        self._direct: Dict[Tuple[str, int], protocol.Connection] = {}
+        self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
+        self.loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(target=self._run_loop, daemon=True,
+                                             name="ray_tpu-client-loop")
+        self.conn: Optional[protocol.Connection] = None
+        self.direct_server: Optional[protocol.Server] = None
+        self.direct_port: Optional[int] = None
+        self.node_info: dict = {}
+        self._started = threading.Event()
+        self._blocked_depth = 0
+        self._blocked_lock = threading.Lock()
+        self.on_disconnect = None
+
+    # ----------------------------------------------------------- lifecycle
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def start(self, direct_handlers: Optional[dict] = None) -> None:
+        self._loop_thread.start()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._start_async(direct_handlers or {}), self.loop)
+        fut.result(timeout=30)
+        self._started.set()
+
+    async def _start_async(self, direct_handlers: dict) -> None:
+        self.direct_server = protocol.Server(direct_handlers, name="direct")
+        self.direct_port = await self.direct_server.start()
+        self.conn = await protocol.connect(self.head_host, self.head_port,
+                                           handlers=self._extra_handlers,
+                                           name="head")
+        self.conn.on_close = lambda c: self._handle_head_loss()
+        self.node_info = await self.conn.request(
+            "register_worker", worker_id=self.worker_id.binary(), pid=os.getpid(),
+            port=self.direct_port, is_driver=self.is_driver)
+
+    def _handle_head_loss(self):
+        if self.on_disconnect:
+            self.on_disconnect()
+
+    def shutdown(self) -> None:
+        async def _close():
+            if self.conn:
+                await self.conn.close()
+            for c in self._direct.values():
+                await c.close()
+            if self.direct_server:
+                await self.direct_server.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_close(), self.loop).result(timeout=5)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._loop_thread.join(timeout=5)
+
+    # ---------------------------------------------------------------- sync
+    def _call(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout=timeout)
+
+    def head_request(self, method: str, **kwargs) -> Any:
+        return self._call(self.conn.request(method, **kwargs))
+
+    # ------------------------------------------------------------- objects
+    def put(self, value: Any, owner: Optional[str] = None) -> ObjectRef:
+        oid = ObjectID.generate()
+        ser = serialization.serialize(value)
+        meta = self.store.put_serialized(oid, ser)
+        self.local_metas[oid] = meta
+        self._register_meta(meta)
+        return ObjectRef(oid)
+
+    def put_serialized(self, ser: SerializedObject, error: bool = False,
+                       register: bool = True) -> ObjectMeta:
+        oid = ObjectID.generate()
+        meta = self.store.put_serialized(oid, ser)
+        meta.error = error
+        self.local_metas[oid] = meta
+        if register:
+            self._register_meta(meta)
+        return meta
+
+    def store_result(self, oid: ObjectID, value: Any, register: bool,
+                     is_error: bool = False) -> ObjectMeta:
+        ser = serialization.serialize(value)
+        meta = self.store.put_serialized(oid, ser)
+        meta.error = is_error
+        self.local_metas[oid] = meta
+        if register:
+            self._register_meta(meta)
+        return meta
+
+    def _register_meta(self, meta: ObjectMeta) -> None:
+        if meta.object_id in self._registered:
+            return
+        self._registered.add(meta.object_id)
+        self._call(self.conn.request("put_meta", meta=meta))
+
+    def ensure_registered(self, ref: ObjectRef) -> None:
+        meta = self.local_metas.get(ref.id)
+        if meta is not None and ref.id not in self._registered:
+            self._registered.add(ref.id)
+            self._call(self.conn.request("put_meta", meta=meta))
+
+    def adopt_meta(self, meta: ObjectMeta) -> ObjectRef:
+        """Record a meta received from a direct actor reply."""
+        self.local_metas[meta.object_id] = meta
+        return ObjectRef(meta.object_id)
+
+    def _read_value(self, meta: ObjectMeta) -> Any:
+        try:
+            ser = self.store.get_serialized(meta)
+        except FileNotFoundError:
+            # our cached meta is stale: the head spilled (or moved) the object
+            # after we fetched the meta — refresh and retry once
+            fresh = self._call(self.conn.request(
+                "get_meta", object_id=meta.object_id.binary(), timeout=5))
+            if fresh is None:
+                from ray_tpu.core.exceptions import ObjectLostError
+
+                raise ObjectLostError(f"object {meta.object_id} is gone")
+            self.local_metas[meta.object_id] = fresh
+            ser = self.store.get_serialized(fresh)
+        value = serialization.deserialize(ser)
+        return value
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        self._set_blocked(True)
+        try:
+            for ref in refs:
+                meta = self.local_metas.get(ref.id)
+                if meta is None:
+                    remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                    meta = self._call(self.conn.request(
+                        "get_meta", object_id=ref.id.binary(), timeout=remaining))
+                    if meta is None:
+                        raise GetTimeoutError(f"get timed out on {ref}")
+                    self.local_metas[ref.id] = meta
+                value = self._read_value(meta)
+                if meta.error or isinstance(value, RayTpuError):
+                    raise value
+                out.append(value)
+            return out
+        finally:
+            self._set_blocked(False)
+
+    async def get_async(self, refs: Sequence[ObjectRef]) -> Any:
+        out = []
+        for ref in refs:
+            meta = self.local_metas.get(ref.id)
+            if meta is None:
+                meta = await self.conn.request("get_meta", object_id=ref.id.binary(),
+                                               timeout=None)
+                self.local_metas[ref.id] = meta
+            value = self._read_value(meta)
+            if meta.error or isinstance(value, RayTpuError):
+                raise value
+            out.append(value)
+        return out[0] if len(out) == 1 else out
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        num_returns = min(num_returns, len(refs))
+        ready_set = {r for r in refs if r.id in self.local_metas}
+        pending = [r for r in refs if r.id not in self.local_metas]
+        if len(ready_set) < num_returns and pending:
+            idx = self._call(self.conn.request(
+                "wait_objects",
+                object_ids=[r.id.binary() for r in pending],
+                num_returns=num_returns - len(ready_set), timeout=timeout))
+            ready_set.update(pending[i] for i in idx)
+        ready = [r for r in refs if r in ready_set][:num_returns]
+        ready_final = set(ready)
+        return ready, [r for r in refs if r not in ready_final]
+
+    def free(self, refs: Sequence[ObjectRef]) -> None:
+        for r in refs:
+            meta = self.local_metas.pop(r.id, None)
+            self._registered.discard(r.id)
+            if meta is not None:
+                self.store.release(meta)  # drop our mapping; head unlinks
+        self._call(self.conn.request(
+            "free_objects", object_ids=[r.id.binary() for r in refs]))
+
+    def _set_blocked(self, value: bool) -> None:
+        if self.is_driver or self.conn is None:
+            return
+        with self._blocked_lock:
+            self._blocked_depth += 1 if value else -1
+            depth = self._blocked_depth
+        if (value and depth == 1) or (not value and depth == 0):
+            try:
+                self._call(self.conn.request("blocked", value=value))
+            except Exception:
+                pass
+
+    # --------------------------------------------------------------- tasks
+    def build_args_payload(self, args: tuple, kwargs: dict):
+        """Top-level ObjectRef args become deps (resolved at execution, like
+        the reference); everything ships serialized."""
+        deps = []
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, ObjectRef):
+                self.ensure_registered(a)
+                deps.append(a.id.binary())
+        ser = serialization.serialize((args, kwargs))
+        if ser.total_bytes <= ARGS_INLINE_LIMIT:
+            return {"inline": ser.to_bytes()}, deps
+        meta = self.put_serialized(ser)
+        return {"meta": meta}, deps
+
+    def submit_task(self, fn_key: bytes, args: tuple, kwargs: dict,
+                    options: dict, num_returns: int = 1) -> List[ObjectRef]:
+        payload, deps = self.build_args_payload(args, kwargs)
+        task_id = TaskID.generate()
+        return_ids = [ObjectID.generate() for _ in range(num_returns)]
+        spec = {"task_id": task_id, "fn_key": fn_key, "args": payload,
+                "deps": deps, "return_ids": [o.binary() for o in return_ids],
+                "options": options}
+        self._call(self.conn.request("submit_task", spec=spec))
+        return [ObjectRef(o) for o in return_ids]
+
+    # -------------------------------------------------------------- actors
+    def create_actor(self, cls_key: bytes, args: tuple, kwargs: dict,
+                     options: dict, methods: dict) -> ActorID:
+        payload, deps = self.build_args_payload(args, kwargs)
+        actor_id = ActorID.generate()
+        spec = {"actor_id": actor_id.binary(), "cls_key": cls_key,
+                "args": payload, "deps": deps, "options": options,
+                "methods": methods}
+        reply = self._call(self.conn.request("create_actor", spec=spec))
+        return ActorID(reply["actor_id"])
+
+    async def _actor_conn(self, actor_id: ActorID) -> protocol.Connection:
+        addr = self._actor_addr_cache.get(actor_id)
+        if addr is None:
+            reply = await self.conn.request("get_actor_address",
+                                            actor_id=actor_id.binary())
+            if reply["state"] == "DEAD":
+                raise ActorDiedError(reply.get("death_cause") or "actor died")
+            addr = tuple(reply["address"])
+            self._actor_addr_cache[actor_id] = addr
+        conn = self._direct.get(addr)
+        if conn is None or conn.closed:
+            reader_writer = await asyncio.open_connection(addr[0], addr[1])
+            conn = protocol.Connection(*reader_writer, name=f"actor-{addr[1]}")
+            conn.start()
+            self._direct[addr] = conn
+        return conn
+
+    async def _call_actor_async(self, actor_id: ActorID, method: str,
+                                payload, deps, return_id: bytes, retries: int = 30):
+        last_err = None
+        for _ in range(retries):
+            try:
+                conn = await self._actor_conn(actor_id)
+                reply = await conn.request(
+                    "actor_call", actor_id=actor_id.binary(), method=method,
+                    args=payload, deps=deps, return_id=return_id)
+                return reply
+            except (protocol.ConnectionLost, ConnectionRefusedError, OSError) as e:
+                last_err = e
+                self._actor_addr_cache.pop(actor_id, None)
+                await asyncio.sleep(0.1)
+        raise ActorDiedError(f"actor unreachable: {last_err}")
+
+    def call_actor(self, actor_id: ActorID, method: str, args: tuple,
+                   kwargs: dict) -> ObjectRef:
+        payload, deps = self.build_args_payload(args, kwargs)
+        return_id = ObjectID.generate()
+        reply = self._call(self._call_actor_async(
+            actor_id, method, payload, deps, return_id.binary()))
+        meta = reply["meta"]
+        self.local_metas[meta.object_id] = meta
+        return ObjectRef(meta.object_id)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._call(self.conn.request("kill_actor", actor_id=actor_id.binary(),
+                                     no_restart=no_restart))
+
+    # ------------------------------------------------------------------ kv
+    def kv_put(self, ns: str, key: bytes, value: bytes, overwrite=True) -> bool:
+        return self._call(self.conn.request("kv_put", ns=ns, key=key,
+                                            value=value, overwrite=overwrite))
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        return self._call(self.conn.request("kv_get", ns=ns, key=key))
+
+    def kv_del(self, ns: str, key: bytes) -> bool:
+        return self._call(self.conn.request("kv_del", ns=ns, key=key))
+
+    def kv_keys(self, ns: str, prefix: bytes) -> list:
+        return self._call(self.conn.request("kv_keys", ns=ns, prefix=prefix))

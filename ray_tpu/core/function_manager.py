@@ -1,0 +1,43 @@
+"""Ship function/class definitions once, load lazily on workers.
+
+Parity with the reference's FunctionActorManager
+(`python/ray/_private/function_manager.py:58`): definitions are exported to
+the head KV keyed by content hash; executing workers fetch + cache. Uses
+cloudpickle so closures/lambdas work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict
+
+import cloudpickle
+
+FUNCTION_NS = "fn"
+
+
+class FunctionManager:
+    def __init__(self, client):
+        self.client = client
+        self._exported: Dict[bytes, bytes] = {}   # key -> blob (local cache)
+        self._loaded: Dict[bytes, Any] = {}
+
+    def export(self, obj: Any) -> bytes:
+        blob = cloudpickle.dumps(obj, protocol=5)
+        key = hashlib.sha256(blob).digest()[:16]
+        if key not in self._exported:
+            self.client.kv_put(FUNCTION_NS, key, blob, overwrite=False)
+            self._exported[key] = blob
+        return key
+
+    def load(self, key: bytes) -> Any:
+        if key in self._loaded:
+            return self._loaded[key]
+        blob = self._exported.get(key)
+        if blob is None:
+            blob = self.client.kv_get(FUNCTION_NS, key)
+            if blob is None:
+                raise RuntimeError(f"function def {key.hex()} not found in KV")
+        obj = cloudpickle.loads(blob)
+        self._loaded[key] = obj
+        return obj

@@ -1,0 +1,648 @@
+"""Head process: cluster control plane + single-node scheduler + worker pool.
+
+Capability-equivalent of the reference's GCS (`src/ray/gcs/gcs_server/`) fused
+with the raylet's scheduling/worker-pool role (`src/ray/raylet/`) for the
+single-node case: node/actor/object/KV tables, pubsub, resource-based task
+scheduling with dependency-aware dispatch, worker lifecycle, actor restarts,
+placement groups. Multi-node support hangs off the same tables (a remote node
+daemon registers like a worker pool with its own resources).
+
+Design differences from the reference (deliberate, TPU-first):
+- steady-state actor calls NEVER pass through here (direct worker<->worker
+  connections, like the reference's core-worker gRPC) — the head only does
+  placement, restarts, and failure pubsub;
+- the object store is per-object shm segments (store.py) with head-side
+  accounting; device arrays stay in per-actor device stores (collective layer)
+  and only metadata flows through the head.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core import protocol
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from ray_tpu.core.store import ObjectMeta, SharedMemoryStore
+
+
+class WorkerInfo:
+    def __init__(self, worker_id: WorkerID, conn: protocol.Connection, pid: int,
+                 port: int, is_driver: bool):
+        self.worker_id = worker_id
+        self.conn = conn
+        self.pid = pid
+        self.port = port  # direct-call server port
+        self.is_driver = is_driver
+        self.running_task: Optional[TaskID] = None
+        self.actor_id: Optional[ActorID] = None
+        self.blocked = False
+        self.acquired: Dict[str, float] = {}
+        self.acquired_pg = None  # PlacementGroupID the resources came from
+        self.proc: Optional[subprocess.Popen] = None
+        self.current_record = None
+
+
+class ActorInfo:
+    def __init__(self, actor_id: ActorID, spec: dict):
+        self.actor_id = actor_id
+        self.spec = spec                  # serialized class, args, options
+        self.state = "PENDING"            # PENDING/ALIVE/RESTARTING/DEAD
+        self.worker: Optional[WorkerInfo] = None
+        self.address: Optional[Tuple[str, int]] = None
+        self.restarts_left = spec["options"].get("max_restarts", 0)
+        self.ready_event = asyncio.Event()
+        self.death_cause: Optional[str] = None
+
+
+class TaskRecord:
+    def __init__(self, spec: dict, submitter: WorkerInfo):
+        self.spec = spec
+        self.task_id: TaskID = spec["task_id"]
+        self.submitter = submitter
+        self.retries_left = spec["options"].get("max_retries", 3)
+        self.pending_deps: Set[ObjectID] = set()
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[dict], strategy: str,
+                 name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"
+        self.ready_event = asyncio.Event()
+        self.capacity: Dict[str, float] = {}   # total reservation (set on CREATED)
+        self.available: Dict[str, float] = {}  # unclaimed portion of it
+
+
+class Head:
+    def __init__(self, session: str, num_cpus: Optional[float] = None,
+                 resources: Optional[dict] = None, num_tpu_chips: Optional[int] = None,
+                 object_store_bytes: int = 2 << 30, max_workers: Optional[int] = None,
+                 labels: Optional[dict] = None):
+        self.session = session
+        self.node_id = NodeID.generate()
+        from ray_tpu.core.resources import node_resources
+
+        self.total_resources = node_resources(num_cpus, num_tpu_chips, resources)
+        self.available = dict(self.total_resources)
+        self.labels = labels or {}
+        self.max_workers = max_workers or max(int(self.total_resources.get("CPU", 4)) * 2, 8)
+
+        self.store = SharedMemoryStore(session, capacity_bytes=object_store_bytes)
+        self.workers: Dict[WorkerID, WorkerInfo] = {}
+        self.idle: List[WorkerInfo] = []
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.objects: Dict[ObjectID, ObjectMeta] = {}
+        self.object_waiters: Dict[ObjectID, List[asyncio.Future]] = {}
+        self.kv: Dict[Tuple[str, bytes], bytes] = {}
+        self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.queue: List[TaskRecord] = []
+        self.dep_index: Dict[ObjectID, List[TaskRecord]] = {}
+        self.subscribers: Dict[str, List[protocol.Connection]] = {}
+        self.port: Optional[int] = None
+        self._server: Optional[protocol.Server] = None
+        self._starting_workers = 0
+        self._shutdown = False
+        self.job_counter = 0
+        self.start_time = time.time()
+        self._spawned: Dict[int, subprocess.Popen] = {}
+
+    # ------------------------------------------------------------------ rpc
+    def _handlers(self, conn_state: dict):
+        async def register_worker(worker_id, pid, port, is_driver):
+            w = WorkerInfo(WorkerID(worker_id), conn_state["conn"], pid, port, is_driver)
+            proc = self._spawned.pop(pid, None)
+            w.proc = proc
+            self.workers[w.worker_id] = w
+            conn_state["worker"] = w
+            if not is_driver:
+                self.idle.append(w)
+                self._starting_workers = max(0, self._starting_workers - 1)
+                self._kick()
+            return {"node_id": self.node_id.binary(), "session": self.session,
+                    "resources": self.total_resources, "labels": self.labels}
+
+        async def submit_task(spec):
+            w = conn_state["worker"]
+            rec = TaskRecord(spec, w)
+            self._enqueue(rec)
+            return True
+
+        async def create_actor(spec):
+            actor_id = ActorID(spec["actor_id"])
+            name = spec["options"].get("name")
+            key = None
+            if name:
+                key = (spec["options"].get("namespace", "default"), name)
+                if key in self.named_actors:
+                    existing = self.actors[self.named_actors[key]]
+                    if existing.state != "DEAD":
+                        if spec["options"].get("get_if_exists"):
+                            return {"actor_id": self.named_actors[key].binary()}
+                        raise ValueError(f"actor name {name!r} already taken")
+            info = ActorInfo(actor_id, spec)
+            self.actors[actor_id] = info
+            if key is not None:
+                self.named_actors[key] = actor_id
+            self._schedule_actor(info)
+            return {"actor_id": actor_id.binary()}
+
+        async def wait_actor(actor_id):
+            info = self.actors[ActorID(actor_id)]
+            await info.ready_event.wait()
+            if info.state == "DEAD":
+                return {"state": "DEAD", "death_cause": info.death_cause}
+            return {"state": info.state, "address": info.address}
+
+        async def get_actor_address(actor_id):
+            info = self.actors.get(ActorID(actor_id))
+            if info is None:
+                return {"state": "DEAD", "death_cause": "actor not found"}
+            if info.state in ("PENDING", "RESTARTING"):
+                await info.ready_event.wait()
+            if info.state == "DEAD":
+                return {"state": "DEAD", "death_cause": info.death_cause}
+            return {"state": info.state, "address": info.address}
+
+        async def get_named_actor(name, namespace):
+            key = (namespace, name)
+            actor_id = self.named_actors.get(key)
+            if actor_id is None or self.actors[actor_id].state == "DEAD":
+                return None
+            info = self.actors[actor_id]
+            meta = {"actor_id": actor_id.binary(),
+                    "methods": info.spec.get("methods", {})}
+            return meta
+
+        async def kill_actor(actor_id, no_restart=True):
+            info = self.actors.get(ActorID(actor_id))
+            if info is None:
+                return False
+            if no_restart:
+                info.restarts_left = 0
+            if info.worker is not None:
+                self._terminate_worker(info.worker)
+            else:
+                self._mark_actor_dead(info, "killed")
+            return True
+
+        async def put_meta(meta):
+            self._seal(meta)
+            return True
+
+        async def get_meta(object_id, timeout=None):
+            oid = ObjectID(object_id)
+            meta = self.objects.get(oid)
+            if meta is not None:
+                return meta
+            fut = asyncio.get_running_loop().create_future()
+            self.object_waiters.setdefault(oid, []).append(fut)
+            if timeout is None:
+                return await fut
+            try:
+                return await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                return None
+
+        async def wait_objects(object_ids, num_returns, timeout):
+            ids = [ObjectID(b) for b in object_ids]
+            num_returns = min(num_returns, len(ids))
+            deadline = None if timeout is None else time.monotonic() + timeout
+
+            def ready():
+                return [i for i, oid in enumerate(ids) if oid in self.objects]
+
+            while len(ready()) < num_returns:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                futs = []
+                for oid in ids:
+                    if oid not in self.objects:
+                        fut = asyncio.get_running_loop().create_future()
+                        self.object_waiters.setdefault(oid, []).append(fut)
+                        futs.append(fut)
+                if not futs:
+                    break
+                try:
+                    await asyncio.wait(futs, timeout=remaining,
+                                       return_when=asyncio.FIRST_COMPLETED)
+                finally:
+                    for fut in futs:
+                        fut.cancel()
+            return ready()
+
+        async def free_objects(object_ids):
+            for b in object_ids:
+                meta = self.objects.pop(ObjectID(b), None)
+                if meta is not None:
+                    self.store.free(meta)
+            return True
+
+        async def kv_put(ns, key, value, overwrite=True):
+            k = (ns, key)
+            if not overwrite and k in self.kv:
+                return False
+            self.kv[k] = value
+            return True
+
+        async def kv_get(ns, key):
+            return self.kv.get((ns, key))
+
+        async def kv_del(ns, key):
+            return self.kv.pop((ns, key), None) is not None
+
+        async def kv_keys(ns, prefix):
+            return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+        async def create_pg(pg_id, bundles, strategy, name):
+            pgid = PlacementGroupID(pg_id)
+            pg = PlacementGroupInfo(pgid, bundles, strategy, name)
+            self.pgs[pgid] = pg
+            self._try_reserve_pg(pg)
+            return True
+
+        async def wait_pg(pg_id, timeout=None):
+            pg = self.pgs.get(PlacementGroupID(pg_id))
+            if pg is None:
+                return {"state": "REMOVED"}
+            if timeout is not None:
+                try:
+                    await asyncio.wait_for(pg.ready_event.wait(), timeout)
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await pg.ready_event.wait()
+            return {"state": pg.state}
+
+        async def remove_pg(pg_id):
+            pg = self.pgs.pop(PlacementGroupID(pg_id), None)
+            if pg is not None and pg.state == "CREATED":
+                # return only the unclaimed portion; in-use resources flow back
+                # to the node ledger when their tasks release (pg is gone then)
+                for res, amt in pg.available.items():
+                    self.available[res] = self.available.get(res, 0) + amt
+                self._kick()
+            return True
+
+        async def blocked(value):
+            w = conn_state.get("worker")
+            if w is not None and w.blocked != value:
+                w.blocked = value
+                if value:
+                    self._release(w, cpu_only=True)
+                self._kick()
+            return True
+
+        async def subscribe(channel):
+            self.subscribers.setdefault(channel, []).append(conn_state["conn"])
+            return True
+
+        async def cluster_info():
+            return {
+                "node_id": self.node_id.binary(),
+                "session": self.session,
+                "total_resources": self.total_resources,
+                "available_resources": self.available,
+                "labels": self.labels,
+                "num_workers": len(self.workers),
+                "actors": {a.hex(): info.state for a, info in self.actors.items()},
+                "uptime": time.time() - self.start_time,
+            }
+
+        async def job_counter_next():
+            self.job_counter += 1
+            return self.job_counter
+
+        async def list_state(kind):
+            return self._list_state(kind)
+
+        async def task_done(task_id):
+            w = conn_state.get("worker")
+            if w is not None:
+                self.notify_task_done(w)
+            return True
+
+        async def actor_ready(actor_id, address):
+            info = self.actors.get(ActorID(actor_id))
+            if info is not None:
+                self.notify_actor_ready(info, address)
+            return True
+
+        async def actor_creation_failed(actor_id, cause):
+            info = self.actors.get(ActorID(actor_id))
+            if info is not None:
+                w = info.worker
+                info.restarts_left = 0  # constructor errors are not retried
+                self._mark_actor_dead(info, f"creation failed: {cause}")
+                if w is not None:
+                    info.worker = None
+                    w.actor_id = None
+                    self._release(w)
+                    if w not in self.idle:
+                        self.idle.append(w)
+                    self._kick()
+            return True
+
+        import inspect
+
+        return {k: v for k, v in locals().items() if inspect.iscoroutinefunction(v)}
+
+    # ---------------------------------------------------------------- sched
+    def _enqueue(self, rec: TaskRecord) -> None:
+        for dep in rec.spec.get("deps", []):
+            oid = ObjectID(dep)
+            if oid not in self.objects:
+                rec.pending_deps.add(oid)
+                self.dep_index.setdefault(oid, []).append(rec)
+        self.queue.append(rec)
+        self._kick()
+
+    def _seal(self, meta: ObjectMeta) -> None:
+        existing = self.objects.get(meta.object_id)
+        if existing is not None:
+            # objects are immutable: first seal wins (a racing retry must not
+            # replace a good value, especially not with its own error)
+            self.store.free(meta)
+            return
+        self.objects[meta.object_id] = meta
+        if meta.kind == "shm":
+            self.store.adopt(meta)  # accounting + LRU/spill tracking
+        for fut in self.object_waiters.pop(meta.object_id, []):
+            if not fut.done():
+                fut.set_result(meta)
+        for rec in self.dep_index.pop(meta.object_id, []):
+            rec.pending_deps.discard(meta.object_id)
+        self._kick()
+
+    def _fits(self, resources: Dict[str, float]) -> bool:
+        return all(self.available.get(r, 0) >= amt - 1e-9 for r, amt in resources.items())
+
+    def _pg_for(self, options: dict) -> Optional[PlacementGroupInfo]:
+        pgb = options.get("placement_group")
+        return self.pgs.get(PlacementGroupID(pgb)) if pgb else None
+
+    @staticmethod
+    def _fits_pg(pg: PlacementGroupInfo, resources: Dict[str, float]) -> bool:
+        return pg.state == "CREATED" and all(
+            pg.available.get(r, 0) >= amt - 1e-9 for r, amt in resources.items())
+
+    def _acquire(self, w: WorkerInfo, resources: Dict[str, float],
+                 pg: Optional[PlacementGroupInfo] = None) -> None:
+        ledger = pg.available if pg is not None else self.available
+        for r, amt in resources.items():
+            ledger[r] = ledger.get(r, 0) - amt
+        w.acquired = dict(resources)
+        w.acquired_pg = pg.pg_id if pg is not None else None
+
+    def _release(self, w: WorkerInfo, cpu_only: bool = False) -> None:
+        pg = self.pgs.get(w.acquired_pg) if getattr(w, "acquired_pg", None) else None
+        # if the pg was removed while the work ran, resources return to the node
+        ledger = pg.available if pg is not None else self.available
+        for r, amt in list(w.acquired.items()):
+            if cpu_only and r != "CPU":
+                continue
+            ledger[r] = ledger.get(r, 0) + amt
+            del w.acquired[r]
+        if not w.acquired:
+            w.acquired_pg = None
+
+    def _kick(self) -> None:
+        """Dispatch as many queued tasks as possible; spawn workers if useful."""
+        if self._shutdown:
+            return
+        self._retry_pending_pgs()
+        still_queued: List[TaskRecord] = []
+        for rec in self.queue:
+            if rec.pending_deps:
+                still_queued.append(rec)
+                continue
+            resources = rec.spec["options"].get("resources", {"CPU": 1})
+            if rec.spec["options"].get("placement_group"):
+                pg = self._pg_for(rec.spec["options"])
+                if pg is None:
+                    self._fail_task(rec, "placement group was removed")
+                    continue
+                if not self._fits_pg(pg, resources) or not self.idle:
+                    still_queued.append(rec)
+                    continue
+            else:
+                pg = None
+                if not self._fits(resources) or not self.idle:
+                    still_queued.append(rec)
+                    continue
+            w = self.idle.pop()
+            self._acquire(w, resources, pg)
+            w.running_task = rec.task_id
+            w.current_record = rec
+            w.conn.push("exec_task", spec=rec.spec)
+        self.queue = still_queued
+        # Pending actors also need workers.
+        for info in self.actors.values():
+            if info.state in ("PENDING", "RESTARTING") and info.worker is None:
+                self._schedule_actor(info)
+        demand = len([r for r in self.queue if not r.pending_deps]) + len(
+            [a for a in self.actors.values()
+             if a.state in ("PENDING", "RESTARTING") and a.worker is None])
+        can_start = (self.max_workers - len([w for w in self.workers.values()
+                                             if not w.is_driver]) - self._starting_workers)
+        for _ in range(min(demand - len(self.idle) - self._starting_workers, can_start)):
+            self._spawn_worker()
+
+    def _schedule_actor(self, info: ActorInfo) -> None:
+        resources = info.spec["options"].get("resources", {"CPU": 0})
+        pg = self._pg_for(info.spec["options"])
+        if info.spec["options"].get("placement_group") and pg is None:
+            self._mark_actor_dead(info, "placement group was removed")
+            return
+        fits = self._fits_pg(pg, resources) if pg else self._fits(resources)
+        if not self.idle or not fits:
+            self._maybe_spawn_for_demand()
+            return
+        w = self.idle.pop()
+        self._acquire(w, resources, pg)
+        w.actor_id = info.actor_id
+        info.worker = w
+        w.conn.push("start_actor", spec=info.spec)
+
+    def _maybe_spawn_for_demand(self) -> None:
+        alive = len([w for w in self.workers.values() if not w.is_driver])
+        if alive + self._starting_workers < self.max_workers:
+            self._spawn_worker()
+
+    # -------------------------------------------------------------- workers
+    def _spawn_worker(self) -> None:
+        self._starting_workers += 1
+        from ray_tpu.core.resources import strip_device_env
+
+        env = strip_device_env(dict(os.environ))
+        env["RAY_TPU_HEAD_PORT"] = str(self.port)
+        env["RAY_TPU_SESSION"] = self.session
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env, stdout=None, stderr=None)
+        self._spawned[proc.pid] = proc
+
+    def _on_worker_disconnect(self, w: WorkerInfo) -> None:
+        self.workers.pop(w.worker_id, None)
+        if w in self.idle:
+            self.idle.remove(w)
+        self._release(w)
+        rec = getattr(w, "current_record", None)
+        if rec is not None and w.running_task is not None:
+            if rec.retries_left > 0:
+                rec.retries_left -= 1
+                rec.pending_deps = set()
+                self._enqueue(rec)
+            else:
+                self._fail_task(rec, f"worker {w.worker_id} died (pid {w.pid})")
+        if w.actor_id is not None:
+            info = self.actors.get(w.actor_id)
+            if info is not None and info.state != "DEAD":
+                info.worker = None
+                info.address = None
+                if info.restarts_left != 0:
+                    if info.restarts_left > 0:
+                        info.restarts_left -= 1
+                    info.state = "RESTARTING"
+                    info.ready_event = asyncio.Event()
+                    self._publish("actor_state", {"actor_id": w.actor_id.binary(),
+                                                  "state": "RESTARTING"})
+                    self._schedule_actor(info)
+                else:
+                    self._mark_actor_dead(info, f"worker died (pid {w.pid})")
+        if w.is_driver:
+            pass  # job cleanup: objects are session-scoped in round 1
+        self._kick()
+
+    def _mark_actor_dead(self, info: ActorInfo, cause: str) -> None:
+        info.state = "DEAD"
+        info.death_cause = cause
+        info.ready_event.set()
+        self._publish("actor_state", {"actor_id": info.actor_id.binary(),
+                                      "state": "DEAD", "cause": cause})
+
+    def _terminate_worker(self, w: WorkerInfo) -> None:
+        try:
+            if w.proc is not None:
+                w.proc.kill()
+            else:
+                os.kill(w.pid, 9)
+        except ProcessLookupError:
+            pass
+
+    def _fail_task(self, rec: TaskRecord, cause: str) -> None:
+        from ray_tpu.core import serialization
+        from ray_tpu.core.exceptions import WorkerCrashedError
+
+        err = serialization.serialize(WorkerCrashedError(cause))
+        for rid in rec.spec["return_ids"]:
+            meta = self.store.put_serialized(ObjectID(rid), err)
+            meta.error = True
+            self._seal(meta)
+
+    def _publish(self, channel: str, msg: dict) -> None:
+        for conn in self.subscribers.get(channel, []):
+            if not conn.closed:
+                conn.push("pubsub", channel=channel, msg=msg)
+
+    def _retry_pending_pgs(self) -> None:
+        for pg in self.pgs.values():
+            if pg.state == "PENDING":
+                self._try_reserve_pg(pg)
+
+    # ------------------------------------------------------------------ pgs
+    def _try_reserve_pg(self, pg: PlacementGroupInfo) -> None:
+        need: Dict[str, float] = {}
+        for bundle in pg.bundles:
+            for r, amt in bundle.items():
+                need[r] = need.get(r, 0) + amt
+        if self._fits(need):
+            for r, amt in need.items():
+                self.available[r] -= amt
+            pg.capacity = dict(need)
+            pg.available = dict(need)
+            pg.state = "CREATED"
+            pg.ready_event.set()
+        # else stays PENDING; re-tried on resource release (single-node round 1)
+
+    # ---------------------------------------------------------------- state
+    def _list_state(self, kind: str):
+        if kind == "actors":
+            return [{"actor_id": a.hex(), "state": i.state,
+                     "name": i.spec["options"].get("name"),
+                     "restarts_left": i.restarts_left}
+                    for a, i in self.actors.items()]
+        if kind == "workers":
+            return [{"worker_id": w.hex(), "pid": i.pid, "is_driver": i.is_driver,
+                     "actor": i.actor_id.hex() if i.actor_id else None,
+                     "task": i.running_task.hex() if i.running_task else None}
+                    for w, i in self.workers.items()]
+        if kind == "objects":
+            return [{"object_id": o.hex(), "size": m.size, "kind": m.kind}
+                    for o, m in self.objects.items()]
+        if kind == "tasks":
+            return [{"task_id": r.task_id.hex(),
+                     "pending_deps": len(r.pending_deps)} for r in self.queue]
+        if kind == "nodes":
+            return [{"node_id": self.node_id.hex(), "resources": self.total_resources,
+                     "available": self.available, "labels": self.labels,
+                     "alive": True}]
+        if kind == "placement_groups":
+            return [{"pg_id": p.hex(), "state": g.state, "strategy": g.strategy,
+                     "bundles": g.bundles} for p, g in self.pgs.items()]
+        raise ValueError(f"unknown state kind {kind}")
+
+    # --------------------------------------------------------------- server
+    async def start(self, port: int = 0) -> int:
+        def on_connect(conn: protocol.Connection):
+            conn_state = {"conn": conn}
+            conn.handlers.update(self._handlers(conn_state))
+            orig_close = conn.on_close
+
+            def on_close(c):
+                if orig_close:
+                    orig_close(c)
+                w = conn_state.get("worker")
+                if w is not None:
+                    self._on_worker_disconnect(w)
+
+            conn.on_close = on_close
+
+        # handlers installed per-connection (they close over conn_state)
+        self._server = protocol.Server({}, on_connect=on_connect, name="head")
+        self.port = await self._server.start(port=port)
+        # task completion wiring: workers push task_done
+        return self.port
+
+    def notify_task_done(self, w: WorkerInfo) -> None:
+        w.running_task = None
+        w.current_record = None
+        self._release(w)
+        if not w.is_driver and w.actor_id is None and w not in self.idle:
+            self.idle.append(w)
+        self._kick()
+
+    def notify_actor_ready(self, info: ActorInfo, address) -> None:
+        info.state = "ALIVE"
+        info.address = tuple(address)
+        info.ready_event.set()
+        self._publish("actor_state", {"actor_id": info.actor_id.binary(),
+                                      "state": "ALIVE"})
+
+    async def stop(self) -> None:
+        self._shutdown = True
+        for w in list(self.workers.values()):
+            if not w.is_driver:
+                self._terminate_worker(w)
+        if self._server:
+            await self._server.stop()
+        self.store.shutdown()

@@ -1,0 +1,49 @@
+"""Head process entry (`python -m ray_tpu.core.head_main`).
+
+Prints `RAY_TPU_HEAD_PORT=<port>` on stdout once serving, then runs until
+killed — the counterpart of `gcs_server` + head-node raylet bring-up
+(`python/ray/_private/node.py:1340 start_head_processes`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ray_tpu.core.gcs import Head
+
+
+async def amain(args) -> None:
+    head = Head(session=args.session, num_cpus=args.num_cpus,
+                resources=json.loads(args.resources) if args.resources else None,
+                num_tpu_chips=args.num_tpu_chips,
+                object_store_bytes=args.object_store_bytes,
+                max_workers=args.max_workers)
+    port = await head.start(port=args.port)
+    print(f"RAY_TPU_HEAD_PORT={port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await head.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--session", required=True)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpu-chips", type=int, default=None)
+    p.add_argument("--resources", type=str, default=None)
+    p.add_argument("--object-store-bytes", type=int, default=2 << 30)
+    p.add_argument("--max-workers", type=int, default=None)
+    args = p.parse_args()
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""ObjectRef: a future-like handle to a (possibly not yet created) object."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id",)
+
+    def __init__(self, object_id: ObjectID):
+        assert isinstance(object_id, ObjectID)
+        self.id = object_id
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id,))
+
+    # `await ref` inside async actors / drivers with a running loop
+    def __await__(self):
+        from ray_tpu.core.api import _global_client
+
+        client = _global_client()
+        return client.get_async([self]).__await__()

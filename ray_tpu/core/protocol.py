@@ -1,0 +1,206 @@
+"""Asyncio message transport: length-prefixed pickle frames + RPC layer.
+
+Plays the role of the reference's gRPC wrappers (`src/ray/rpc/`): typed
+request/reply with correlation ids over persistent connections, plus
+server-push messages. Includes the reference's `rpc_chaos`-style fault
+injection hook (SURVEY.md §4.2 pattern 4) so tests can kill/delay specific
+RPCs via config, not external tooling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+import pickle
+import random
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+HEADER = 8  # little-endian u64 frame length
+
+# --- fault injection (env: RAY_TPU_TESTING_RPC_FAILURE="method:prob") -------
+_chaos: Dict[str, float] = {}
+
+
+def configure_chaos(spec: Optional[str] = None) -> None:
+    _chaos.clear()
+    spec = spec if spec is not None else os.environ.get("RAY_TPU_TESTING_RPC_FAILURE", "")
+    for part in filter(None, (spec or "").split(",")):
+        method, prob = part.rsplit(":", 1)
+        _chaos[method] = float(prob)
+
+
+configure_chaos()
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class RemoteError(RpcError):
+    """The handler raised; carries the remote traceback string."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    try:
+        header = await reader.readexactly(HEADER)
+        payload = await reader.readexactly(int.from_bytes(header, "little"))
+    except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError) as e:
+        raise ConnectionLost(str(e)) from e
+    return pickle.loads(payload)
+
+
+def write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
+    payload = pickle.dumps(msg, protocol=5)
+    writer.write(len(payload).to_bytes(8, "little") + payload)
+
+
+class Connection:
+    """Bidirectional RPC over one TCP connection.
+
+    Either side may call `request`; either side serves via its handler table.
+    Message shapes: ("req", id, method, args_dict), ("rep", id, result),
+    ("err", id, repr_string), ("push", method, args_dict).
+    """
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handlers: Optional[Dict[str, Callable[..., Awaitable[Any]]]] = None,
+                 name: str = "?"):
+        self.reader, self.writer = reader, writer
+        self.handlers = handlers or {}
+        self.name = name
+        self._seq = itertools.count()
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._closed = asyncio.Event()
+        self.on_close: Optional[Callable[["Connection"], None]] = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._read_loop(), name=f"conn-{self.name}")
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await read_frame(self.reader)
+                kind = msg[0]
+                if kind == "req":
+                    _, rid, method, kwargs = msg
+                    asyncio.create_task(self._dispatch(rid, method, kwargs))
+                elif kind == "push":
+                    _, method, kwargs = msg
+                    asyncio.create_task(self._dispatch(None, method, kwargs))
+                elif kind == "rep":
+                    fut = self._pending.pop(msg[1], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg[2])
+                elif kind == "err":
+                    fut = self._pending.pop(msg[1], None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(RemoteError(msg[2]))
+        except (ConnectionLost, asyncio.CancelledError):
+            pass
+        finally:
+            self._closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost(f"connection {self.name} closed"))
+            self._pending.clear()
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+            if self.on_close:
+                self.on_close(self)
+
+    async def _dispatch(self, rid: Optional[int], method: str, kwargs: dict) -> None:
+        try:
+            handler = self.handlers[method]
+            result = await handler(**kwargs)
+            if rid is not None:
+                write_frame(self.writer, ("rep", rid, result))
+        except Exception as e:  # noqa: BLE001 - must serialize any failure
+            import traceback
+
+            if rid is not None:
+                try:
+                    write_frame(self.writer, ("err", rid, traceback.format_exc()))
+                except Exception:
+                    pass
+            else:
+                print(f"[ray_tpu] push handler {method} failed: {e}", flush=True)
+
+    async def request(self, rpc: str, **kwargs) -> Any:
+        if prob := _chaos.get(rpc):
+            if random.random() < prob:
+                raise ConnectionLost(f"chaos: injected failure for {rpc}")
+        if self.closed:
+            raise ConnectionLost(f"connection {self.name} already closed")
+        rid = next(self._seq)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        write_frame(self.writer, ("req", rid, rpc, kwargs))
+        return await fut
+
+    def push(self, rpc: str, **kwargs) -> None:
+        if not self.closed:
+            write_frame(self.writer, ("push", rpc, kwargs))
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def connect(host: str, port: int, handlers=None, name: str = "?") -> Connection:
+    reader, writer = await asyncio.open_connection(host, port)
+    conn = Connection(reader, writer, handlers, name=name)
+    conn.start()
+    return conn
+
+
+class Server:
+    """TCP server that wraps each inbound connection in a Connection."""
+
+    def __init__(self, handlers: Dict[str, Callable[..., Awaitable[Any]]],
+                 on_connect: Optional[Callable[[Connection], None]] = None,
+                 name: str = "server"):
+        self.handlers = handlers
+        self.on_connect = on_connect
+        self.name = name
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set[Connection] = set()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        async def handle(reader, writer):
+            conn = Connection(reader, writer, dict(self.handlers), name=self.name)
+            self.connections.add(conn)
+            conn.on_close = self.connections.discard
+            if self.on_connect:
+                self.on_connect(conn)
+            conn.start()
+
+        self._server = await asyncio.start_server(handle, host, port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()

@@ -1,0 +1,124 @@
+"""Object serialization: pickle5 with out-of-band buffers.
+
+Equivalent capability to the reference's msgpack+cloudpickle envelope with
+pickle5 out-of-band buffers (`python/ray/_private/serialization.py`) — but we
+only need the Python path, and jax/numpy arrays are the hot case:
+
+- protocol-5 `buffer_callback` captures large contiguous buffers (numpy
+  arrays, bytes) without copying them into the pickle stream;
+- `jax.Array` on device is fetched to host memory first (device buffers are
+  process-local in PJRT; zero-copy device handoff is the device object
+  store's job, not the byte serializer's);
+- the resulting (meta, buffers) pair maps directly onto a shared-memory
+  segment: header + concatenated buffers, so readers reconstruct numpy arrays
+  as zero-copy views onto shm.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import sys
+from typing import Any, List
+
+
+class SerializedObject:
+    """Pickle meta + list of out-of-band buffers (zero-copy where possible)."""
+
+    __slots__ = ("meta", "buffers")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview]):
+        self.meta = meta
+        self.buffers = buffers
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self.meta) + sum(b.nbytes for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten into one contiguous frame: [n_buffers][meta_len][meta]
+        [buf_len buf]*  (lengths are 8-byte little-endian)."""
+        parts = [len(self.buffers).to_bytes(8, "little"),
+                 len(self.meta).to_bytes(8, "little"), self.meta]
+        for b in self.buffers:
+            parts.append(b.nbytes.to_bytes(8, "little"))
+            parts.append(bytes(b) if not isinstance(b, bytes) else b)
+        return b"".join(parts)
+
+    def write_into(self, out: memoryview) -> int:
+        """Serialize into a preallocated buffer (e.g. a shm segment)."""
+        off = 0
+
+        def put(data):
+            nonlocal off
+            n = len(data)
+            out[off:off + n] = data
+            off += n
+
+        put(len(self.buffers).to_bytes(8, "little"))
+        put(len(self.meta).to_bytes(8, "little"))
+        put(self.meta)
+        for b in self.buffers:
+            put(b.nbytes.to_bytes(8, "little"))
+            mv = memoryview(b)
+            if not mv.contiguous:
+                mv = memoryview(bytes(mv))
+            out[off:off + mv.nbytes] = mv.cast("B")
+            off += mv.nbytes
+        return off
+
+    @property
+    def frame_bytes(self) -> int:
+        return 16 + len(self.meta) + sum(8 + b.nbytes for b in self.buffers)
+
+    @classmethod
+    def from_view(cls, view: memoryview) -> "SerializedObject":
+        """Parse a frame, keeping buffers as zero-copy views into `view`."""
+        off = 0
+        n_buffers = int.from_bytes(view[off:off + 8], "little"); off += 8
+        meta_len = int.from_bytes(view[off:off + 8], "little"); off += 8
+        meta = bytes(view[off:off + meta_len]); off += meta_len
+        buffers = []
+        for _ in range(n_buffers):
+            blen = int.from_bytes(view[off:off + 8], "little"); off += 8
+            buffers.append(view[off:off + blen]); off += blen
+        return cls(meta, buffers)
+
+
+class _Pickler(pickle.Pickler):
+    """Pickler that lowers device-resident jax Arrays to host numpy (device
+    buffers are process-local; zero-copy device paths use the device object
+    store instead, not byte serialization)."""
+
+    def reducer_override(self, obj):
+        jax = sys.modules.get("jax")
+        if jax is not None and isinstance(obj, jax.Array):
+            import numpy as np
+
+            return np.asarray(obj).__reduce_ex__(5)
+        return NotImplemented
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[memoryview] = []
+
+    def callback(pb: pickle.PickleBuffer):
+        buffers.append(pb.raw())
+        return False  # out-of-band
+
+    sink = io.BytesIO()
+    p = _Pickler(sink, protocol=5, buffer_callback=callback)
+    p.dump(value)
+    return SerializedObject(sink.getvalue(), buffers)
+
+
+def deserialize(obj: SerializedObject) -> Any:
+    return pickle.loads(obj.meta, buffers=[pickle.PickleBuffer(b) for b in obj.buffers])
+
+
+def dumps(value: Any) -> bytes:
+    return serialize(value).to_bytes()
+
+
+def loads(data: bytes) -> Any:
+    return deserialize(SerializedObject.from_view(memoryview(data)))

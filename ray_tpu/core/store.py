@@ -1,0 +1,214 @@
+"""Node-local shared-memory object store with spill-to-disk.
+
+Capability-equivalent of the reference's plasma store + external storage
+(`src/ray/object_manager/plasma/`, `python/ray/_private/external_storage.py`):
+immutable sealed objects in named shm segments, zero-copy reads from any
+process on the node, LRU spill to disk under memory pressure. Re-designed
+rather than ported: Python `multiprocessing.shared_memory` segments (one per
+object) instead of a dlmalloc arena + fd passing; small objects stay inline
+and never touch shm (the reference's in-process memory store fast path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import OrderedDict
+from multiprocessing import shared_memory
+from typing import Dict, Optional
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.serialization import SerializedObject
+
+INLINE_THRESHOLD = 100 * 1024  # small objects ride the control plane inline
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    object_id: ObjectID
+    size: int
+    kind: str                      # "inline" | "shm" | "spilled"
+    segment: Optional[str] = None  # shm segment name
+    inline: Optional[bytes] = None # inline payload (kind == "inline")
+    spill_path: Optional[str] = None
+    node_id: Optional[object] = None
+    owner: Optional[object] = None  # WorkerID of owner
+    error: bool = False             # payload is a serialized exception
+
+
+def _unregister_tracker(shm: shared_memory.SharedMemory) -> None:
+    """We manage segment lifetime explicitly; stop resource_tracker from
+    unlinking segments when an attaching process exits."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+
+
+class SharedMemoryStore:
+    """Per-node store. The node's daemon owns creation/eviction; other
+    processes attach read-only by segment name."""
+
+    def __init__(self, session: str, capacity_bytes: int = 2 << 30,
+                 spill_dir: Optional[str] = None):
+        self.session = session
+        self.capacity = capacity_bytes
+        self.used = 0
+        self.spill_dir = spill_dir or f"/tmp/ray_tpu/{session}/spill"
+        self._segments: "OrderedDict[str, shared_memory.SharedMemory]" = OrderedDict()
+        self._meta_by_segment: Dict[str, ObjectMeta] = {}
+        self._pinned: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- creation ----------------------------------------------------------
+    def put_serialized(self, obj_id: ObjectID, ser: SerializedObject) -> ObjectMeta:
+        size = ser.frame_bytes
+        if size <= INLINE_THRESHOLD:
+            return ObjectMeta(obj_id, size, "inline", inline=ser.to_bytes())
+        # random suffix: a retried task must not collide with a segment left
+        # behind by a dead attempt for the same return object id
+        name = f"rtpu_{self.session[:8]}_{obj_id.hex()[:12]}_{os.urandom(3).hex()}"
+        with self._lock:
+            self._ensure_capacity(size)
+            shm = shared_memory.SharedMemory(create=True, size=size, name=name)
+            _unregister_tracker(shm)
+            self._segments[name] = shm
+            self.used += size
+        ser.write_into(memoryview(shm.buf))
+        meta = ObjectMeta(obj_id, size, "shm", segment=name)
+        self._meta_by_segment[name] = meta
+        return meta
+
+    def adopt(self, meta: ObjectMeta) -> None:
+        """Track a segment created by another process on this node (accounting,
+        LRU ordering, spill eligibility)."""
+        if meta.kind != "shm" or meta.segment is None:
+            return
+        with self._lock:
+            if meta.segment in self._segments:
+                self._meta_by_segment[meta.segment] = meta
+                return
+            self._ensure_capacity(meta.size)
+            try:
+                shm = shared_memory.SharedMemory(name=meta.segment)
+            except FileNotFoundError:
+                return
+            _unregister_tracker(shm)
+            self._segments[meta.segment] = shm
+            self._meta_by_segment[meta.segment] = meta
+            self.used += meta.size
+
+    # -- reads -------------------------------------------------------------
+    def get_serialized(self, meta: ObjectMeta) -> SerializedObject:
+        if meta.kind == "inline":
+            return SerializedObject.from_view(memoryview(meta.inline))
+        if meta.kind == "spilled":
+            with open(meta.spill_path, "rb") as f:
+                return SerializedObject.from_view(memoryview(f.read()))
+        with self._lock:
+            shm = self._segments.get(meta.segment)
+            if shm is not None:
+                self._segments.move_to_end(meta.segment)  # LRU touch
+        if shm is None:
+            shm = shared_memory.SharedMemory(name=meta.segment)
+            _unregister_tracker(shm)
+            with self._lock:
+                self._segments.setdefault(meta.segment, shm)  # cache attachment
+        # NOTE: the returned buffers alias shm memory; callers must copy or
+        # finish deserializing before the object is freed.
+        return SerializedObject.from_view(memoryview(shm.buf))
+
+    # -- lifetime ----------------------------------------------------------
+    def pin(self, meta: ObjectMeta) -> None:
+        with self._lock:
+            if meta.segment:
+                self._pinned[meta.segment] = self._pinned.get(meta.segment, 0) + 1
+
+    def unpin(self, meta: ObjectMeta) -> None:
+        with self._lock:
+            if meta.segment and meta.segment in self._pinned:
+                self._pinned[meta.segment] -= 1
+                if self._pinned[meta.segment] <= 0:
+                    del self._pinned[meta.segment]
+
+    def release(self, meta: ObjectMeta) -> None:
+        """Drop this process's mapping of a segment without unlinking it
+        (freeing/unlinking is the owner node's job)."""
+        if meta.kind != "shm" or not meta.segment:
+            return
+        with self._lock:
+            shm = self._segments.pop(meta.segment, None)
+            self._meta_by_segment.pop(meta.segment, None)
+        if shm is not None:
+            try:
+                shm.close()
+            except BufferError:
+                pass  # live memoryviews still reference it; mapping stays
+
+    def free(self, meta: ObjectMeta) -> None:
+        if meta.kind == "shm" and meta.segment:
+            with self._lock:
+                shm = self._segments.pop(meta.segment, None)
+                self._meta_by_segment.pop(meta.segment, None)
+                if shm is not None:
+                    self.used -= meta.size
+            if shm is None:
+                try:
+                    shm = shared_memory.SharedMemory(name=meta.segment)
+                except FileNotFoundError:
+                    return
+                _unregister_tracker(shm)
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        elif meta.kind == "spilled" and meta.spill_path:
+            try:
+                os.remove(meta.spill_path)
+            except OSError:
+                pass
+
+    # -- spilling ----------------------------------------------------------
+    def _ensure_capacity(self, incoming: int) -> None:
+        """Spill LRU unpinned segments until `incoming` fits. Lock held."""
+        if self.used + incoming <= self.capacity:
+            return
+        os.makedirs(self.spill_dir, exist_ok=True)
+        for name in list(self._segments):
+            if self.used + incoming <= self.capacity:
+                break
+            if name in self._pinned:
+                continue
+            shm = self._segments.pop(name)
+            meta = self._meta_by_segment.pop(name, None)
+            path = os.path.join(self.spill_dir, name)
+            with open(path, "wb") as f:
+                f.write(shm.buf)
+            self.used -= (meta.size if meta else shm.size)
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+            if meta is not None:
+                # readers that already attached keep a valid mapping; new
+                # readers see the updated meta and read the spill file
+                meta.kind = "spilled"
+                meta.spill_path = path
+                meta.segment = None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for shm in self._segments.values():
+                shm.close()
+                try:
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
+            self._segments.clear()
+            self.used = 0

@@ -1,0 +1,159 @@
+"""Worker process entry: executes tasks and hosts actors.
+
+Counterpart of the reference's default_worker.py + task-execution path
+(`python/ray/_private/workers/default_worker.py`, `_raylet.pyx:2141
+execute_task_with_cancellation_handler`): receives pushed task specs from the
+head, runs user code on executor threads, stores results, serves direct
+actor calls on its own port.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+
+from ray_tpu.core import serialization
+from ray_tpu.core.client import CoreClient
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.serialization import SerializedObject
+
+
+class WorkerRuntime:
+    def __init__(self, head_host: str, head_port: int, session: str):
+        self.client = CoreClient(head_host, head_port, session, is_driver=False,
+                                 handlers={
+                                     "exec_task": self._on_exec_task,
+                                     "start_actor": self._on_start_actor,
+                                 })
+        self.task_executor = ThreadPoolExecutor(max_workers=1,
+                                                thread_name_prefix="task")
+        self.actor_executor = None
+        self.actor_instance = None
+        self.actor_id = None
+        self.shutdown_event = threading.Event()
+
+    # ------------------------------------------------------------ plumbing
+    def start(self):
+        self.client.start(direct_handlers={"actor_call": self._on_actor_call})
+        self.client.on_disconnect = lambda: self.shutdown_event.set()
+        import ray_tpu.core.api as api
+
+        api._attach_existing_client(self.client)
+
+    def _resolve_args(self, payload) -> tuple:
+        if "inline" in payload:
+            ser = SerializedObject.from_view(memoryview(payload["inline"]))
+        else:
+            meta = payload["meta"]
+            self.client.local_metas[meta.object_id] = meta
+            ser = self.client.store.get_serialized(meta)
+        args, kwargs = serialization.deserialize(ser)
+        args = [self.client.get([a])[0] if isinstance(a, ObjectRef) else a
+                for a in args]
+        kwargs = {k: (self.client.get([v])[0] if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    # -------------------------------------------------------------- tasks
+    async def _on_exec_task(self, spec):
+        loop = asyncio.get_running_loop()
+        loop.run_in_executor(self.task_executor, self._run_task, spec)
+        return True
+
+    def _run_task(self, spec):
+        return_ids = [ObjectID(b) for b in spec["return_ids"]]
+        try:
+            fn = self.client.fn_manager.load(spec["fn_key"])
+            args, kwargs = self._resolve_args(spec["args"])
+            result = fn(*args, **kwargs)
+            results = [result] if len(return_ids) == 1 else list(result)
+            if len(results) != len(return_ids):
+                raise ValueError(
+                    f"task returned {len(results)} values, expected {len(return_ids)}")
+            for rid, val in zip(return_ids, results):
+                self.client.store_result(rid, val, register=True)
+        except BaseException as e:  # noqa: BLE001 - all failures become error objects
+            err = e if isinstance(e, TaskError) else TaskError(
+                repr(e), traceback.format_exc())
+            for rid in return_ids:
+                try:
+                    self.client.store_result(rid, err, register=True, is_error=True)
+                except Exception:
+                    pass
+        finally:
+            try:
+                self.client.head_request("task_done", task_id=spec["task_id"].binary())
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------- actors
+    async def _on_start_actor(self, spec):
+        loop = asyncio.get_running_loop()
+        max_conc = spec["options"].get("max_concurrency", 1)
+        self.actor_executor = ThreadPoolExecutor(max_workers=max_conc,
+                                                 thread_name_prefix="actor")
+        self.actor_id = ActorID(spec["actor_id"])
+
+        def _init():
+            cls = self.client.fn_manager.load(spec["cls_key"])
+            args, kwargs = self._resolve_args(spec["args"])
+            self.actor_instance = cls(*args, **kwargs)
+
+        try:
+            await loop.run_in_executor(self.actor_executor, _init)
+            await self.client.conn.request(
+                "actor_ready", actor_id=spec["actor_id"],
+                address=("127.0.0.1", self.client.direct_port))
+        except Exception:
+            try:
+                await self.client.conn.request(
+                    "actor_creation_failed", actor_id=spec["actor_id"],
+                    cause=traceback.format_exc())
+            except Exception:
+                pass
+        return True
+
+    async def _on_actor_call(self, actor_id, method, args, deps, return_id):
+        loop = asyncio.get_running_loop()
+
+        def _run():
+            rid = ObjectID(return_id)
+            try:
+                fn = getattr(self.actor_instance, method)
+                a, kw = self._resolve_args(args)
+                result = fn(*a, **kw)
+                return self.client.store_result(rid, result, register=False)
+            except BaseException as e:  # noqa: BLE001
+                err = e if isinstance(e, TaskError) else TaskError(
+                    repr(e), traceback.format_exc())
+                return self.client.store_result(rid, err, register=False,
+                                                is_error=True)
+
+        meta = await loop.run_in_executor(self.actor_executor, _run)
+        return {"meta": meta}
+
+    # ---------------------------------------------------------------- run
+    def run_forever(self):
+        self.shutdown_event.wait()
+        self.client.shutdown()
+
+
+def main():
+    head_port = int(os.environ["RAY_TPU_HEAD_PORT"])
+    session = os.environ["RAY_TPU_SESSION"]
+    rt = WorkerRuntime("127.0.0.1", head_port, session)
+    try:
+        rt.start()
+    except (ConnectionRefusedError, OSError, TimeoutError):
+        sys.exit(0)  # head already gone: racing a cluster shutdown
+    rt.run_forever()
+
+
+if __name__ == "__main__":
+    main()

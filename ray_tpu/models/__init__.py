@@ -1,0 +1,3 @@
+from ray_tpu.models import gpt2
+
+__all__ = ["gpt2"]

@@ -1,0 +1,249 @@
+"""GPT-2 family in pure JAX, designed TPU-first.
+
+Capability target: the reference's north-star config "Ray Train GPT-2-125M
+data-parallel" (/root/repo/BASELINE.json) — but built the XLA way rather than
+as a torch port:
+
+- layers are *stacked* (leading `n_layer` dim on every block param) and the
+  forward pass is a single `lax.scan` over them: one compiled block, O(1)
+  compile time in depth, and XLA can pipeline HBM prefetch of layer weights;
+- compute in bfloat16 (MXU-native), params + softmax/loss in float32;
+- every activation is annotated with logical axes (`batch`/`seq`/`embed`/...)
+  so the same code runs dp/fsdp/tp/sp sharded under any mesh from
+  `ray_tpu.parallel.mesh.build_mesh` — XLA inserts the ICI collectives;
+- `jax.checkpoint` (remat) around each block trades FLOPs for HBM.
+
+No dropout in round 1 (the reference benchmark config trains without it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.mesh import constrain, logical_to_spec
+
+Params = Any  # nested dict pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304          # GPT-2's 50257 padded up to a 128 multiple (MXU tiling)
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16        # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @classmethod
+    def preset(cls, name: str, **overrides) -> "GPT2Config":
+        presets = {
+            "gpt2-125m": dict(n_layer=12, n_head=12, d_model=768, d_ff=3072),
+            "gpt2-350m": dict(n_layer=24, n_head=16, d_model=1024, d_ff=4096),
+            "gpt2-774m": dict(n_layer=36, n_head=20, d_model=1280, d_ff=5120),
+            "gpt2-1.5b": dict(n_layer=48, n_head=25, d_model=1600, d_ff=6400),
+            "gpt2-tiny": dict(n_layer=2, n_head=4, d_model=128, d_ff=512,
+                              vocab_size=512, max_seq_len=128),
+        }
+        return cls(**{**presets[name], **overrides})
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: GPT2Config) -> Params:
+    """GPT-2 init: N(0, 0.02), residual projections scaled by 1/sqrt(2*n_layer)."""
+    k_wte, k_wpe, k_blocks = jax.random.split(key, 3)
+    std = 0.02
+    resid_std = std / math.sqrt(2 * cfg.n_layer)
+    pd = cfg.param_dtype
+
+    def norm(k, shape, s):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(pd)
+
+    def init_block(k):
+        ks = jax.random.split(k, 4)
+        return {
+            "ln1": {"scale": jnp.ones((cfg.d_model,), pd),
+                    "bias": jnp.zeros((cfg.d_model,), pd)},
+            "attn": {
+                "wqkv": norm(ks[0], (cfg.d_model, 3 * cfg.d_model), std),
+                "bqkv": jnp.zeros((3 * cfg.d_model,), pd),
+                "wo": norm(ks[1], (cfg.d_model, cfg.d_model), resid_std),
+                "bo": jnp.zeros((cfg.d_model,), pd),
+            },
+            "ln2": {"scale": jnp.ones((cfg.d_model,), pd),
+                    "bias": jnp.zeros((cfg.d_model,), pd)},
+            "mlp": {
+                "wi": norm(ks[2], (cfg.d_model, cfg.d_ff), std),
+                "bi": jnp.zeros((cfg.d_ff,), pd),
+                "wo": norm(ks[3], (cfg.d_ff, cfg.d_model), resid_std),
+                "bo": jnp.zeros((cfg.d_model,), pd),
+            },
+        }
+
+    blocks = jax.vmap(init_block)(jax.random.split(k_blocks, cfg.n_layer))
+    return {
+        "wte": norm(k_wte, (cfg.vocab_size, cfg.d_model), std),
+        "wpe": norm(k_wpe, (cfg.max_seq_len, cfg.d_model), std / 2),
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((cfg.d_model,), pd),
+                 "bias": jnp.zeros((cfg.d_model,), pd)},
+    }
+
+
+def param_logical_axes(cfg: GPT2Config) -> Params:
+    """Logical axis names per param leaf (same tree structure as init_params).
+
+    Resolve to PartitionSpecs with `param_specs`. Conventions: `embed` is the
+    ZeRO/fsdp-sharded hidden axis, `mlp`/`heads`-shaped output dims shard over
+    tp, `vocab` over tp (tied embedding => logits matmul is tp-sharded).
+    """
+    del cfg
+    block = {
+        "ln1": {"scale": ("embed",), "bias": ("embed",)},
+        "attn": {
+            "wqkv": ("embed", "heads"),   # 3*d_model output dim, megatron col-parallel
+            "bqkv": ("heads",),
+            "wo": ("heads", "embed"),     # row-parallel back to hidden
+            "bo": ("embed",),
+        },
+        "ln2": {"scale": ("embed",), "bias": ("embed",)},
+        "mlp": {
+            "wi": ("embed", "mlp"),
+            "bi": ("mlp",),
+            "wo": ("mlp", "embed"),
+            "bo": ("embed",),
+        },
+    }
+    # stacked layer dim is logical axis "layers" (unsharded by default)
+    block = jax.tree.map(lambda axes: ("layers",) + axes, block,
+                         is_leaf=lambda x: isinstance(x, tuple))
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": block,
+        "ln_f": {"scale": ("embed",), "bias": ("embed",)},
+    }
+
+
+def param_specs(cfg: GPT2Config, rules=None) -> Params:
+    """PartitionSpec pytree for the params under the active (or given) rules."""
+    return jax.tree.map(
+        lambda axes: logical_to_spec(*axes, rules=rules),
+        param_logical_axes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, p, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(x, p, cfg: GPT2Config):
+    B, T, D = x.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    qkv = x @ p["wqkv"].astype(cfg.dtype) + p["bqkv"].astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+    q = constrain(q, "batch", "heads", "seq", None)
+    k = constrain(k, "batch", "heads", "seq", None)
+    v = constrain(v, "batch", "heads", "seq", None)
+
+    # fp32 softmax for stability; scores computed on MXU in bf16 inputs.
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(Dh)
+    causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+    scores = jnp.where(causal[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
+    out = out @ p["wo"].astype(cfg.dtype) + p["bo"].astype(cfg.dtype)
+    return out
+
+
+def _mlp(x, p, cfg: GPT2Config):
+    h = x @ p["wi"].astype(cfg.dtype) + p["bi"].astype(cfg.dtype)
+    h = constrain(h, "batch", "seq", "mlp")
+    h = jax.nn.gelu(h, approximate=True)
+    return h @ p["wo"].astype(cfg.dtype) + p["bo"].astype(cfg.dtype)
+
+
+def _block(x, bp, cfg: GPT2Config):
+    x = x + _attention(_layer_norm(x, bp["ln1"]), bp["attn"], cfg)
+    x = constrain(x, "batch", "seq", "embed")
+    x = x + _mlp(_layer_norm(x, bp["ln2"]), bp["mlp"], cfg)
+    x = constrain(x, "batch", "seq", "embed")
+    return x
+
+
+def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] (compute dtype)."""
+    B, T = tokens.shape
+    wte = params["wte"]
+    x = wte[tokens] + params["wpe"][:T][None]
+    x = x.astype(cfg.dtype)
+    x = constrain(x, "batch", "seq", "embed")
+
+    block_fn = partial(_block, cfg=cfg)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def scan_body(carry, bp):
+        return block_fn(carry, bp), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+    x = _layer_norm(x, params["ln_f"])
+    logits = x @ wte.T.astype(cfg.dtype)  # tied embeddings
+    logits = constrain(logits, "batch", "seq", "vocab")
+    return logits
+
+
+def loss_fn(params: Params, batch: dict, cfg: GPT2Config) -> jax.Array:
+    """Next-token cross-entropy. batch = {"tokens": [B,T+1] int32} or
+    {"inputs": [B,T], "targets": [B,T]}."""
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = forward(params, inputs, cfg).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def num_params(cfg: GPT2Config) -> int:
+    d, f, L, V, S = cfg.d_model, cfg.d_ff, cfg.n_layer, cfg.vocab_size, cfg.max_seq_len
+    per_block = (3 * d * d + 3 * d) + (d * d + d) + (2 * d * f + f + d) + 4 * d
+    return V * d + S * d + L * per_block + 2 * d
+
+
+def flops_per_token(cfg: GPT2Config, seq_len: int) -> float:
+    """Approx training FLOPs/token (fwd+bwd ≈ 6*N + attention term)."""
+    n = num_params(cfg) - cfg.vocab_size * cfg.d_model  # non-embedding
+    attn = 12 * cfg.n_layer * cfg.d_model * seq_len
+    return 6 * (n + cfg.vocab_size * cfg.d_model) + attn

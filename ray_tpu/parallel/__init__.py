@@ -1,0 +1,15 @@
+from ray_tpu.parallel.mesh import (
+    MESH_AXES,
+    MeshConfig,
+    build_mesh,
+    constrain,
+    current_mesh,
+    logical_to_spec,
+    named_sharding,
+    use_mesh,
+)
+
+__all__ = [
+    "MESH_AXES", "MeshConfig", "build_mesh", "constrain", "current_mesh",
+    "logical_to_spec", "named_sharding", "use_mesh",
+]

@@ -1,0 +1,194 @@
+"""Device mesh construction and logical-axis sharding rules.
+
+This is the TPU-native replacement for the reference's process-group plumbing
+(`python/ray/util/collective/collective.py`, `python/ray/train/v2/jax/config.py`):
+instead of wiring NCCL communicators between actors, we build a single
+`jax.sharding.Mesh` over all chips and express every parallelism strategy
+(dp/fsdp/sp/tp/ep/pp) as named mesh axes. XLA inserts the ICI/DCN collectives.
+
+Axis order is slowest-varying first so that DCN-crossing axes (dp, pp) get the
+outermost mesh dimensions and ICI-local axes (tp) the innermost, matching the
+physical topology (tp traffic must ride ICI; dp allreduces tolerate DCN).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import threading
+from typing import Any, Mapping, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+# Canonical mesh axis names, outermost (DCN-tolerant) to innermost (ICI-only).
+MESH_AXES = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Degree of each parallelism axis. Product must equal the device count.
+
+    Any axis left at -1 is inferred to absorb the remaining devices (at most
+    one axis may be -1).
+    """
+
+    pp: int = 1    # pipeline stages
+    dp: int = 1    # pure data parallel (gradients allreduced)
+    fsdp: int = 1  # data parallel with parameters sharded (ZeRO-3 style)
+    sp: int = 1    # sequence/context parallel (ring attention axis)
+    ep: int = 1    # expert parallel (MoE)
+    tp: int = 1    # tensor (megatron) parallel
+
+    def degrees(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in MESH_AXES}
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        d = self.degrees()
+        unknown = [a for a, v in d.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one axis may be -1, got {unknown}")
+        known = math.prod(v for v in d.values() if v != -1)
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}")
+            d[unknown[0]] = n_devices // known
+        if math.prod(d.values()) != n_devices:
+            raise ValueError(
+                f"mesh {d} has {math.prod(d.values())} slots but {n_devices} devices")
+        return MeshConfig(**d)
+
+
+def build_mesh(
+    config: Union[MeshConfig, Mapping[str, int], None] = None,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Build a Mesh with the canonical axis names.
+
+    `devices` defaults to all local jax devices. The device array is reshaped
+    in canonical axis order; on real slices callers should pass devices from
+    `jax.experimental.mesh_utils.create_device_mesh` for ICI-optimal layout
+    (we do that automatically when the topology is a known slice shape).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if config is None:
+        config = MeshConfig(dp=len(devices))
+    if isinstance(config, Mapping):
+        config = MeshConfig(**dict(config))
+    config = config.resolved(len(devices))
+    shape = tuple(config.degrees()[a] for a in MESH_AXES)
+    try:
+        # ICI-aware layout when available (real TPU slices).
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+# ---------------------------------------------------------------------------
+# Logical axis rules (flax-style) and a current-mesh context so model code can
+# write `constrain(x, "batch", "seq", "embed")` without threading a mesh.
+# ---------------------------------------------------------------------------
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None for replicated)
+LogicalRules = Mapping[str, Union[str, tuple, None]]
+
+DEFAULT_RULES: dict[str, Union[str, tuple, None]] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",            # activation sequence axis (context parallelism)
+    "embed": "fsdp",        # parameter hidden axis: ZeRO-3 shard over fsdp
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": None,
+    "vocab": "tp",
+    "expert": "ep",
+    "stage": "pp",
+    "layers": None,
+}
+
+
+class _MeshContext(threading.local):
+    def __init__(self):
+        self.mesh: Optional[Mesh] = None
+        self.rules: dict[str, Any] = dict(DEFAULT_RULES)
+
+
+_ctx = _MeshContext()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh, rules: Optional[LogicalRules] = None):
+    """Install `mesh` (and optionally override logical rules) for this thread."""
+    prev_mesh, prev_rules = _ctx.mesh, _ctx.rules
+    _ctx.mesh = mesh
+    if rules is not None:
+        _ctx.rules = {**DEFAULT_RULES, **rules}
+    try:
+        yield mesh
+    finally:
+        _ctx.mesh, _ctx.rules = prev_mesh, prev_rules
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _ctx.mesh
+
+
+def logical_to_spec(*logical_axes: Optional[str], rules: Optional[LogicalRules] = None) -> PartitionSpec:
+    """Map logical axis names to a PartitionSpec via the active rules.
+
+    Mesh axes consumed by an earlier logical axis are dropped (a mesh axis may
+    only appear once in a PartitionSpec).
+    """
+    rules = dict(rules) if rules is not None else _ctx.rules
+    used: set = set()
+    parts = []
+    for name in logical_axes:
+        if name is None:
+            parts.append(None)
+            continue
+        mesh_axes = rules.get(name)
+        if mesh_axes is None:
+            parts.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        keep = tuple(a for a in mesh_axes if a not in used)
+        used.update(keep)
+        if not keep:
+            parts.append(None)
+        elif len(keep) == 1:
+            parts.append(keep[0])
+        else:
+            parts.append(keep)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return PartitionSpec(*parts)
+
+
+def named_sharding(*logical_axes: Optional[str], mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise RuntimeError("no active mesh: wrap in `use_mesh(mesh)` or pass mesh=")
+    return NamedSharding(mesh, logical_to_spec(*logical_axes))
+
+
+def constrain(x, *logical_axes: Optional[str]):
+    """`with_sharding_constraint` by logical axis names; no-op without a mesh."""
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = logical_to_spec(*logical_axes)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def axis_size(mesh: Mesh, *axes: str) -> int:
+    return math.prod(mesh.shape[a] for a in axes)

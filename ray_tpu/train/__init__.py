@@ -1,0 +1,12 @@
+from ray_tpu.train.spmd import (
+    CompiledTrain,
+    TrainState,
+    compile_gpt2_train,
+    compile_train,
+    default_optimizer,
+)
+
+__all__ = [
+    "CompiledTrain", "TrainState", "compile_gpt2_train", "compile_train",
+    "default_optimizer",
+]

@@ -1,0 +1,9 @@
+"""Parity namespace for the reference's `ray.util` surface."""
+
+from ray_tpu.core.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+
+__all__ = ["PlacementGroup", "placement_group", "remove_placement_group"]

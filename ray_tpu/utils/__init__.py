@@ -1,0 +1,3 @@
+from ray_tpu.utils.platform import ensure_virtual_cpu
+
+__all__ = ["ensure_virtual_cpu"]

@@ -1,0 +1,38 @@
+"""Platform helpers: force a virtual multi-device CPU backend for tests/dryruns.
+
+The reference tests distributed logic on one machine with fake resources
+(SURVEY.md §4.2); our analog is an N-device virtual CPU mesh. Environments may
+pre-register/initialize a TPU PJRT plugin before our code runs, so env vars
+alone are not enough — we reset jax's backend state when needed.
+"""
+
+from __future__ import annotations
+
+
+def ensure_virtual_cpu(n_devices: int) -> None:
+    """Make `jax.devices()` return >= n_devices CPU devices, resetting the
+    already-initialized backend if necessary. Call before creating any arrays
+    (live buffers on a cleared backend become invalid)."""
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+    except ImportError:  # pragma: no cover - jax internals moved
+        xla_bridge = None
+
+    if xla_bridge is not None and xla_bridge.backends_are_initialized():
+        if jax.devices()[0].platform == "cpu" and len(jax.devices()) >= n_devices:
+            return
+        xla_bridge._clear_backends()
+        xla_bridge.get_backend.cache_clear()
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", max(n_devices, 1))
+    except RuntimeError:
+        pass  # backend got initialized under us; XLA_FLAGS may still apply
+    got = len(jax.devices())
+    if got < n_devices:
+        raise RuntimeError(
+            f"could not create {n_devices} virtual CPU devices (got {got}); "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=N before jax init")

@@ -1,0 +1,34 @@
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.mesh import (
+    MeshConfig, build_mesh, constrain, logical_to_spec, use_mesh)
+
+
+def test_mesh_config_resolve():
+    assert MeshConfig(dp=-1, tp=2).resolved(8).dp == 4
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3).resolved(8)
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, tp=-1).resolved(8)
+
+
+def test_build_mesh_axes(devices8):
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2), devices=devices8)
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+    assert mesh.shape["pp"] == 1
+
+
+def test_logical_to_spec_rules(devices8):
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2), devices=devices8)
+    with use_mesh(mesh):
+        assert logical_to_spec("batch", "seq", "embed") == P(("dp", "fsdp"), "sp")
+        # mesh axis used once: batch consumes dp+fsdp, embed(fsdp) must drop it
+        assert logical_to_spec("batch", "embed") == P(("dp", "fsdp"))
+        assert logical_to_spec("embed", "mlp") == P("fsdp", "tp")
+
+
+def test_constrain_noop_without_mesh():
+    x = jax.numpy.ones((4, 4))
+    assert constrain(x, "batch", "embed") is x
