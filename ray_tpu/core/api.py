@@ -188,6 +188,10 @@ class RemoteFunction:
         task_opts = {"resources": _build_resources(opts),
                      "max_retries": opts.get("max_retries", 3),
                      "placement_group": pg.id.binary() if pg is not None else None,
+                     "placement_group_bundle_index": opts.get(
+                         "placement_group_bundle_index"),
+                     "label_selector": opts.get("label_selector"),
+                     "scheduling_strategy": opts.get("scheduling_strategy", "hybrid"),
                      "name": opts.get("name") or getattr(self._fn, "__name__", "task")}
         refs = _global_client().submit_task(
             fn_key, args, kwargs, task_opts,
@@ -264,6 +268,10 @@ class ActorClass:
         pg = opts.get("placement_group")
         actor_opts = {"resources": _build_resources({**opts, "num_cpus": opts.get("num_cpus", 0.0)}),
                       "placement_group": pg.id.binary() if pg is not None else None,
+                      "placement_group_bundle_index": opts.get(
+                          "placement_group_bundle_index"),
+                      "label_selector": opts.get("label_selector"),
+                      "scheduling_strategy": opts.get("scheduling_strategy", "hybrid"),
                       "max_restarts": opts.get("max_restarts", 0),
                       "max_concurrency": opts.get("max_concurrency", 1),
                       "name": opts.get("name"),

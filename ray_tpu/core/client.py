@@ -50,6 +50,11 @@ class CoreClient:
         self.direct_server: Optional[protocol.Server] = None
         self.direct_port: Optional[int] = None
         self.node_info: dict = {}
+        self.current_actor_id: Optional[ActorID] = None  # set when hosting an actor
+        # in-flight actor calls: return ObjectID -> concurrent Future of reply
+        self._pending_calls: Dict[ObjectID, Any] = {}
+        self._pending_lock = threading.Lock()
+        self._actor_order_locks: Dict[ActorID, asyncio.Lock] = {}
         self._started = threading.Event()
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
@@ -74,9 +79,11 @@ class CoreClient:
                                            handlers=self._extra_handlers,
                                            name="head")
         self.conn.on_close = lambda c: self._handle_head_loss()
+        node_id_hex = os.environ.get("RAY_TPU_NODE_ID")
         self.node_info = await self.conn.request(
             "register_worker", worker_id=self.worker_id.binary(), pid=os.getpid(),
-            port=self.direct_port, is_driver=self.is_driver)
+            port=self.direct_port, is_driver=self.is_driver,
+            node_id=bytes.fromhex(node_id_hex) if node_id_hex else None)
 
     def _handle_head_loss(self):
         if self.on_disconnect:
@@ -142,6 +149,10 @@ class CoreClient:
         self._call(self.conn.request("put_meta", meta=meta))
 
     def ensure_registered(self, ref: ObjectRef) -> None:
+        if ref.id not in self.local_metas:
+            # passing an in-flight actor-call result onward: join it first so
+            # the head learns the object before anyone depends on it
+            self._resolve_pending_call(ref.id)
         meta = self.local_metas.get(ref.id)
         if meta is not None and ref.id not in self._registered:
             self._registered.add(ref.id)
@@ -178,8 +189,11 @@ class CoreClient:
                 meta = self.local_metas.get(ref.id)
                 if meta is None:
                     remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-                    meta = self._call(self.conn.request(
-                        "get_meta", object_id=ref.id.binary(), timeout=remaining))
+                    if self._resolve_pending_call(ref.id, timeout=remaining):
+                        meta = self.local_metas[ref.id]
+                    else:
+                        meta = self._call(self.conn.request(
+                            "get_meta", object_id=ref.id.binary(), timeout=remaining))
                     if meta is None:
                         raise GetTimeoutError(f"get timed out on {ref}")
                     self.local_metas[ref.id] = meta
@@ -196,9 +210,17 @@ class CoreClient:
         for ref in refs:
             meta = self.local_metas.get(ref.id)
             if meta is None:
-                meta = await self.conn.request("get_meta", object_id=ref.id.binary(),
-                                               timeout=None)
-                self.local_metas[ref.id] = meta
+                with self._pending_lock:
+                    cfut = self._pending_calls.get(ref.id)
+                if cfut is not None:
+                    meta = (await asyncio.wrap_future(cfut))["meta"]
+                    self.local_metas[ref.id] = meta
+                    with self._pending_lock:
+                        self._pending_calls.pop(ref.id, None)
+                else:
+                    meta = await self.conn.request(
+                        "get_meta", object_id=ref.id.binary(), timeout=None)
+                    self.local_metas[ref.id] = meta
             value = self._read_value(meta)
             if meta.error or isinstance(value, RayTpuError):
                 raise value
@@ -208,20 +230,52 @@ class CoreClient:
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         num_returns = min(num_returns, len(refs))
-        ready_set = {r for r in refs if r.id in self.local_metas}
-        pending = [r for r in refs if r.id not in self.local_metas]
-        if len(ready_set) < num_returns and pending:
-            idx = self._call(self.conn.request(
-                "wait_objects",
-                object_ids=[r.id.binary() for r in pending],
-                num_returns=num_returns - len(ready_set), timeout=timeout))
-            ready_set.update(pending[i] for i in idx)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready_set: set = set()
+
+        def check_local(r: ObjectRef) -> bool:
+            if r.id in self.local_metas:
+                return True
+            with self._pending_lock:
+                cfut = self._pending_calls.get(r.id)
+            # a finished-but-failed actor call counts as ready: get() surfaces it
+            return cfut is not None and cfut.done()
+
+        while True:
+            ready_set.update(r for r in refs if check_local(r))
+            if len(ready_set) >= num_returns:
+                break
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break
+            head_refs = [r for r in refs if r not in ready_set
+                         and not self._is_pending_call(r.id)]
+            has_pending = any(self._is_pending_call(r.id) for r in refs)
+            if head_refs:
+                # poll in short steps while actor calls are in flight so both
+                # sources of readiness are observed
+                step = min(x for x in (0.05 if has_pending else None, remaining)
+                           if x is not None) if (has_pending or remaining is not None) else None
+                idx = self._call(self.conn.request(
+                    "wait_objects",
+                    object_ids=[r.id.binary() for r in head_refs],
+                    num_returns=num_returns - len(ready_set), timeout=step))
+                ready_set.update(head_refs[i] for i in idx)
+            else:
+                time.sleep(0.02)
         ready = [r for r in refs if r in ready_set][:num_returns]
         ready_final = set(ready)
         return ready, [r for r in refs if r not in ready_final]
 
+    def _is_pending_call(self, oid: ObjectID) -> bool:
+        with self._pending_lock:
+            cfut = self._pending_calls.get(oid)
+        return cfut is not None and not cfut.done()
+
     def free(self, refs: Sequence[ObjectRef]) -> None:
         for r in refs:
+            with self._pending_lock:
+                self._pending_calls.pop(r.id, None)
             meta = self.local_metas.pop(r.id, None)
             self._registered.discard(r.id)
             if meta is not None:
@@ -297,14 +351,20 @@ class CoreClient:
 
     async def _call_actor_async(self, actor_id: ActorID, method: str,
                                 payload, deps, return_id: bytes, retries: int = 30):
+        order_lock = self._actor_order_locks.setdefault(actor_id, asyncio.Lock())
         last_err = None
         for _ in range(retries):
             try:
-                conn = await self._actor_conn(actor_id)
-                reply = await conn.request(
-                    "actor_call", actor_id=actor_id.binary(), method=method,
-                    args=payload, deps=deps, return_id=return_id)
-                return reply
+                # hold the per-actor lock only across connect+send so calls
+                # from this process reach the actor in program order while
+                # replies stay pipelined (ActorTaskSubmitter seqno semantics,
+                # reference task_submission/actor_task_submitter.h:70)
+                async with order_lock:
+                    conn = await self._actor_conn(actor_id)
+                    fut = conn.request_future(
+                        "actor_call", actor_id=actor_id.binary(), method=method,
+                        args=payload, deps=deps, return_id=return_id)
+                return await fut
             except (protocol.ConnectionLost, ConnectionRefusedError, OSError) as e:
                 last_err = e
                 self._actor_addr_cache.pop(actor_id, None)
@@ -313,13 +373,45 @@ class CoreClient:
 
     def call_actor(self, actor_id: ActorID, method: str, args: tuple,
                    kwargs: dict) -> ObjectRef:
+        """Submit an actor call; returns immediately with the result ref.
+
+        The reply (result meta) resolves in the background; `get`/`wait` on
+        the ref join it via `_pending_calls`."""
         payload, deps = self.build_args_payload(args, kwargs)
         return_id = ObjectID.generate()
-        reply = self._call(self._call_actor_async(
-            actor_id, method, payload, deps, return_id.binary()))
-        meta = reply["meta"]
-        self.local_metas[meta.object_id] = meta
-        return ObjectRef(meta.object_id)
+        cfut = asyncio.run_coroutine_threadsafe(
+            self._call_actor_async(actor_id, method, payload, deps,
+                                   return_id.binary()), self.loop)
+        with self._pending_lock:
+            self._pending_calls[return_id] = cfut
+
+        def _on_done(f):
+            try:
+                meta = f.result()["meta"]
+            except BaseException:
+                return  # surfaced when the ref is consumed
+            self.local_metas[meta.object_id] = meta
+
+        cfut.add_done_callback(_on_done)
+        return ObjectRef(return_id)
+
+    def _resolve_pending_call(self, oid: ObjectID,
+                              timeout: Optional[float] = None) -> bool:
+        """Join an in-flight actor call for `oid`. True if it was pending."""
+        with self._pending_lock:
+            cfut = self._pending_calls.get(oid)
+        if cfut is None:
+            return False
+        try:
+            meta = cfut.result(timeout=timeout)["meta"]
+            self.local_metas[meta.object_id] = meta
+        except TimeoutError:
+            raise GetTimeoutError(f"actor call {oid} not finished in time")
+        finally:
+            if cfut.done():
+                with self._pending_lock:
+                    self._pending_calls.pop(oid, None)
+        return True
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._call(self.conn.request("kill_actor", actor_id=actor_id.binary(),

@@ -1,19 +1,21 @@
-"""Head process: cluster control plane + single-node scheduler + worker pool.
+"""Head process: cluster control plane + two-level scheduler + worker pools.
 
-Capability-equivalent of the reference's GCS (`src/ray/gcs/gcs_server/`) fused
-with the raylet's scheduling/worker-pool role (`src/ray/raylet/`) for the
-single-node case: node/actor/object/KV tables, pubsub, resource-based task
-scheduling with dependency-aware dispatch, worker lifecycle, actor restarts,
-placement groups. Multi-node support hangs off the same tables (a remote node
-daemon registers like a worker pool with its own resources).
+Capability-equivalent of the reference's GCS (`src/ray/gcs/gcs_server/`) plus
+the scheduling half of the raylet (`src/ray/raylet/scheduling/
+cluster_task_manager.cc:201`): node/actor/object/KV tables, pubsub,
+resource-based task scheduling with dependency-aware dispatch, label
+selectors, worker lifecycle, actor restarts, placement groups with
+PACK/SPREAD/STRICT_* bundle placement across nodes.
 
-Design differences from the reference (deliberate, TPU-first):
-- steady-state actor calls NEVER pass through here (direct worker<->worker
-  connections, like the reference's core-worker gRPC) — the head only does
-  placement, restarts, and failure pubsub;
-- the object store is per-object shm segments (store.py) with head-side
-  accounting; device arrays stay in per-actor device stores (collective layer)
-  and only metadata flows through the head.
+Topology: the head owns the tables and the placement decisions; every node
+(including the head's own) contributes a worker pool. Remote nodes run a thin
+node daemon (`node_main.py`) that only spawns/kills local workers on request —
+workers connect straight to the head, and steady-state actor traffic is
+direct worker<->worker (reference's core-worker gRPC model, SURVEY §3.3).
+
+Single-machine multi-node: exactly the reference's `cluster_utils.Cluster`
+strategy (SURVEY §4.2) — N node daemons as local processes with fake
+resource dicts exercise all distributed logic over real sockets.
 """
 
 from __future__ import annotations
@@ -30,19 +32,63 @@ from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
 from ray_tpu.core.store import ObjectMeta, SharedMemoryStore
 
 
+class NodeInfo:
+    def __init__(self, node_id: NodeID, resources: Dict[str, float],
+                 labels: Dict[str, str], conn: Optional[protocol.Connection],
+                 max_workers: int, is_head: bool = False):
+        self.node_id = node_id
+        self.resources = dict(resources)
+        self.available = dict(resources)
+        self.labels = dict(labels)
+        self.conn = conn              # None for the head-local node
+        self.max_workers = max_workers
+        self.is_head = is_head
+        self.alive = True
+        self.idle: List["WorkerInfo"] = []
+        self.workers: Set[WorkerID] = set()
+        self.starting_workers = 0
+
+    def fits(self, resources: Dict[str, float]) -> bool:
+        return all(self.available.get(r, 0) >= amt - 1e-9
+                   for r, amt in resources.items())
+
+    def could_ever_fit(self, resources: Dict[str, float]) -> bool:
+        return all(self.resources.get(r, 0) >= amt - 1e-9
+                   for r, amt in resources.items())
+
+    def matches_labels(self, selector: Optional[Dict[str, str]]) -> bool:
+        if not selector:
+            return True
+        for k, v in selector.items():
+            have = self.labels.get(k)
+            if isinstance(v, (list, tuple, set)):   # "in" semantics
+                if have not in v:
+                    return False
+            elif have != str(v):
+                return False
+        return True
+
+    def utilization(self) -> float:
+        fracs = [1 - self.available.get(r, 0) / t
+                 for r, t in self.resources.items() if t > 0]
+        return max(fracs) if fracs else 0.0
+
+
 class WorkerInfo:
     def __init__(self, worker_id: WorkerID, conn: protocol.Connection, pid: int,
-                 port: int, is_driver: bool):
+                 port: int, is_driver: bool, node_id: NodeID):
         self.worker_id = worker_id
         self.conn = conn
         self.pid = pid
         self.port = port  # direct-call server port
         self.is_driver = is_driver
+        self.node_id = node_id
         self.running_task: Optional[TaskID] = None
         self.actor_id: Optional[ActorID] = None
         self.blocked = False
         self.acquired: Dict[str, float] = {}
-        self.acquired_pg = None  # PlacementGroupID the resources came from
+        self.acquired_pg: Optional[PlacementGroupID] = None
+        self.acquired_bundle: Optional[int] = None
         self.proc: Optional[subprocess.Popen] = None
         self.current_record = None
 
@@ -68,17 +114,27 @@ class TaskRecord:
         self.pending_deps: Set[ObjectID] = set()
 
 
+class BundleState:
+    def __init__(self, index: int, resources: Dict[str, float]):
+        self.index = index
+        self.resources = dict(resources)
+        self.node_id: Optional[NodeID] = None
+        self.available: Dict[str, float] = {}
+
+    def fits(self, resources: Dict[str, float]) -> bool:
+        return all(self.available.get(r, 0) >= amt - 1e-9
+                   for r, amt in resources.items())
+
+
 class PlacementGroupInfo:
     def __init__(self, pg_id: PlacementGroupID, bundles: List[dict], strategy: str,
                  name: str = ""):
         self.pg_id = pg_id
-        self.bundles = bundles
+        self.bundles = [BundleState(i, b) for i, b in enumerate(bundles)]
         self.strategy = strategy
         self.name = name
         self.state = "PENDING"
         self.ready_event = asyncio.Event()
-        self.capacity: Dict[str, float] = {}   # total reservation (set on CREATED)
-        self.available: Dict[str, float] = {}  # unclaimed portion of it
 
 
 class Head:
@@ -88,16 +144,17 @@ class Head:
                  labels: Optional[dict] = None):
         self.session = session
         self.node_id = NodeID.generate()
-        from ray_tpu.core.resources import node_resources
+        from ray_tpu.core.resources import node_labels, node_resources
 
-        self.total_resources = node_resources(num_cpus, num_tpu_chips, resources)
-        self.available = dict(self.total_resources)
-        self.labels = labels or {}
-        self.max_workers = max_workers or max(int(self.total_resources.get("CPU", 4)) * 2, 8)
+        head_resources = node_resources(num_cpus, num_tpu_chips, resources)
+        head_max = max_workers or max(int(head_resources.get("CPU", 4)) * 2, 8)
+        self.head_node = NodeInfo(self.node_id, head_resources,
+                                  {**node_labels(), **(labels or {})},
+                                  conn=None, max_workers=head_max, is_head=True)
+        self.nodes: Dict[NodeID, NodeInfo] = {self.node_id: self.head_node}
 
         self.store = SharedMemoryStore(session, capacity_bytes=object_store_bytes)
         self.workers: Dict[WorkerID, WorkerInfo] = {}
-        self.idle: List[WorkerInfo] = []
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.objects: Dict[ObjectID, ObjectMeta] = {}
@@ -109,7 +166,6 @@ class Head:
         self.subscribers: Dict[str, List[protocol.Connection]] = {}
         self.port: Optional[int] = None
         self._server: Optional[protocol.Server] = None
-        self._starting_workers = 0
         self._shutdown = False
         self.job_counter = 0
         self.start_time = time.time()
@@ -117,18 +173,31 @@ class Head:
 
     # ------------------------------------------------------------------ rpc
     def _handlers(self, conn_state: dict):
-        async def register_worker(worker_id, pid, port, is_driver):
-            w = WorkerInfo(WorkerID(worker_id), conn_state["conn"], pid, port, is_driver)
-            proc = self._spawned.pop(pid, None)
-            w.proc = proc
+        async def register_worker(worker_id, pid, port, is_driver, node_id=None):
+            nid = NodeID(node_id) if node_id else self.node_id
+            node = self.nodes.get(nid) or self.head_node
+            w = WorkerInfo(WorkerID(worker_id), conn_state["conn"], pid, port,
+                           is_driver, node.node_id)
+            w.proc = self._spawned.pop(pid, None)
             self.workers[w.worker_id] = w
             conn_state["worker"] = w
+            node.workers.add(w.worker_id)
             if not is_driver:
-                self.idle.append(w)
-                self._starting_workers = max(0, self._starting_workers - 1)
+                node.idle.append(w)
+                node.starting_workers = max(0, node.starting_workers - 1)
                 self._kick()
-            return {"node_id": self.node_id.binary(), "session": self.session,
-                    "resources": self.total_resources, "labels": self.labels}
+            return {"node_id": node.node_id.binary(), "session": self.session,
+                    "resources": node.resources, "labels": node.labels}
+
+        async def register_node(node_id, resources, labels, max_workers):
+            nid = NodeID(node_id)
+            node = NodeInfo(nid, resources, labels, conn_state["conn"],
+                            max_workers)
+            self.nodes[nid] = node
+            conn_state["node"] = node
+            self._publish("node_state", {"node_id": nid.binary(), "state": "ALIVE"})
+            self._kick()
+            return {"session": self.session, "head_node_id": self.node_id.binary()}
 
         async def submit_task(spec):
             w = conn_state["worker"]
@@ -153,6 +222,7 @@ class Head:
             if key is not None:
                 self.named_actors[key] = actor_id
             self._schedule_actor(info)
+            self._spawn_for_demand()
             return {"actor_id": actor_id.binary()}
 
         async def wait_actor(actor_id):
@@ -178,9 +248,8 @@ class Head:
             if actor_id is None or self.actors[actor_id].state == "DEAD":
                 return None
             info = self.actors[actor_id]
-            meta = {"actor_id": actor_id.binary(),
+            return {"actor_id": actor_id.binary(),
                     "methods": info.spec.get("methods", {})}
-            return meta
 
         async def kill_actor(actor_id, no_restart=True):
             info = self.actors.get(ActorID(actor_id))
@@ -281,15 +350,20 @@ class Head:
                     pass
             else:
                 await pg.ready_event.wait()
-            return {"state": pg.state}
+            return {"state": pg.state,
+                    "bundle_nodes": [b.node_id.binary() if b.node_id else None
+                                     for b in pg.bundles]}
 
         async def remove_pg(pg_id):
             pg = self.pgs.pop(PlacementGroupID(pg_id), None)
             if pg is not None and pg.state == "CREATED":
                 # return only the unclaimed portion; in-use resources flow back
                 # to the node ledger when their tasks release (pg is gone then)
-                for res, amt in pg.available.items():
-                    self.available[res] = self.available.get(res, 0) + amt
+                for b in pg.bundles:
+                    node = self.nodes.get(b.node_id)
+                    if node is not None:
+                        for res, amt in b.available.items():
+                            node.available[res] = node.available.get(res, 0) + amt
                 self._kick()
             return True
 
@@ -307,13 +381,23 @@ class Head:
             return True
 
         async def cluster_info():
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            for node in self.nodes.values():
+                if not node.alive:
+                    continue
+                for r, v in node.resources.items():
+                    total[r] = total.get(r, 0) + v
+                for r, v in node.available.items():
+                    avail[r] = avail.get(r, 0) + v
             return {
                 "node_id": self.node_id.binary(),
                 "session": self.session,
-                "total_resources": self.total_resources,
-                "available_resources": self.available,
-                "labels": self.labels,
+                "total_resources": total,
+                "available_resources": avail,
+                "labels": self.head_node.labels,
                 "num_workers": len(self.workers),
+                "num_nodes": len([n for n in self.nodes.values() if n.alive]),
                 "actors": {a.hex(): info.state for a, info in self.actors.items()},
                 "uptime": time.time() - self.start_time,
             }
@@ -347,8 +431,9 @@ class Head:
                     info.worker = None
                     w.actor_id = None
                     self._release(w)
-                    if w not in self.idle:
-                        self.idle.append(w)
+                    node = self.nodes.get(w.node_id)
+                    if node is not None and w not in node.idle:
+                        node.idle.append(w)
                     self._kick()
             return True
 
@@ -383,30 +468,74 @@ class Head:
             rec.pending_deps.discard(meta.object_id)
         self._kick()
 
-    def _fits(self, resources: Dict[str, float]) -> bool:
-        return all(self.available.get(r, 0) >= amt - 1e-9 for r, amt in resources.items())
+    def _alive_nodes(self) -> List[NodeInfo]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    def _select_node(self, resources: Dict[str, float],
+                     label_selector: Optional[dict] = None,
+                     strategy: str = "hybrid") -> Optional[NodeInfo]:
+        """Hybrid policy (reference scheduling_policy.h:35-57): prefer the
+        head/local node until utilization crosses a threshold, then pack the
+        lowest-utilization feasible node; SPREAD picks least-utilized."""
+        candidates = [n for n in self._alive_nodes()
+                      if n.matches_labels(label_selector) and n.fits(resources)]
+        if not candidates:
+            return None
+        if strategy == "spread":
+            return min(candidates, key=lambda n: n.utilization())
+        head_first = [n for n in candidates if n.is_head]
+        if head_first and head_first[0].utilization() < 0.8:
+            return head_first[0]
+        return min(candidates, key=lambda n: n.utilization())
 
     def _pg_for(self, options: dict) -> Optional[PlacementGroupInfo]:
         pgb = options.get("placement_group")
         return self.pgs.get(PlacementGroupID(pgb)) if pgb else None
 
-    @staticmethod
-    def _fits_pg(pg: PlacementGroupInfo, resources: Dict[str, float]) -> bool:
-        return pg.state == "CREATED" and all(
-            pg.available.get(r, 0) >= amt - 1e-9 for r, amt in resources.items())
+    def _find_pg_slot(self, pg: PlacementGroupInfo, resources: Dict[str, float],
+                      bundle_index: Optional[int]) -> Optional[BundleState]:
+        if pg.state != "CREATED":
+            return None
+        if bundle_index is not None and bundle_index >= 0:
+            b = pg.bundles[bundle_index]
+            return b if b.fits(resources) else None
+        for b in pg.bundles:
+            if b.fits(resources):
+                return b
+        return None
+
+    def _idle_worker_on(self, node: NodeInfo) -> Optional[WorkerInfo]:
+        while node.idle:
+            w = node.idle.pop()
+            if not w.conn.closed:
+                return w
+        return None
 
     def _acquire(self, w: WorkerInfo, resources: Dict[str, float],
-                 pg: Optional[PlacementGroupInfo] = None) -> None:
-        ledger = pg.available if pg is not None else self.available
+                 pg: Optional[PlacementGroupInfo] = None,
+                 bundle: Optional[BundleState] = None) -> None:
+        if bundle is not None:
+            ledger = bundle.available
+            w.acquired_pg = pg.pg_id
+            w.acquired_bundle = bundle.index
+        else:
+            ledger = self.nodes[w.node_id].available
+            w.acquired_pg = None
+            w.acquired_bundle = None
         for r, amt in resources.items():
             ledger[r] = ledger.get(r, 0) - amt
         w.acquired = dict(resources)
-        w.acquired_pg = pg.pg_id if pg is not None else None
 
     def _release(self, w: WorkerInfo, cpu_only: bool = False) -> None:
-        pg = self.pgs.get(w.acquired_pg) if getattr(w, "acquired_pg", None) else None
-        # if the pg was removed while the work ran, resources return to the node
-        ledger = pg.available if pg is not None else self.available
+        ledger = None
+        if w.acquired_pg is not None:
+            pg = self.pgs.get(w.acquired_pg)
+            if pg is not None and w.acquired_bundle is not None:
+                ledger = pg.bundles[w.acquired_bundle].available
+        if ledger is None:
+            # pg removed while the work ran (or non-pg): back to the node
+            node = self.nodes.get(w.node_id)
+            ledger = node.available if node is not None else {}
         for r, amt in list(w.acquired.items()):
             if cpu_only and r != "CPU":
                 continue
@@ -414,6 +543,44 @@ class Head:
             del w.acquired[r]
         if not w.acquired:
             w.acquired_pg = None
+            w.acquired_bundle = None
+
+    def _try_dispatch(self, rec: TaskRecord) -> Optional[str]:
+        """Try to place+dispatch one task. Returns None on success, else a
+        reason to stay queued ('resources' | 'worker') — or fails the task."""
+        options = rec.spec["options"]
+        resources = options.get("resources", {"CPU": 1})
+        if options.get("placement_group"):
+            pg = self._pg_for(options)
+            if pg is None:
+                self._fail_task(rec, "placement group was removed")
+                return None
+            bundle = self._find_pg_slot(pg, resources,
+                                        options.get("placement_group_bundle_index"))
+            if bundle is None:
+                return "resources"
+            node = self.nodes.get(bundle.node_id)
+            if node is None or not node.alive:
+                return "resources"
+            w = self._idle_worker_on(node)
+            if w is None:
+                self._request_worker(node)
+                return "worker"
+            self._acquire(w, resources, pg, bundle)
+        else:
+            node = self._select_node(resources, options.get("label_selector"),
+                                     options.get("scheduling_strategy", "hybrid"))
+            if node is None:
+                return "resources"
+            w = self._idle_worker_on(node)
+            if w is None:
+                self._request_worker(node)
+                return "worker"
+            self._acquire(w, resources)
+        w.running_task = rec.task_id
+        w.current_record = rec
+        w.conn.push("exec_task", spec=rec.spec)
+        return None
 
     def _kick(self) -> None:
         """Dispatch as many queued tasks as possible; spawn workers if useful."""
@@ -425,67 +592,73 @@ class Head:
             if rec.pending_deps:
                 still_queued.append(rec)
                 continue
-            resources = rec.spec["options"].get("resources", {"CPU": 1})
-            if rec.spec["options"].get("placement_group"):
-                pg = self._pg_for(rec.spec["options"])
-                if pg is None:
-                    self._fail_task(rec, "placement group was removed")
-                    continue
-                if not self._fits_pg(pg, resources) or not self.idle:
-                    still_queued.append(rec)
-                    continue
-            else:
-                pg = None
-                if not self._fits(resources) or not self.idle:
-                    still_queued.append(rec)
-                    continue
-            w = self.idle.pop()
-            self._acquire(w, resources, pg)
-            w.running_task = rec.task_id
-            w.current_record = rec
-            w.conn.push("exec_task", spec=rec.spec)
+            if self._try_dispatch(rec) is not None:
+                still_queued.append(rec)
         self.queue = still_queued
-        # Pending actors also need workers.
         for info in self.actors.values():
             if info.state in ("PENDING", "RESTARTING") and info.worker is None:
                 self._schedule_actor(info)
-        demand = len([r for r in self.queue if not r.pending_deps]) + len(
-            [a for a in self.actors.values()
-             if a.state in ("PENDING", "RESTARTING") and a.worker is None])
-        can_start = (self.max_workers - len([w for w in self.workers.values()
-                                             if not w.is_driver]) - self._starting_workers)
-        for _ in range(min(demand - len(self.idle) - self._starting_workers, can_start)):
-            self._spawn_worker()
+        self._spawn_for_demand()
 
     def _schedule_actor(self, info: ActorInfo) -> None:
-        resources = info.spec["options"].get("resources", {"CPU": 0})
-        pg = self._pg_for(info.spec["options"])
-        if info.spec["options"].get("placement_group") and pg is None:
-            self._mark_actor_dead(info, "placement group was removed")
-            return
-        fits = self._fits_pg(pg, resources) if pg else self._fits(resources)
-        if not self.idle or not fits:
-            self._maybe_spawn_for_demand()
-            return
-        w = self.idle.pop()
-        self._acquire(w, resources, pg)
+        options = info.spec["options"]
+        resources = options.get("resources", {"CPU": 0})
+        if options.get("placement_group"):
+            pg = self._pg_for(options)
+            if pg is None:
+                self._mark_actor_dead(info, "placement group was removed")
+                return
+            bundle = self._find_pg_slot(pg, resources,
+                                        options.get("placement_group_bundle_index"))
+            if bundle is None:
+                return
+            node = self.nodes.get(bundle.node_id)
+            if node is None or not node.alive:
+                return
+            w = self._idle_worker_on(node)
+            if w is None:
+                self._request_worker(node)
+                return
+            self._acquire(w, resources, pg, bundle)
+        else:
+            node = self._select_node(resources, options.get("label_selector"),
+                                     options.get("scheduling_strategy", "hybrid"))
+            if node is None:
+                return
+            w = self._idle_worker_on(node)
+            if w is None:
+                self._request_worker(node)
+                return
+            self._acquire(w, resources)
         w.actor_id = info.actor_id
         info.worker = w
         w.conn.push("start_actor", spec=info.spec)
 
-    def _maybe_spawn_for_demand(self) -> None:
-        alive = len([w for w in self.workers.values() if not w.is_driver])
-        if alive + self._starting_workers < self.max_workers:
-            self._spawn_worker()
-
     # -------------------------------------------------------------- workers
-    def _spawn_worker(self) -> None:
-        self._starting_workers += 1
+    def _request_worker(self, node: NodeInfo) -> None:
+        alive = len(node.workers)
+        if alive + node.starting_workers >= node.max_workers:
+            return
+        node.starting_workers += 1
+        if node.conn is None:
+            self._spawn_local_worker()
+        else:
+            node.conn.push("spawn_worker")
+
+    def _spawn_for_demand(self) -> None:
+        # each queued-but-dispatchable task/actor has already issued a
+        # _request_worker for its chosen node inside _try_dispatch; nothing
+        # further to do here beyond a safety valve for empty pools
+        if not self.queue:
+            return
+
+    def _spawn_local_worker(self) -> None:
         from ray_tpu.core.resources import strip_device_env
 
         env = strip_device_env(dict(os.environ))
         env["RAY_TPU_HEAD_PORT"] = str(self.port)
         env["RAY_TPU_SESSION"] = self.session
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env, stdout=None, stderr=None)
@@ -493,8 +666,11 @@ class Head:
 
     def _on_worker_disconnect(self, w: WorkerInfo) -> None:
         self.workers.pop(w.worker_id, None)
-        if w in self.idle:
-            self.idle.remove(w)
+        node = self.nodes.get(w.node_id)
+        if node is not None:
+            node.workers.discard(w.worker_id)
+            if w in node.idle:
+                node.idle.remove(w)
         self._release(w)
         rec = getattr(w, "current_record", None)
         if rec is not None and w.running_task is not None:
@@ -523,6 +699,34 @@ class Head:
             pass  # job cleanup: objects are session-scoped in round 1
         self._kick()
 
+    def _on_node_disconnect(self, node: NodeInfo) -> None:
+        """Node daemon lost: the reference's GcsHealthCheckManager dead-node
+        path (node table update + pubsub + per-worker failure handling)."""
+        node.alive = False
+        self.nodes.pop(node.node_id, None)
+        self._publish("node_state", {"node_id": node.node_id.binary(),
+                                     "state": "DEAD"})
+        # PG bundles on that node lose their reservation; re-reserve
+        for pg in self.pgs.values():
+            if any(b.node_id == node.node_id for b in pg.bundles):
+                pg.state = "PENDING"
+                pg.ready_event = asyncio.Event()
+                for b in pg.bundles:
+                    surviving = self.nodes.get(b.node_id)
+                    if surviving is not None and b.node_id != node.node_id:
+                        for r, amt in b.available.items():
+                            surviving.available[r] = surviving.available.get(r, 0) + amt
+                    b.node_id = None
+                    b.available = {}
+                self._try_reserve_pg(pg)
+        # workers on the node: their conns will close; handle proactively so
+        # retries don't wait on TCP timeouts
+        for wid in list(node.workers):
+            w = self.workers.get(wid)
+            if w is not None and not w.conn.closed:
+                asyncio.ensure_future(w.conn.close())
+        self._kick()
+
     def _mark_actor_dead(self, info: ActorInfo, cause: str) -> None:
         info.state = "DEAD"
         info.death_cause = cause
@@ -531,11 +735,18 @@ class Head:
                                       "state": "DEAD", "cause": cause})
 
     def _terminate_worker(self, w: WorkerInfo) -> None:
-        try:
-            if w.proc is not None:
+        if w.proc is not None:
+            try:
                 w.proc.kill()
-            else:
-                os.kill(w.pid, 9)
+                return
+            except ProcessLookupError:
+                return
+        node = self.nodes.get(w.node_id)
+        if node is not None and node.conn is not None and not node.conn.closed:
+            node.conn.push("kill_worker", pid=w.pid)
+            return
+        try:
+            os.kill(w.pid, 9)
         except ProcessLookupError:
             pass
 
@@ -554,35 +765,94 @@ class Head:
             if not conn.closed:
                 conn.push("pubsub", channel=channel, msg=msg)
 
+    # ------------------------------------------------------------------ pgs
     def _retry_pending_pgs(self) -> None:
         for pg in self.pgs.values():
             if pg.state == "PENDING":
                 self._try_reserve_pg(pg)
 
-    # ------------------------------------------------------------------ pgs
     def _try_reserve_pg(self, pg: PlacementGroupInfo) -> None:
-        need: Dict[str, float] = {}
-        for bundle in pg.bundles:
-            for r, amt in bundle.items():
-                need[r] = need.get(r, 0) + amt
-        if self._fits(need):
-            for r, amt in need.items():
-                self.available[r] -= amt
-            pg.capacity = dict(need)
-            pg.available = dict(need)
-            pg.state = "CREATED"
-            pg.ready_event.set()
-        # else stays PENDING; re-tried on resource release (single-node round 1)
+        """Strategy-aware bundle placement with all-or-nothing commit
+        (semantics of GcsPlacementGroupScheduler's 2-phase protocol collapsed
+        into the head's single ledger view)."""
+        nodes = self._alive_nodes()
+        if not nodes:
+            return
+        scratch = {n.node_id: dict(n.available) for n in nodes}
+        assignment: List[Optional[NodeID]] = []
+        strategy = pg.strategy
+        if strategy in ("PACK", "STRICT_PACK"):
+            # try single-node packing first (required for STRICT_PACK)
+            packed = None
+            for n in nodes:
+                trial = dict(scratch[n.node_id])
+                ok = True
+                for b in pg.bundles:
+                    if all(trial.get(r, 0) >= amt - 1e-9 for r, amt in b.resources.items()):
+                        for r, amt in b.resources.items():
+                            trial[r] = trial.get(r, 0) - amt
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    packed = n.node_id
+                    break
+            if packed is not None:
+                assignment = [packed] * len(pg.bundles)
+            elif strategy == "STRICT_PACK":
+                return  # stays PENDING
+            else:  # PACK falls back to best-effort spread
+                assignment = self._greedy_assign(pg, nodes, scratch, distinct=False)
+        elif strategy == "STRICT_SPREAD":
+            assignment = self._greedy_assign(pg, nodes, scratch, distinct=True)
+        else:  # SPREAD: best-effort distinct, fall back to reuse
+            assignment = (self._greedy_assign(pg, nodes, scratch, distinct=True)
+                          or self._greedy_assign(pg, nodes, scratch, distinct=False))
+        if not assignment or any(a is None for a in assignment):
+            return  # stays PENDING
+        # commit
+        for b, nid in zip(pg.bundles, assignment):
+            node = self.nodes[nid]
+            for r, amt in b.resources.items():
+                node.available[r] = node.available.get(r, 0) - amt
+            b.node_id = nid
+            b.available = dict(b.resources)
+        pg.state = "CREATED"
+        pg.ready_event.set()
+
+    def _greedy_assign(self, pg: PlacementGroupInfo, nodes: List[NodeInfo],
+                       scratch: dict, distinct: bool) -> Optional[List[NodeID]]:
+        avail = {nid: dict(v) for nid, v in scratch.items()}
+        used: Set[NodeID] = set()
+        out: List[Optional[NodeID]] = []
+        for b in pg.bundles:
+            placed = None
+            for n in sorted(nodes, key=lambda n: n.utilization()):
+                if distinct and n.node_id in used:
+                    continue
+                a = avail[n.node_id]
+                if all(a.get(r, 0) >= amt - 1e-9 for r, amt in b.resources.items()):
+                    for r, amt in b.resources.items():
+                        a[r] = a.get(r, 0) - amt
+                    placed = n.node_id
+                    used.add(n.node_id)
+                    break
+            if placed is None:
+                return None
+            out.append(placed)
+        return out
 
     # ---------------------------------------------------------------- state
     def _list_state(self, kind: str):
         if kind == "actors":
             return [{"actor_id": a.hex(), "state": i.state,
                      "name": i.spec["options"].get("name"),
+                     "node_id": (i.worker.node_id.hex() if i.worker else None),
                      "restarts_left": i.restarts_left}
                     for a, i in self.actors.items()]
         if kind == "workers":
             return [{"worker_id": w.hex(), "pid": i.pid, "is_driver": i.is_driver,
+                     "node_id": i.node_id.hex(),
                      "actor": i.actor_id.hex() if i.actor_id else None,
                      "task": i.running_task.hex() if i.running_task else None}
                     for w, i in self.workers.items()]
@@ -591,14 +861,19 @@ class Head:
                     for o, m in self.objects.items()]
         if kind == "tasks":
             return [{"task_id": r.task_id.hex(),
+                     "name": r.spec["options"].get("name"),
                      "pending_deps": len(r.pending_deps)} for r in self.queue]
         if kind == "nodes":
-            return [{"node_id": self.node_id.hex(), "resources": self.total_resources,
-                     "available": self.available, "labels": self.labels,
-                     "alive": True}]
+            return [{"node_id": n.node_id.hex(), "resources": n.resources,
+                     "available": n.available, "labels": n.labels,
+                     "is_head": n.is_head, "alive": n.alive}
+                    for n in self.nodes.values()]
         if kind == "placement_groups":
             return [{"pg_id": p.hex(), "state": g.state, "strategy": g.strategy,
-                     "bundles": g.bundles} for p, g in self.pgs.items()]
+                     "bundles": [{"resources": b.resources,
+                                  "node_id": b.node_id.hex() if b.node_id else None}
+                                 for b in g.bundles]}
+                    for p, g in self.pgs.items()]
         raise ValueError(f"unknown state kind {kind}")
 
     # --------------------------------------------------------------- server
@@ -614,21 +889,25 @@ class Head:
                 w = conn_state.get("worker")
                 if w is not None:
                     self._on_worker_disconnect(w)
+                node = conn_state.get("node")
+                if node is not None:
+                    self._on_node_disconnect(node)
 
             conn.on_close = on_close
 
         # handlers installed per-connection (they close over conn_state)
         self._server = protocol.Server({}, on_connect=on_connect, name="head")
         self.port = await self._server.start(port=port)
-        # task completion wiring: workers push task_done
         return self.port
 
     def notify_task_done(self, w: WorkerInfo) -> None:
         w.running_task = None
         w.current_record = None
         self._release(w)
-        if not w.is_driver and w.actor_id is None and w not in self.idle:
-            self.idle.append(w)
+        node = self.nodes.get(w.node_id)
+        if (not w.is_driver and w.actor_id is None and node is not None
+                and w not in node.idle):
+            node.idle.append(w)
         self._kick()
 
     def notify_actor_ready(self, info: ActorInfo, address) -> None:
@@ -640,6 +919,9 @@ class Head:
 
     async def stop(self) -> None:
         self._shutdown = True
+        for node in self.nodes.values():
+            if node.conn is not None and not node.conn.closed:
+                node.conn.push("shutdown_node")
         for w in list(self.workers.values()):
             if not w.is_driver:
                 self._terminate_worker(w)

@@ -20,7 +20,8 @@ async def amain(args) -> None:
                 resources=json.loads(args.resources) if args.resources else None,
                 num_tpu_chips=args.num_tpu_chips,
                 object_store_bytes=args.object_store_bytes,
-                max_workers=args.max_workers)
+                max_workers=args.max_workers,
+                labels=json.loads(args.labels) if args.labels else None)
     port = await head.start(port=args.port)
     print(f"RAY_TPU_HEAD_PORT={port}", flush=True)
     try:
@@ -38,6 +39,7 @@ def main() -> None:
     p.add_argument("--resources", type=str, default=None)
     p.add_argument("--object-store-bytes", type=int, default=2 << 30)
     p.add_argument("--max-workers", type=int, default=None)
+    p.add_argument("--labels", type=str, default=None)
     args = p.parse_args()
     try:
         asyncio.run(amain(args))

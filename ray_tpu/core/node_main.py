@@ -1,0 +1,127 @@
+"""Node daemon (`python -m ray_tpu.core.node_main`): joins a cluster.
+
+The thin per-node agent — the remainder of the reference raylet's job after
+the head absorbed scheduling (SURVEY §2.1 N1/N3): advertise this node's
+resources+labels to the head, spawn/kill local worker processes on request.
+Workers connect straight to the head; object data rides the node-local shm
+store.
+
+`ray start --address=...` equivalent for worker nodes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import Dict
+
+from ray_tpu.core import protocol
+from ray_tpu.core.ids import NodeID
+
+
+class NodeDaemon:
+    def __init__(self, head_host: str, head_port: int,
+                 num_cpus=None, num_tpu_chips=None, resources=None,
+                 labels=None, max_workers=None):
+        from ray_tpu.core.resources import node_labels, node_resources
+
+        self.head_host, self.head_port = head_host, head_port
+        self.node_id = NodeID.generate()
+        self.resources = node_resources(num_cpus, num_tpu_chips, resources)
+        self.labels = {**node_labels(), **(labels or {})}
+        self.max_workers = max_workers or max(
+            int(self.resources.get("CPU", 4)) * 2, 8)
+        self.session: str = ""
+        self.conn: protocol.Connection = None
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.stopping = asyncio.Event()
+
+    async def start(self):
+        self.conn = await protocol.connect(
+            self.head_host, self.head_port,
+            handlers={
+                "spawn_worker": self._spawn_worker,
+                "kill_worker": self._kill_worker,
+                "shutdown_node": self._shutdown_node,
+            },
+            name="node")
+        self.conn.on_close = lambda c: self.stopping.set()
+        reply = await self.conn.request(
+            "register_node", node_id=self.node_id.binary(),
+            resources=self.resources, labels=self.labels,
+            max_workers=self.max_workers)
+        self.session = reply["session"]
+
+    async def _spawn_worker(self):
+        from ray_tpu.core.resources import strip_device_env
+
+        env = strip_device_env(dict(os.environ))
+        env["RAY_TPU_HEAD_PORT"] = str(self.head_port)
+        env["RAY_TPU_HEAD_HOST"] = self.head_host
+        env["RAY_TPU_SESSION"] = self.session
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env, stdout=None, stderr=None)
+        self.procs[proc.pid] = proc
+        return proc.pid
+
+    async def _kill_worker(self, pid):
+        proc = self.procs.pop(pid, None)
+        try:
+            if proc is not None:
+                proc.kill()
+            else:
+                os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        return True
+
+    async def _shutdown_node(self):
+        self.stopping.set()
+        return True
+
+    async def run(self):
+        await self.stopping.wait()
+        for proc in self.procs.values():
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+
+
+async def amain(args):
+    host, port_s = args.address.rsplit(":", 1)
+    daemon = NodeDaemon(
+        host, int(port_s), num_cpus=args.num_cpus,
+        num_tpu_chips=args.num_tpu_chips,
+        resources=json.loads(args.resources) if args.resources else None,
+        labels=json.loads(args.labels) if args.labels else None,
+        max_workers=args.max_workers)
+    await daemon.start()
+    print(f"RAY_TPU_NODE_ID={daemon.node_id.hex()}", flush=True)
+    await daemon.run()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpu-chips", type=int, default=None)
+    p.add_argument("--resources", type=str, default=None)
+    p.add_argument("--labels", type=str, default=None)
+    p.add_argument("--max-workers", type=int, default=None)
+    args = p.parse_args()
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

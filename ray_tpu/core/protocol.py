@@ -141,7 +141,12 @@ class Connection:
             else:
                 print(f"[ray_tpu] push handler {method} failed: {e}", flush=True)
 
-    async def request(self, rpc: str, **kwargs) -> Any:
+    def request_future(self, rpc: str, **kwargs) -> asyncio.Future:
+        """Send the request now; return the reply future without awaiting.
+
+        Lets callers pipeline ordered requests (write in program order, await
+        replies concurrently) — the role of the reference's async gRPC
+        callbacks in the actor submit queue."""
         if prob := _chaos.get(rpc):
             if random.random() < prob:
                 raise ConnectionLost(f"chaos: injected failure for {rpc}")
@@ -151,7 +156,10 @@ class Connection:
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         write_frame(self.writer, ("req", rid, rpc, kwargs))
-        return await fut
+        return fut
+
+    async def request(self, rpc: str, **kwargs) -> Any:
+        return await self.request_future(rpc, **kwargs)
 
     def push(self, rpc: str, **kwargs) -> None:
         if not self.closed:

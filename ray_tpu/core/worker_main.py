@@ -99,6 +99,7 @@ class WorkerRuntime:
         self.actor_executor = ThreadPoolExecutor(max_workers=max_conc,
                                                  thread_name_prefix="actor")
         self.actor_id = ActorID(spec["actor_id"])
+        self.client.current_actor_id = self.actor_id
 
         def _init():
             cls = self.client.fn_manager.load(spec["cls_key"])
@@ -145,9 +146,10 @@ class WorkerRuntime:
 
 
 def main():
+    head_host = os.environ.get("RAY_TPU_HEAD_HOST", "127.0.0.1")
     head_port = int(os.environ["RAY_TPU_HEAD_PORT"])
     session = os.environ["RAY_TPU_SESSION"]
-    rt = WorkerRuntime("127.0.0.1", head_port, session)
+    rt = WorkerRuntime(head_host, head_port, session)
     try:
         rt.start()
     except (ConnectionRefusedError, OSError, TimeoutError):

@@ -1,0 +1,116 @@
+"""Checkpoint handle + top-K retention manager.
+
+Parity with `python/ray/train/_checkpoint.py` (directory-handle Checkpoint)
+and `train/v2/_internal/execution/checkpoint/checkpoint_manager.py` (top-K by
+metric per CheckpointConfig). Storage is a local/NFS path; TPU jobs write
+orbax/msgpack files into the directory — the framework only moves bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.config import CheckpointConfig
+
+
+class Checkpoint:
+    """A handle to a directory of checkpoint files (reference Checkpoint)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        dest = path or tempfile.mkdtemp(prefix="ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+class CheckpointManager:
+    """Persists reported checkpoints under storage_path, keeps top-K."""
+
+    def __init__(self, storage_path: str, config: Optional[CheckpointConfig] = None):
+        self.storage_path = storage_path
+        self.config = config or CheckpointConfig()
+        self.tracked: List[Dict[str, Any]] = []  # {path, metrics, index}
+        self._index = 0
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict[str, Any]] = None) -> Checkpoint:
+        """Copy a worker-local checkpoint into durable storage; evict per
+        top-K policy. Returns the durable handle."""
+        self._index += 1
+        dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        if os.path.abspath(checkpoint.path) != dest:
+            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        entry = {"path": dest, "metrics": metrics or {}, "index": self._index,
+                 "time": time.time()}
+        self.tracked.append(entry)
+        self._write_manifest()
+        self._evict()
+        return Checkpoint(dest)
+
+    def _score(self, entry) -> float:
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            return entry["index"]  # recency
+        v = entry["metrics"].get(attr)
+        if v is None:
+            return float("-inf")
+        return float(v) if self.config.checkpoint_score_order == "max" else -float(v)
+
+    def _evict(self) -> None:
+        k = self.config.num_to_keep
+        if k is None or len(self.tracked) <= k:
+            return
+        self.tracked.sort(key=self._score, reverse=True)
+        for entry in self.tracked[k:]:
+            shutil.rmtree(entry["path"], ignore_errors=True)
+        self.tracked = self.tracked[:k]
+        self._write_manifest()
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self.tracked:
+            return None
+        return Checkpoint(max(self.tracked, key=self._score)["path"])
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self.tracked:
+            return None
+        return Checkpoint(max(self.tracked, key=lambda e: e["index"])["path"])
+
+    def _write_manifest(self) -> None:
+        manifest = os.path.join(self.storage_path, "checkpoints.json")
+        with open(manifest, "w") as f:
+            json.dump([{k: v for k, v in e.items()} for e in self.tracked], f)
+
+    @classmethod
+    def restore(cls, storage_path: str,
+                config: Optional[CheckpointConfig] = None) -> "CheckpointManager":
+        mgr = cls(storage_path, config)
+        manifest = os.path.join(storage_path, "checkpoints.json")
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                mgr.tracked = [e for e in json.load(f)
+                               if os.path.isdir(e["path"])]
+            mgr._index = max((e["index"] for e in mgr.tracked), default=0)
+        return mgr

@@ -1,0 +1,66 @@
+"""Train configuration dataclasses.
+
+Parity with the reference's AIR/Train v2 configs
+(`python/ray/train/v2/api/config.py` ScalingConfig incl. `use_tpu`/`topology`,
+`python/ray/air/config.py` RunConfig/FailureConfig/CheckpointConfig).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    TPU semantics: `use_tpu=True` + `topology` (e.g. "v5e-16") gang-schedules
+    one worker per slice host via the slice-name label (reference
+    train/v2/jax flow, SURVEY §3.4); `chips_per_worker` subdivides hosts for
+    small jobs.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: Optional[str] = None          # e.g. "v5e-16" (a pod type)
+    chips_per_worker: Optional[int] = None  # default: all chips of a host
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.chips_per_worker or 4)
+        if not self.use_tpu and not res:
+            res = {"CPU": 1.0}
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: whole-group restarts allowed before erroring (reference
+    v2/_internal/execution/failure_handling/default.py)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)

@@ -1,0 +1,138 @@
+"""TrainController: the state machine driving a training run.
+
+Parity with `python/ray/train/v2/_internal/execution/controller/
+controller.py:93` (states Initializing/Scheduling/Running/Restarting/Errored/
+Finished; poll loop; whole-group restart per FailurePolicy). Runs as an actor
+spawned by the trainer (reference spawns a detached controller,
+data_parallel_trainer.py:207).
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+POLL_INTERVAL_S = 0.2
+
+
+class TrainControllerLogic:
+    """The controller loop, actor-hostable (see TrainControllerActor)."""
+
+    def __init__(self, train_fn: Callable, train_config: Any,
+                 scaling_config: ScalingConfig, run_config: RunConfig,
+                 backend=None, resume_from: Optional[str] = None):
+        self.train_fn = train_fn
+        self.train_config = train_config
+        self.scaling = scaling_config
+        self.run_config = run_config
+        self.backend = backend
+        self.state = "INITIALIZING"
+        self.failure_config = run_config.failure_config or FailureConfig()
+        self.ckpt_manager = CheckpointManager(
+            run_config.resolved_storage_path(),
+            run_config.checkpoint_config)
+        self.resume_from = resume_from
+        self.latest_metrics: Dict[int, dict] = {}
+        self.failures = 0
+        self._slice_reservation = None
+
+    # ----------------------------------------------------------- scheduling
+    def _build_group(self) -> WorkerGroup:
+        label_selector = None
+        pg = None
+        if self.scaling.use_tpu and self.scaling.topology:
+            from ray_tpu.util.accelerators import reserve_tpu_slice
+
+            if self._slice_reservation is None:
+                self._slice_reservation = reserve_tpu_slice(self.scaling.topology)
+            label_selector = self._slice_reservation.label_selector
+        return WorkerGroup(self.scaling, label_selector=label_selector,
+                           placement_group=pg)
+
+    def _resume_checkpoint(self) -> Optional[Checkpoint]:
+        if self.resume_from:
+            return Checkpoint(self.resume_from)
+        return self.ckpt_manager.latest_checkpoint()
+
+    # ------------------------------------------------------------ main loop
+    def run(self) -> dict:
+        """Blocking run; returns a plain-dict Result."""
+        error: Optional[str] = None
+        while True:
+            self.state = "SCHEDULING"
+            group = self._build_group()
+            try:
+                group.start(self.train_fn, self.train_config,
+                            resume_checkpoint=self._resume_checkpoint(),
+                            backend=self.backend)
+            except Exception:
+                error = traceback.format_exc()
+                self.state = "ERRORED"
+                group.shutdown()
+                break
+            self.state = "RUNNING"
+            outcome = self._poll_until_done(group)
+            group.shutdown()
+            if outcome == "finished":
+                self.state = "FINISHED"
+                break
+            # worker failure: whole-group restart (reference FailurePolicy
+            # RETRY semantics, failure_handling/default.py)
+            self.failures += 1
+            if self.failures > self.failure_config.max_failures:
+                error = self._last_error or "train worker group failed"
+                self.state = "ERRORED"
+                break
+            self.state = "RESTARTING"
+        best = self.ckpt_manager.best_checkpoint()
+        return {
+            "state": self.state,
+            "metrics": self.latest_metrics.get(0, {}),
+            "all_rank_metrics": self.latest_metrics,
+            "checkpoint_path": best.path if best else None,
+            "storage_path": self.ckpt_manager.storage_path,
+            "error": error,
+            "restarts": self.failures,
+        }
+
+    _last_error: Optional[str] = None
+
+    def _poll_until_done(self, group: WorkerGroup) -> str:
+        while True:
+            try:
+                statuses = group.poll()
+            except RayTpuError:
+                self._last_error = "worker died (actor unreachable)"
+                return "failed"
+            for rank, st in enumerate(statuses):
+                for rep in st["reports"]:
+                    self.latest_metrics[rank] = rep["metrics"]
+                    if rep["checkpoint_path"]:
+                        self.ckpt_manager.register(
+                            Checkpoint(rep["checkpoint_path"]), rep["metrics"])
+                if st["error"]:
+                    self._last_error = st["error"]
+                    return "failed"
+            if all(st["done"] for st in statuses):
+                return "finished"
+            time.sleep(POLL_INTERVAL_S)
+
+
+@ray_tpu.remote
+class TrainControllerActor:
+    """Actor wrapper so the run survives the driver's call stack (reference
+    detached TrainController)."""
+
+    def run(self, train_fn, train_config, scaling_config, run_config,
+            backend=None, resume_from=None):
+        logic = TrainControllerLogic(train_fn, train_config, scaling_config,
+                                     run_config, backend=backend,
+                                     resume_from=resume_from)
+        return logic.run()

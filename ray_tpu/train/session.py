@@ -1,0 +1,81 @@
+"""Per-worker training session: rank info + report(metrics, checkpoint).
+
+Parity with `ray.train.report` / `ray.train.get_context`
+(`python/ray/train/v2/_internal/execution/context.py` semantics): the train
+function runs in a thread inside the TrainWorker actor; `report` enqueues
+(metrics, checkpoint) for the controller to poll, mirroring the reference's
+ReportCallbackHandler path (SURVEY §3.4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, local_rank: int = 0,
+                 node_rank: int = 0, resume_checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[dict] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.resume_checkpoint = resume_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.reports: List[Dict[str, Any]] = []
+        self.lock = threading.Lock()
+        self.stop_requested = False
+
+    # -- user-facing API ---------------------------------------------------
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.resume_checkpoint
+
+
+_ctx = threading.local()
+
+
+def _set_context(ctx: Optional[TrainContext]) -> None:
+    _ctx.value = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_ctx, "value", None)
+    if ctx is None:
+        raise RuntimeError("not inside a train worker (no TrainContext)")
+    return ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (all ranks) and optionally a checkpoint (rank 0 by
+    convention) to the controller."""
+    ctx = get_context()
+    with ctx.lock:
+        ctx.reports.append({
+            "metrics": dict(metrics),
+            "checkpoint_path": checkpoint.path if checkpoint else None,
+        })
+    if ctx.stop_requested:
+        raise StopIteration("training stop requested by controller")
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's streaming shard of a dataset passed to the trainer
+    (reference `ray.train.get_dataset_shard`)."""
+    ctx = get_context()
+    shard = ctx.dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(f"no dataset shard named {name!r}")
+    return shard

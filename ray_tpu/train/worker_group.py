@@ -1,0 +1,125 @@
+"""TrainWorker actor + WorkerGroup.
+
+Parity with `python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py:103` (actor group creation w/ PGs, poll_status) and
+`worker.py`/`thread_runner.py` (train fn runs on a thread inside the actor).
+TPU twist: workers of a multi-host job are gang-placed one-per-host on a
+reserved slice via the slice-name label selector (SURVEY §3.4).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train import session as session_lib
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """Hosts the user train function on a thread; polled by the controller."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._ctx: Optional[session_lib.TrainContext] = None
+        self._error: Optional[str] = None
+        self._done = False
+
+    def setup_and_start(self, train_fn, train_config, rank, world_size,
+                        local_rank, node_rank, resume_checkpoint_path,
+                        backend_env: Optional[Dict[str, str]] = None):
+        import os
+
+        if backend_env:
+            os.environ.update(backend_env)
+        resume = (Checkpoint(resume_checkpoint_path)
+                  if resume_checkpoint_path else None)
+        self._ctx = session_lib.TrainContext(
+            rank=rank, world_size=world_size, local_rank=local_rank,
+            node_rank=node_rank, resume_checkpoint=resume)
+
+        def _run():
+            session_lib._set_context(self._ctx)
+            try:
+                if train_config is None:
+                    train_fn()
+                else:
+                    train_fn(train_config)
+            except StopIteration:
+                pass
+            except BaseException:
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+                session_lib._set_context(None)
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name=f"train-rank{rank}")
+        self._thread.start()
+        return True
+
+    def poll(self):
+        """Drain new reports; reference worker_group.poll_status :488."""
+        with self._ctx.lock:
+            reports = self._ctx.reports
+            self._ctx.reports = []
+        return {"reports": reports, "done": self._done, "error": self._error}
+
+    def request_stop(self):
+        if self._ctx is not None:
+            self._ctx.stop_requested = True
+        return True
+
+    def node_id(self):
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    def shutdown_worker(self):
+        return True
+
+
+class WorkerGroup:
+    """Creates and tracks the gang of TrainWorker actors."""
+
+    def __init__(self, scaling_config, label_selector: Optional[dict] = None,
+                 placement_group=None):
+        self.scaling = scaling_config
+        self.label_selector = label_selector
+        self.placement_group = placement_group
+        self.workers: List[Any] = []
+
+    def start(self, train_fn: Callable, train_config: Any,
+              resume_checkpoint: Optional[Checkpoint] = None,
+              backend=None) -> None:
+        n = self.scaling.num_workers
+        res = self.scaling.worker_resources()
+        opts: Dict[str, Any] = {"resources": res, "num_cpus": res.get("CPU", 0)}
+        if self.label_selector:
+            opts["label_selector"] = self.label_selector
+        if self.placement_group is not None:
+            opts["placement_group"] = self.placement_group
+        if self.scaling.placement_strategy in ("SPREAD", "STRICT_SPREAD"):
+            opts["scheduling_strategy"] = "spread"
+        self.workers = [TrainWorker.options(**opts).remote() for _ in range(n)]
+        backend_envs = (backend.worker_envs(self) if backend is not None
+                        else [{} for _ in range(n)])
+        starts = []
+        for rank, w in enumerate(self.workers):
+            starts.append(w.setup_and_start.remote(
+                train_fn, train_config, rank, n, 0, rank,
+                resume_checkpoint.path if resume_checkpoint else None,
+                backend_envs[rank]))
+        ray_tpu.get(starts, timeout=120)
+
+    def poll(self) -> List[dict]:
+        return ray_tpu.get([w.poll.remote() for w in self.workers], timeout=60)
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -1,0 +1,2 @@
+from ray_tpu.util.accelerators.tpu import (  # noqa: F401
+    SliceReservation, release_tpu_slice, reserve_tpu_slice)

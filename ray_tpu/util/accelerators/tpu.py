@@ -1,0 +1,89 @@
+"""TPU slice gang scheduling helpers.
+
+Behavioral parity with the reference's multi-host TPU flow
+(`python/ray/_private/accelerators/tpu.py:145 reserve_tpu_slice`, `:131
+fetch_tpu_slice_name_from_pg`, used by Train v2 at SURVEY §3.4): reserve the
+slice via a placement group on the per-slice `TPU-{pod}-head` resource, then
+read the slice name off the reserved node so workers can gang-place onto all
+hosts of that slice with the `ray.io/tpu-slice-name` label selector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_tpu.core.placement_group import PlacementGroup, placement_group
+
+SLICE_NAME_LABEL = "ray.io/tpu-slice-name"
+WORKER_ID_LABEL = "ray.io/tpu-worker-id"
+POD_TYPE_LABEL = "ray.io/tpu-pod-type"
+TOPOLOGY_LABEL = "ray.io/tpu-topology"
+
+
+@dataclasses.dataclass
+class SliceReservation:
+    """A claimed multi-host slice: placement group pinning its head + the
+    slice name every worker of the slice is labeled with."""
+
+    pod_type: str
+    slice_name: str
+    pg: PlacementGroup
+
+    @property
+    def label_selector(self) -> dict:
+        return {SLICE_NAME_LABEL: self.slice_name}
+
+
+def slice_head_resource(pod_type: str) -> str:
+    return f"TPU-{pod_type}-head"
+
+
+def num_hosts_for_pod(pod_type: str) -> int:
+    """v5e-16 -> 16 chips -> 4 hosts (4 chips/host), mirroring the
+    reference's pod-name arithmetic (tpu.py GKE metadata path)."""
+    try:
+        chips = int(pod_type.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 1
+    return max(1, chips // 4)
+
+
+def reserve_tpu_slice(pod_type: str, timeout: Optional[float] = 60,
+                      ) -> SliceReservation:
+    """Claim one whole slice of `pod_type` and learn its name.
+
+    Creates a PG on the slice-head resource (only worker 0 of each slice
+    advertises it), then runs a probe task inside the PG to read the slice
+    name from that node's environment."""
+    import ray_tpu
+
+    pg = placement_group([{slice_head_resource(pod_type): 1}],
+                         strategy="STRICT_PACK",
+                         name=f"tpu-slice-{pod_type}")
+    if not pg.ready(timeout=timeout):
+        from ray_tpu.core.placement_group import remove_placement_group
+
+        remove_placement_group(pg)
+        raise TimeoutError(
+            f"no free {pod_type} slice (resource "
+            f"{slice_head_resource(pod_type)!r} unavailable)")
+
+    @ray_tpu.remote
+    def _fetch_slice_name():
+        from ray_tpu.core.resources import tpu_slice_name
+
+        return tpu_slice_name()
+
+    name = ray_tpu.get(
+        _fetch_slice_name.options(num_cpus=0, placement_group=pg).remote(),
+        timeout=timeout)
+    if name is None:
+        name = f"slice-{pg.id.hex()[:8]}"
+    return SliceReservation(pod_type=pod_type, slice_name=name, pg=pg)
+
+
+def release_tpu_slice(reservation: SliceReservation) -> None:
+    from ray_tpu.core.placement_group import remove_placement_group
+
+    remove_placement_group(reservation.pg)

@@ -1,0 +1,169 @@
+"""Collective API surface — parity with `ray.util.collective.collective`.
+
+Reference: `python/ray/util/collective/collective.py` (init_collective_group
+:166, create_collective_group :203, get_rank, allreduce :311, barrier :351,
+reduce :364, broadcast :426, allgather :476, reducescatter :525, send/recv
+:584-705). Backends here are TPU-native (see types.py): `kv` for
+cross-process actor gangs (CI/CPU), `xla` for in-process device gangs.
+
+Rendezvous: group metadata lives in the head KV store (the reference uses a
+named detached Info actor, collective.py:260-265, and internal KV for gloo);
+declarative creation writes actor-id→rank there and members lazily attach.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.kv_group import KVCollectiveGroup
+from ray_tpu.util.collective.types import Backend, ReduceOp
+from ray_tpu.util.collective.xla_group import XlaCollectiveGroup
+
+_META_NS = "collective_meta"
+_groups: dict = {}
+_lock = threading.Lock()
+
+
+def _client():
+    from ray_tpu.core.api import _global_client
+
+    return _global_client()
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = "kv",
+                          group_name: str = "default") -> None:
+    """Imperative init: every member calls this with its own rank."""
+    backend = Backend(backend)
+    with _lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group {group_name!r} already initialized")
+        if backend == Backend.XLA:
+            raise ValueError(
+                "backend='xla' groups are in-process device gangs; build one "
+                "with ray_tpu.util.collective.XlaCollectiveGroup(devices)")
+        _groups[group_name] = KVCollectiveGroup(
+            _client(), group_name, world_size, rank)
+
+
+def create_collective_group(actors: list, world_size: int, ranks: List[int],
+                            backend: str = "kv",
+                            group_name: str = "default") -> None:
+    """Declarative init from the driver: members lazily attach on first op."""
+    Backend(backend)
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("actors/ranks must both have world_size entries")
+    mapping = {a._actor_id.hex(): r for a, r in zip(actors, ranks)}
+    meta = {"world_size": world_size, "ranks": mapping, "backend": backend}
+    ok = _client().kv_put(_META_NS, group_name.encode(), pickle.dumps(meta),
+                          overwrite=False)
+    if not ok:
+        raise RuntimeError(f"collective group {group_name!r} already exists")
+
+
+def _lazy_attach(group_name: str) -> KVCollectiveGroup:
+    blob = _client().kv_get(_META_NS, group_name.encode())
+    if blob is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized; call "
+            "init_collective_group or create_collective_group first")
+    meta = pickle.loads(blob)
+    actor_id = _client().current_actor_id
+    if actor_id is None or actor_id.hex() not in meta["ranks"]:
+        raise RuntimeError(
+            f"this process is not a member of group {group_name!r}")
+    group = KVCollectiveGroup(_client(), group_name, meta["world_size"],
+                              meta["ranks"][actor_id.hex()])
+    _groups[group_name] = group
+    return group
+
+
+def _get_group(group_name: str) -> KVCollectiveGroup:
+    with _lock:
+        group = _groups.get(group_name)
+        if group is None:
+            group = _lazy_attach(group_name)
+        return group
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        group = _groups.pop(group_name, None)
+    if group is not None:
+        group.destroy()
+    try:
+        _client().kv_del(_META_NS, group_name.encode())
+    except Exception:
+        pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _get_group(group_name).world_size
+
+
+# --------------------------------------------------------------- collectives
+def allreduce(tensor, op: ReduceOp = ReduceOp.SUM,
+              group_name: str = "default"):
+    return _get_group(group_name).allreduce(tensor, op)
+
+
+def reduce(tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM,
+           group_name: str = "default"):
+    return _get_group(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _get_group(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor_list: Optional[list], tensor, group_name: str = "default"):
+    """Reference signature: fills tensor_list with world_size tensors."""
+    parts = _get_group(group_name).allgather(tensor)
+    if tensor_list is not None:
+        tensor_list[:] = parts
+    return parts
+
+
+def reducescatter(tensor, op: ReduceOp = ReduceOp.SUM,
+                  group_name: str = "default"):
+    return _get_group(group_name).reducescatter(tensor, op)
+
+
+def barrier(group_name: str = "default") -> None:
+    _get_group(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    _get_group(group_name).send(tensor, dst_rank)
+
+
+def recv(tensor, src_rank: int, group_name: str = "default"):
+    return _get_group(group_name).recv(tensor, src_rank)
+
+
+def synchronize(device_or_group=None) -> None:
+    """Block until all queued device work completes (reference :708 syncs
+    the CUDA stream; on TPU the analog is draining dispatched XLA work)."""
+    import jax
+
+    (jax.device_put(np.zeros(()))).block_until_ready()
+
+
+__all__ = [
+    "init_collective_group", "create_collective_group",
+    "destroy_collective_group", "is_group_initialized", "get_rank",
+    "get_collective_group_size", "allreduce", "reduce", "broadcast",
+    "allgather", "reducescatter", "barrier", "send", "recv", "synchronize",
+    "ReduceOp", "Backend", "XlaCollectiveGroup",
+]

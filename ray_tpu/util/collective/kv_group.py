@@ -1,0 +1,189 @@
+"""Cross-process collective group over the head KV store + shm object store.
+
+This is the CPU/CI backend of the collective layer — the role the reference's
+pygloo group plays (`python/ray/util/collective/collective_group/
+gloo_collective_group.py:185`, rendezvous via Ray internal KV,
+`collective.py:101`). Data plane: small payloads ride the KV store directly;
+large payloads go through the shared-memory object store and only the ref id
+rides KV, so an allreduce of an N-byte tensor moves N bytes through shm per
+rank pair, not through pickle frames.
+
+Correctness model: every collective in a group is assigned a monotonically
+increasing sequence number per rank (program order). Rank r posts its
+contribution under (group, seq, rank) and polls for peers. A rank reaching
+seq n proves it finished reading seq n-1, so each rank garbage-collects its
+own key for seq n-2 when issuing seq n — the store stays O(world_size) keys
+per group.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.types import ReduceOp
+
+_KV_NS = "collective"
+_INLINE_LIMIT = 256 * 1024
+_POLL_S = 0.002
+
+
+def _reduce(op: ReduceOp, arrays: List[np.ndarray]) -> np.ndarray:
+    out = arrays[0].copy()
+    for a in arrays[1:]:
+        if op is ReduceOp.SUM:
+            out += a
+        elif op is ReduceOp.PRODUCT:
+            out *= a
+        elif op is ReduceOp.MIN:
+            np.minimum(out, a, out=out)
+        elif op is ReduceOp.MAX:
+            np.maximum(out, a, out=out)
+    return out
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    # jax.Array / torch.Tensor / lists all coerce via the buffer protocol
+    return np.asarray(tensor)
+
+
+def _write_back(tensor, value: np.ndarray):
+    """In-place update when the tensor supports it (numpy); reference
+    collectives mutate their input tensors (collective.py allreduce doc)."""
+    if isinstance(tensor, np.ndarray):
+        tensor[...] = value
+        return tensor
+    return value
+
+
+class KVCollectiveGroup:
+    backend_name = "kv"
+
+    def __init__(self, client, group_name: str, world_size: int, rank: int):
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range for world {world_size}")
+        self._client = client
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._seq = 0
+        self._p2p_seq: dict = {}  # (src, dst) -> seq
+        self._owned_refs: dict = {}  # seq -> ObjectRef kept alive until gc
+
+    # ------------------------------------------------------------- transport
+    def _key(self, seq: int, rank: int, tag: str = "c") -> bytes:
+        return f"{self.group_name}:{tag}:{seq}:{rank}".encode()
+
+    def _post(self, seq: int, payload: np.ndarray, tag: str = "c",
+              rank: Optional[int] = None):
+        rank = self.rank if rank is None else rank
+        blob = pickle.dumps(payload, protocol=5)
+        if len(blob) <= _INLINE_LIMIT:
+            value = b"I" + blob
+        else:
+            ref = self._client.put(payload)
+            self._owned_refs[(tag, seq)] = ref
+            value = b"R" + ref.id.binary()
+        self._client.kv_put(_KV_NS, self._key(seq, rank, tag), value)
+
+    def _fetch(self, seq: int, rank: int, tag: str = "c",
+               timeout: Optional[float] = None) -> np.ndarray:
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.core.ids import ObjectID
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        key = self._key(seq, rank, tag)
+        while True:
+            value = self._client.kv_get(_KV_NS, key)
+            if value is not None:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {self.group_name} seq {seq}: rank {rank} "
+                    f"did not arrive within {timeout}s")
+            time.sleep(_POLL_S)
+        if value[:1] == b"I":
+            return pickle.loads(value[1:])
+        return self._client.get([ObjectRef(ObjectID(value[1:]))])[0]
+
+    def _gc(self, seq: int, tag: str = "c"):
+        if seq >= 0:
+            self._client.kv_del(_KV_NS, self._key(seq, self.rank, tag))
+            ref = self._owned_refs.pop((tag, seq), None)
+            if ref is not None:
+                try:
+                    self._client.free([ref])
+                except Exception:
+                    pass
+
+    def _next_seq(self) -> int:
+        seq = self._seq
+        self._seq += 1
+        self._gc(seq - 2)
+        return seq
+
+    def _gather_all(self, tensor, timeout=None) -> List[np.ndarray]:
+        seq = self._next_seq()
+        self._post(seq, _to_numpy(tensor))
+        return [self._fetch(seq, r, timeout=timeout) if r != self.rank
+                else _to_numpy(tensor) for r in range(self.world_size)]
+
+    # ------------------------------------------------------------ collectives
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM, timeout=None):
+        return _write_back(tensor, _reduce(op, self._gather_all(tensor, timeout)))
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM,
+               timeout=None):
+        parts = self._gather_all(tensor, timeout)
+        if self.rank == dst_rank:
+            return _write_back(tensor, _reduce(op, parts))
+        return tensor
+
+    def broadcast(self, tensor, src_rank: int = 0, timeout=None):
+        seq = self._next_seq()
+        if self.rank == src_rank:
+            self._post(seq, _to_numpy(tensor))
+            return tensor
+        return _write_back(tensor, self._fetch(seq, src_rank, timeout=timeout))
+
+    def allgather(self, tensor, timeout=None) -> List[np.ndarray]:
+        return self._gather_all(tensor, timeout)
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM, timeout=None):
+        """Input shape [world, ...]; returns this rank's reduced slice."""
+        arr = _to_numpy(tensor)
+        if arr.shape[0] != self.world_size:
+            raise ValueError(
+                f"reducescatter input leading dim {arr.shape[0]} != world "
+                f"{self.world_size}")
+        parts = self._gather_all(arr, timeout)
+        return _reduce(op, [p[self.rank] for p in parts])
+
+    def barrier(self, timeout=None):
+        self._gather_all(np.zeros((), np.int8), timeout)
+
+    # ------------------------------------------------------------------- p2p
+    def send(self, tensor, dst_rank: int, timeout=None):
+        key = (self.rank, dst_rank)
+        seq = self._p2p_seq.get(key, 0)
+        self._p2p_seq[key] = seq + 1
+        tag = f"p{self.rank}-{dst_rank}"
+        self._gc(seq - 2, tag)
+        self._post(seq, _to_numpy(tensor), tag=tag)
+
+    def recv(self, tensor, src_rank: int, timeout=None):
+        key = (src_rank, self.rank)
+        seq = self._p2p_seq.get(key, 0)
+        self._p2p_seq[key] = seq + 1
+        tag = f"p{src_rank}-{self.rank}"
+        value = self._fetch(seq, src_rank, tag=tag, timeout=timeout)
+        return _write_back(tensor, value)
+
+    def destroy(self):
+        for seq in range(max(0, self._seq - 2), self._seq):
+            self._gc(seq)

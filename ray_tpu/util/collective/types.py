@@ -1,0 +1,48 @@
+"""Collective types: ReduceOp, backend registry, group options.
+
+API parity with the reference's `python/ray/util/collective/types.py`
+(ReduceOp enum, backend validation) re-expressed for the TPU stack: the
+canonical backends are `xla` (in-process device collectives over a
+`jax.sharding.Mesh`, the ICI data plane) and `kv` (cross-process CPU
+collectives rendezvoused through the head's KV store — the CI/correctness
+backend filling the role of the reference's gloo path).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Union
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+class Backend:
+    """Backend name validation (reference: types.py Backend class)."""
+
+    XLA = "xla"      # in-process jax mesh collectives (ICI/DCN data plane)
+    KV = "kv"        # cross-process via head KV + shm object store (CPU/CI)
+    NCCL = "nccl"    # unavailable on TPU — rejected with guidance
+    GLOO = "gloo"    # alias for KV (drop-in for reference code)
+    MPI = "mpi"      # rejected, like the reference (collective.py:93-94)
+
+    def __new__(cls, name: Union[str, "Backend"] = "xla"):
+        backend = str(name).lower()
+        if backend in ("xla", "ici", "tpu"):
+            return Backend.XLA
+        if backend in ("kv", "gloo", "torch_gloo", "cpu"):
+            return Backend.KV
+        if backend == "nccl":
+            raise ValueError(
+                "NCCL is not available on TPU; use backend='xla' (ICI "
+                "collectives) or 'kv' (cross-process CPU collectives)")
+        if backend == "mpi":
+            raise ValueError("MPI is not supported")
+        raise ValueError(f"unknown collective backend: {name!r}")
+
+
+ALL_REDUCE_OPS = tuple(ReduceOp)

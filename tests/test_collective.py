@@ -1,0 +1,195 @@
+"""Collective layer tests.
+
+Mirrors the reference's collective API-parity matrix
+(`python/ray/util/collective/tests/single_node_cpu_tests/`): every op on the
+cross-process KV backend between real actor processes, plus the in-process
+XLA group on the virtual 8-device CPU mesh.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.collective import ReduceOp, XlaCollectiveGroup
+from ray_tpu.util.collective.types import Backend
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=16, max_workers=16)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _cleanup(members):
+    for m in members:
+        ray_tpu.kill(m)
+
+
+@ray_tpu.remote
+class Member:
+    """Worker actor exercising the imperative collective API."""
+
+    def setup(self, world_size, rank, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend="kv",
+                                  group_name=group_name)
+        return rank
+
+    def run(self, op_name, value, **kw):
+        from ray_tpu.util import collective as col
+
+        arr = np.asarray(value, dtype=np.float64)
+        if op_name == "allgather":  # reference signature: (tensor_list, tensor)
+            return col.allgather(None, arr, **kw)
+        return getattr(col, op_name)(arr, **kw)
+
+    def do_sendrecv(self, rank, group_name):
+        from ray_tpu.util import collective as col
+
+        if rank == 0:
+            col.send(np.full(4, 7.0), dst_rank=1, group_name=group_name)
+            return None
+        out = np.zeros(4)
+        col.recv(out, src_rank=0, group_name=group_name)
+        return out
+
+    def lazy_allreduce(self, value, group_name):
+        from ray_tpu.util import collective as col
+
+        return col.allreduce(np.asarray(value, float), group_name=group_name)
+
+
+def _make_group(n, name):
+    members = [Member.remote() for _ in range(n)]
+    ray_tpu.get([m.setup.remote(n, i, name) for i, m in enumerate(members)])
+    return members
+
+
+def test_kv_allreduce_and_barrier(cluster):
+    ms = _make_group(3, "g-allreduce")
+    out = ray_tpu.get([m.run.remote("allreduce", [float(i)] * 4,
+                                    group_name="g-allreduce")
+                       for i, m in enumerate(ms)])
+    for o in out:
+        np.testing.assert_allclose(o, np.full(4, 3.0))
+    # a second op on the same group must still line up (seq advance + gc)
+    out2 = ray_tpu.get([m.run.remote("allreduce", [1.0], op=ReduceOp.MAX,
+                                     group_name="g-allreduce") for m in ms])
+    for o in out2:
+        np.testing.assert_allclose(o, [1.0])
+    _cleanup(ms)
+
+
+def test_kv_broadcast_reduce_gather_scatter(cluster):
+    ms = _make_group(3, "g-multi")
+    bc = ray_tpu.get([m.run.remote("broadcast", [float(i + 1)] * 2,
+                                   src_rank=1, group_name="g-multi")
+                      for i, m in enumerate(ms)])
+    for o in bc:
+        np.testing.assert_allclose(o, [2.0, 2.0])
+
+    rd = ray_tpu.get([m.run.remote("reduce", [float(i)], dst_rank=0,
+                                   group_name="g-multi")
+                      for i, m in enumerate(ms)])
+    np.testing.assert_allclose(rd[0], [3.0])
+
+    ag = ray_tpu.get([m.run.remote("allgather", [float(i)],
+                                   group_name="g-multi")
+                      for i, m in enumerate(ms)])
+    for parts in ag:
+        np.testing.assert_allclose(np.concatenate(parts), [0.0, 1.0, 2.0])
+
+    rs = ray_tpu.get([m.run.remote(
+        "reducescatter", [[float(i)] * 2] * 3, group_name="g-multi")
+        for i, m in enumerate(ms)])
+    for r, o in enumerate(rs):
+        np.testing.assert_allclose(o, [3.0, 3.0])
+    _cleanup(ms)
+
+
+def test_kv_send_recv(cluster):
+    ms = _make_group(2, "g-p2p")
+    out = ray_tpu.get([m.do_sendrecv.remote(i, "g-p2p")
+                       for i, m in enumerate(ms)])
+    np.testing.assert_allclose(out[1], np.full(4, 7.0))
+    _cleanup(ms)
+
+
+def test_declarative_group_lazy_attach(cluster):
+    from ray_tpu.util import collective as col
+
+    ms = [Member.remote() for _ in range(2)]
+    ray_tpu.get([m.run.remote("synchronize", [0.0]) for m in ms])  # warm up
+    col.create_collective_group(ms, 2, [0, 1], backend="kv",
+                                group_name="g-lazy")
+    out = ray_tpu.get([m.lazy_allreduce.remote([2.0], "g-lazy") for m in ms])
+    for o in out:
+        np.testing.assert_allclose(o, [4.0])
+    col.destroy_collective_group("g-lazy")
+    _cleanup(ms)
+
+
+def test_backend_validation():
+    assert Backend("gloo") == Backend.KV
+    assert Backend("ici") == Backend.XLA
+    with pytest.raises(ValueError, match="NCCL"):
+        Backend("nccl")
+    with pytest.raises(ValueError, match="MPI"):
+        Backend("mpi")
+
+
+# ------------------------------------------------------------- XLA group
+@pytest.fixture(scope="module")
+def xla_group(devices8):
+    return XlaCollectiveGroup(devices8)
+
+
+def test_xla_allreduce(xla_group):
+    n = xla_group.world_size
+    tensors = [jnp.full((4,), float(r)) for r in range(n)]
+    out = xla_group.allreduce(tensors)
+    expected = sum(range(n))
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), np.full(4, expected))
+    out_max = xla_group.allreduce(tensors, ReduceOp.MAX)
+    for o in out_max:
+        np.testing.assert_allclose(np.asarray(o), np.full(4, n - 1))
+
+
+def test_xla_broadcast_allgather(xla_group):
+    n = xla_group.world_size
+    tensors = [jnp.array([float(r)]) for r in range(n)]
+    bc = xla_group.broadcast(tensors, src_rank=2)
+    for o in bc:
+        np.testing.assert_allclose(np.asarray(o), [2.0])
+    ag = xla_group.allgather(tensors)
+    for per_rank in ag:
+        np.testing.assert_allclose(
+            np.concatenate([np.asarray(t) for t in per_rank]),
+            np.arange(n, dtype=float))
+
+
+def test_xla_reducescatter(xla_group):
+    n = xla_group.world_size
+    tensors = [jnp.stack([jnp.full((2,), float(r + c)) for c in range(n)])
+               for r in range(n)]
+    out = xla_group.reducescatter(tensors)
+    for c, o in enumerate(out):
+        expected = sum(r + c for r in range(n))
+        np.testing.assert_allclose(np.asarray(o), np.full(2, expected))
+
+
+def test_xla_send_recv_ring(xla_group):
+    n = xla_group.world_size
+    tensors = [jnp.array([float(r)]) for r in range(n)]
+    pairs = [(r, (r + 1) % n) for r in range(n)]
+    out = xla_group.send_recv(tensors, pairs)
+    for r, o in enumerate(out):
+        np.testing.assert_allclose(np.asarray(o), [float((r - 1) % n)])
+
+
+def test_xla_barrier(xla_group):
+    xla_group.barrier()
