@@ -84,6 +84,17 @@ class CoreClient:
             "register_worker", worker_id=self.worker_id.binary(), pid=os.getpid(),
             port=self.direct_port, is_driver=self.is_driver,
             node_id=bytes.fromhex(node_id_hex) if node_id_hex else None)
+        if self.is_driver:
+            # minimal runtime-env: ship the driver's import roots so workers
+            # can resolve by-reference pickles of driver-local modules (the
+            # reference solves this with runtime_env working_dir packaging)
+            import json as _json
+            import sys as _sys
+
+            await self.conn.request(
+                "kv_put", ns="cluster", key=b"driver_sys_path",
+                value=_json.dumps(
+                    [p for p in _sys.path if p]).encode(), overwrite=True)
 
     def _handle_head_loss(self):
         if self.on_disconnect:

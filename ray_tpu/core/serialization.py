@@ -85,10 +85,14 @@ class SerializedObject:
         return cls(meta, buffers)
 
 
-class _Pickler(pickle.Pickler):
-    """Pickler that lowers device-resident jax Arrays to host numpy (device
-    buffers are process-local; zero-copy device paths use the device object
-    store instead, not byte serialization)."""
+import cloudpickle
+
+
+class _Pickler(cloudpickle.Pickler):
+    """cloudpickle (closures/lambdas ship by value) + a reducer that lowers
+    device-resident jax Arrays to host numpy (device buffers are
+    process-local; zero-copy device paths use the device object store
+    instead, not byte serialization)."""
 
     def reducer_override(self, obj):
         jax = sys.modules.get("jax")
@@ -96,7 +100,7 @@ class _Pickler(pickle.Pickler):
             import numpy as np
 
             return np.asarray(obj).__reduce_ex__(5)
-        return NotImplemented
+        return super().reducer_override(obj)
 
 
 def serialize(value: Any) -> SerializedObject:

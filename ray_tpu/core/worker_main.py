@@ -45,6 +45,20 @@ class WorkerRuntime:
         import ray_tpu.core.api as api
 
         api._attach_existing_client(self.client)
+        self._extend_sys_path()
+
+    def _extend_sys_path(self):
+        """Adopt the driver's import roots (same-machine runtime-env lite)."""
+        import json
+
+        try:
+            blob = self.client.kv_get("cluster", b"driver_sys_path")
+            if blob:
+                for p in json.loads(blob):
+                    if p not in sys.path and os.path.isdir(p):
+                        sys.path.append(p)
+        except Exception:
+            pass
 
     def _resolve_args(self, payload) -> tuple:
         if "inline" in payload:

@@ -1,3 +1,14 @@
+"""ray_tpu.train: distributed training orchestration + SPMD data plane.
+
+Orchestration layer parity: `ray.train` v2 (trainers, config, report/context,
+checkpoints). Data plane: `spmd.py` compiles sharded train steps (the part
+the reference leaves to user code).
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.session import (get_context, get_dataset_shard, report)
 from ray_tpu.train.spmd import (
     CompiledTrain,
     TrainState,
@@ -5,8 +16,15 @@ from ray_tpu.train.spmd import (
     compile_train,
     default_optimizer,
 )
+from ray_tpu.train.trainer import (DataParallelTrainer, JaxBackend, JaxTrainer,
+                                   Result, TrainingFailedError,
+                                   maybe_init_jax_distributed)
 
 __all__ = [
-    "CompiledTrain", "TrainState", "compile_gpt2_train", "compile_train",
-    "default_optimizer",
+    "Checkpoint", "CheckpointManager", "CheckpointConfig", "FailureConfig",
+    "RunConfig", "ScalingConfig", "get_context", "get_dataset_shard",
+    "report", "CompiledTrain", "TrainState", "compile_gpt2_train",
+    "compile_train", "default_optimizer", "DataParallelTrainer", "JaxBackend",
+    "JaxTrainer", "Result", "TrainingFailedError",
+    "maybe_init_jax_distributed",
 ]

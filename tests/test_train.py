@@ -1,0 +1,149 @@
+"""Train library: controller, worker group, report/checkpoint, failure policy.
+
+Mirrors the reference's Train v2 test strategy
+(`python/ray/train/v2/tests/test_controller.py` with dummy workers; fault
+tolerance via induced worker kills, SURVEY §4.1).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (Checkpoint, CheckpointConfig, FailureConfig,
+                           RunConfig, ScalingConfig)
+from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.trainer import DataParallelTrainer, TrainingFailedError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, max_workers=16)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path / "store"),
+        CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="acc"))
+    for i, acc in enumerate([0.1, 0.9, 0.5]):
+        src = tmp_path / f"ckpt{i}"
+        src.mkdir()
+        (src / "model.txt").write_text(str(acc))
+        mgr.register(Checkpoint(str(src)), {"acc": acc})
+    assert len(mgr.tracked) == 2
+    best = mgr.best_checkpoint()
+    assert (open(os.path.join(best.path, "model.txt")).read()) == "0.9"
+    # restore from manifest
+    mgr2 = CheckpointManager.restore(str(tmp_path / "store"))
+    assert len(mgr2.tracked) == 2
+
+
+def _train_fn(config):
+    import tempfile
+
+    ctx = train.get_context()
+    for step in range(config["steps"]):
+        metrics = {"step": step, "loss": 1.0 / (step + 1),
+                   "rank": ctx.get_world_rank(),
+                   "world": ctx.get_world_size()}
+        if ctx.get_world_rank() == 0 and step == config["steps"] - 1:
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "weights.txt"), "w") as f:
+                f.write(f"step={step}")
+            train.report(metrics, checkpoint=Checkpoint(d))
+        else:
+            train.report(metrics)
+
+
+def test_data_parallel_trainer(cluster, tmp_path):
+    trainer = DataParallelTrainer(
+        _train_fn,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["world"] == 2
+    assert result.checkpoint is not None
+    assert open(os.path.join(result.checkpoint.path, "weights.txt")).read() == "step=2"
+
+
+def _failing_fn(config):
+    ctx = train.get_context()
+    marker = config["marker"]
+    if ctx.get_world_rank() == 0 and not os.path.exists(marker):
+        open(marker, "w").close()
+        raise RuntimeError("injected failure")
+    train.report({"ok": True, "attempt": 2})
+
+
+def test_failure_policy_restart(cluster, tmp_path):
+    trainer = DataParallelTrainer(
+        _failing_fn,
+        train_loop_config={"marker": str(tmp_path / "failed_once")},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="t2", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    )
+    result = trainer.fit()
+    assert result.restarts == 1
+    assert result.metrics["ok"] is True
+
+
+def test_failure_policy_exhausted(cluster, tmp_path):
+    def always_fail(config):
+        raise RuntimeError("always broken")
+
+    trainer = DataParallelTrainer(
+        always_fail,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="t3", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=0)),
+    )
+    with pytest.raises(TrainingFailedError, match="always broken"):
+        trainer.fit()
+
+
+def _resume_fn(config):
+    ctx = train.get_context()
+    start = 0
+    ck = ctx.get_checkpoint()
+    if ck is not None:
+        start = int(open(os.path.join(ck.path, "step.txt")).read()) + 1
+    import tempfile
+
+    for step in range(start, config["until"]):
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "step.txt"), "w") as f:
+            f.write(str(step))
+        train.report({"step": step, "resumed_from": start},
+                     checkpoint=Checkpoint(d))
+
+
+def test_resume_from_checkpoint(cluster, tmp_path):
+    cfg = dict(
+        train_loop_config={"until": 2},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+    )
+    t1 = DataParallelTrainer(
+        _resume_fn, run_config=RunConfig(name="t4", storage_path=str(tmp_path)),
+        **cfg)
+    r1 = t1.fit()
+    t2 = DataParallelTrainer(
+        _resume_fn, run_config=RunConfig(name="t4b", storage_path=str(tmp_path)),
+        resume_from_checkpoint=r1.checkpoint,
+        train_loop_config={"until": 4},
+        scaling_config=cfg["scaling_config"])
+    r2 = t2.fit()
+    assert r2.metrics["resumed_from"] == 2
+    assert r2.metrics["step"] == 3
