@@ -283,6 +283,17 @@ class CoreClient:
             cfut = self._pending_calls.get(oid)
         return cfut is not None and not cfut.done()
 
+    def add_done_callback(self, ref: ObjectRef, cb) -> None:
+        """Invoke cb() once the in-flight actor call behind `ref` completes
+        (immediately if already resolved). Client-side routing bookkeeping
+        (Serve router) relies on this."""
+        with self._pending_lock:
+            cfut = self._pending_calls.get(ref.id)
+        if cfut is None:
+            cb()
+        else:
+            cfut.add_done_callback(lambda f: cb())
+
     def free(self, refs: Sequence[ObjectRef]) -> None:
         for r in refs:
             with self._pending_lock:

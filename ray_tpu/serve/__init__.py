@@ -1,0 +1,14 @@
+"""ray_tpu.serve: model serving — controller, replicas, routing, batching,
+autoscaling. Reference: `python/ray/serve/` (SURVEY §2.5)."""
+
+from ray_tpu.serve.api import (Deployment, delete, deployment,
+                               get_deployment_handle, run, shutdown, status)
+from ray_tpu.serve.autoscaling import AutoscalingConfig
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Deployment", "deployment", "run", "delete", "shutdown", "status",
+    "get_deployment_handle", "AutoscalingConfig", "batch",
+    "DeploymentHandle", "DeploymentResponse",
+]

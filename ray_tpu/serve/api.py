@@ -1,0 +1,145 @@
+"""Serve public API: @deployment, run, get handle, shutdown.
+
+Parity with `python/ray/serve/api.py` (`serve.run` :665, `@serve.deployment`)
+and `deployment.py`. The controller is a named actor
+("serve-controller"), found or created on demand like the reference's
+detached ServeController.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.autoscaling import AutoscalingConfig
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+CONTROLLER_NAME = "serve-controller"
+
+
+@dataclasses.dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: Optional[Dict[str, Any]] = None
+    max_ongoing_requests: int = 8
+    user_config: Any = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    init_args: tuple = ()
+    init_kwargs: Optional[dict] = None
+    visible_chips: Optional[list] = None
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        return dataclasses.replace(self, init_args=args, init_kwargs=kwargs)
+
+    def options(self, **overrides) -> "Deployment":
+        return dataclasses.replace(self, **overrides)
+
+    def to_config(self) -> dict:
+        num = self.num_replicas
+        auto = self.autoscaling_config
+        if isinstance(auto, dict):
+            auto = AutoscalingConfig(**auto)
+        return {
+            "callable": self.func_or_class,
+            "num_replicas": num,
+            "ray_actor_options": self.ray_actor_options,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "user_config": self.user_config,
+            "autoscaling_config": auto,
+            "init_args": self.init_args,
+            "init_kwargs": self.init_kwargs,
+            "visible_chips": self.visible_chips,
+        }
+
+
+def deployment(_func_or_class: Optional[Callable] = None, *,
+               name: Optional[str] = None, num_replicas: int = 1,
+               ray_actor_options: Optional[dict] = None,
+               max_ongoing_requests: int = 8,
+               user_config: Any = None,
+               autoscaling_config: Optional[Any] = None):
+    def deco(obj):
+        return Deployment(
+            func_or_class=obj,
+            name=name or getattr(obj, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            ray_actor_options=ray_actor_options,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            autoscaling_config=autoscaling_config)
+
+    if _func_or_class is not None:
+        return deco(_func_or_class)
+    return deco
+
+
+def _get_or_create_controller():
+    from ray_tpu.core.api import _auto_init, get_actor
+
+    _auto_init()
+    try:
+        return get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return ServeController.options(
+            name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=16,
+            num_cpus=0).remote()
+
+
+def run(target: Deployment, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None,
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy and return a handle (reference serve.run)."""
+    controller = _get_or_create_controller()
+    dep_name = name or target.name
+    ray_tpu.get(controller.deploy.remote(dep_name, target.to_config()),
+                timeout=60)
+    handle = DeploymentHandle(dep_name, controller)
+    if _blocking:
+        _wait_healthy(controller, dep_name)
+    return handle
+
+
+def _wait_healthy(controller, dep_name: str, timeout: float = 60):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+        d = status.get(dep_name)
+        if d and d["running"] >= min(d["target"], 1):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"deployment {dep_name} did not become ready")
+
+
+def get_deployment_handle(deployment_name: str) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, _get_or_create_controller())
+
+
+def status() -> dict:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+
+
+def delete(deployment_name: str) -> None:
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(deployment_name),
+                timeout=60)
+
+
+def shutdown() -> None:
+    from ray_tpu.core.api import get_actor
+
+    try:
+        controller = get_actor(CONTROLLER_NAME)
+    except (ValueError, RuntimeError):
+        return
+    try:
+        ray_tpu.get(controller.shutdown_serve.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

@@ -1,0 +1,40 @@
+"""Serve autoscaling policy.
+
+Parity with `python/ray/serve/autoscaling_policy.py:13
+_calculate_desired_num_replicas` + AutoscalingConfig fields
+(`serve/config.py:186` target_ongoing_requests, min/max_replicas,
+upscale/downscale smoothing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_ongoing_requests: float = 2.0
+    upscale_smoothing_factor: float = 1.0
+    downscale_smoothing_factor: float = 1.0
+    look_back_period_s: float = 2.0
+
+
+def calculate_desired_num_replicas(config: AutoscalingConfig,
+                                   total_ongoing_requests: float,
+                                   current_num_replicas: int) -> int:
+    if current_num_replicas == 0:
+        return max(config.min_replicas, 1)
+    per_replica = total_ongoing_requests / current_num_replicas
+    error_ratio = per_replica / max(config.target_ongoing_requests, 1e-9)
+    if error_ratio > 1:
+        smoothed = 1 + (error_ratio - 1) * config.upscale_smoothing_factor
+        desired = math.ceil(current_num_replicas * smoothed)
+    else:
+        smoothed = 1 - (1 - error_ratio) * config.downscale_smoothing_factor
+        desired = math.floor(current_num_replicas * smoothed)
+        desired = max(desired, 1) if total_ongoing_requests > 0 else desired
+    return int(min(max(desired, config.min_replicas), config.max_replicas))

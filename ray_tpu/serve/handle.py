@@ -1,0 +1,130 @@
+"""DeploymentHandle + client-side router (power-of-two-choices).
+
+Parity with `python/ray/serve/handle.py` (DeploymentHandle/DeploymentResponse)
+and `_private/router.py:368` + `request_router/pow_2_router.py`: the handle
+tracks per-replica in-flight counts locally, samples two replicas and picks
+the shorter queue — queue-length probes are replaced by completion callbacks
+on the submitted calls (same staleness tradeoff the reference accepts).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.core.api import _global_client
+
+ROUTING_TABLE_REFRESH_S = 1.0
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the result ObjectRef."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller,
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._method_name = method_name
+        self._table: Dict[str, Any] = {}
+        self._table_version = -1
+        self._table_ts = 0.0
+        self._inflight: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # --------------------------------------------------------------- remote
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self._controller,
+                             method_name)
+        h._table, h._table_version = self._table, self._table_version
+        h._table_ts, h._inflight = self._table_ts, self._inflight
+        h._lock = self._lock
+        return h
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._submit(self._method_name, args, kwargs)
+
+    def _submit(self, method: str, args, kwargs) -> DeploymentResponse:
+        replica_tag, handle = self._pick_replica()
+        with self._lock:
+            self._inflight[replica_tag] = self._inflight.get(replica_tag, 0) + 1
+        ref = handle.handle_request.remote(method, args, kwargs)
+
+        def _done():
+            with self._lock:
+                self._inflight[replica_tag] = max(
+                    0, self._inflight.get(replica_tag, 1) - 1)
+
+        _global_client().add_done_callback(ref, _done)
+        self._maybe_push_metrics()
+        return DeploymentResponse(ref)
+
+    # --------------------------------------------------------------- router
+    def _refresh_table(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._table_ts < ROUTING_TABLE_REFRESH_S:
+            return
+        table = ray_tpu.get(self._controller.get_routing_table.remote(
+            self.deployment_name), timeout=30)
+        if table is None:
+            raise KeyError(f"deployment {self.deployment_name!r} not found")
+        with self._lock:
+            self._table = table["replicas"]
+            self._table_version = table["version"]
+            self._table_ts = now
+            self._inflight = {t: self._inflight.get(t, 0) for t in self._table}
+
+    def _pick_replica(self):
+        self._refresh_table()
+        deadline = time.monotonic() + 30
+        while not self._table:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment {self.deployment_name!r}")
+            time.sleep(0.1)
+            self._refresh_table(force=True)
+        with self._lock:
+            tags = list(self._table)
+            if len(tags) == 1:
+                tag = tags[0]
+            else:  # power of two choices on local in-flight counts
+                a, b = random.sample(tags, 2)
+                tag = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+            return tag, self._table[tag]
+
+    def _maybe_push_metrics(self):
+        with self._lock:
+            total = sum(self._inflight.values())
+        try:
+            self._controller.record_handle_metrics.remote(
+                self.deployment_name, total)
+        except Exception:
+            pass
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._submit(self._method, args, kwargs)

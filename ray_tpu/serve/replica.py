@@ -1,0 +1,93 @@
+"""Replica actor: wraps the user's deployment callable.
+
+Parity with `python/ray/serve/_private/replica.py`: runs user __init__ once,
+serves requests with an ongoing-request gauge, health checks, reconfigure
+with user_config, graceful drain. TPU twist: a replica scheduled with
+`num_tpu_chips=k` pins itself to k chips via TPU_VISIBLE_CHIPS before any
+jax import, so multiple replicas subdivide a host (reference
+`tpu.py:283-323` set_current_process_visible_accelerator_ids).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    def __init__(self, deployment_name: str, replica_tag: str,
+                 cls_or_fn, init_args, init_kwargs, user_config,
+                 visible_chips: Optional[list] = None):
+        if visible_chips:
+            from ray_tpu.core.resources import set_visible_chips
+
+            set_visible_chips(visible_chips)
+        self.deployment_name = deployment_name
+        self.replica_tag = replica_tag
+        self._ongoing = 0
+        self._ongoing_lock = threading.Lock()
+        self._total = 0
+        self._healthy = True
+        self._draining = False
+        if isinstance(cls_or_fn, type):
+            self.callable = cls_or_fn(*(init_args or ()), **(init_kwargs or {}))
+        else:
+            self.callable = cls_or_fn
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    def _apply_user_config(self, user_config):
+        reconfigure = getattr(self.callable, "reconfigure", None)
+        if reconfigure is not None:
+            reconfigure(user_config)
+
+    # ------------------------------------------------------------- requests
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        if self._draining:
+            raise RuntimeError(f"replica {self.replica_tag} is draining")
+        with self._ongoing_lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = (self.callable if method == "__call__"
+                      and not isinstance(self.callable, type)
+                      and callable(self.callable)
+                      else None)
+            if target is None or method != "__call__":
+                target = getattr(self.callable, method)
+            return target(*args, **kwargs)
+        finally:
+            with self._ongoing_lock:
+                self._ongoing -= 1
+
+    # -------------------------------------------------------------- control
+    def reconfigure(self, user_config):
+        self._apply_user_config(user_config)
+        return True
+
+    def check_health(self):
+        user_check = getattr(self.callable, "check_health", None)
+        if user_check is not None:
+            try:
+                user_check()
+            except Exception:
+                self._healthy = False
+                return {"healthy": False, "detail": traceback.format_exc()}
+        return {"healthy": True, "ongoing": self._ongoing,
+                "total": self._total}
+
+    def queue_len(self):
+        return self._ongoing
+
+    def prepare_for_shutdown(self, drain_timeout_s: float = 5.0):
+        self._draining = True
+        deadline = time.monotonic() + drain_timeout_s
+        while self._ongoing > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        shutdown = getattr(self.callable, "__del__", None)
+        return self._ongoing == 0
