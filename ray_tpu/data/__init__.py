@@ -1,0 +1,14 @@
+"""ray_tpu.data: streaming dataset engine (reference: python/ray/data/,
+SURVEY §2.6) — lazy plans, fused per-block tasks, bounded-window streaming."""
+
+from ray_tpu.data.block import Block
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.read_api import (from_items, from_numpy, from_pandas, range,
+                                   read_binary_files, read_csv, read_json,
+                                   read_numpy, read_parquet, read_text)
+
+__all__ = [
+    "Block", "Dataset", "GroupedData", "range", "from_items", "from_numpy",
+    "from_pandas", "read_parquet", "read_csv", "read_json", "read_text",
+    "read_binary_files", "read_numpy",
+]

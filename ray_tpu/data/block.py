@@ -1,0 +1,92 @@
+"""Block format + accessor.
+
+Parity with `python/ray/data/block.py` + `_internal/arrow_block.py` in
+miniature: a block is either a column dict of numpy arrays (tabular; the
+TPU-relevant case — token batches feed jax directly) or a plain list of rows.
+The accessor hides the difference for slicing/concat/batching.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Union
+
+import numpy as np
+
+Block = Union[Dict[str, np.ndarray], List[Any]]
+
+
+def block_len(block: Block) -> int:
+    if isinstance(block, dict):
+        return len(next(iter(block.values()))) if block else 0
+    return len(block)
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    if isinstance(block, dict):
+        return {k: v[start:end] for k, v in block.items()}
+    return block[start:end]
+
+
+def block_concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if block_len(b) > 0]
+    if not blocks:
+        return []
+    if isinstance(blocks[0], dict):
+        keys = blocks[0].keys()
+        return {k: np.concatenate([np.asarray(b[k]) for b in blocks])
+                for k in keys}
+    out: List[Any] = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+def block_to_batch(block: Block, batch_format: str) -> Any:
+    if batch_format in ("numpy", "default"):
+        return block
+    if batch_format == "pandas":
+        import pandas as pd
+
+        if isinstance(block, dict):
+            return pd.DataFrame(block)
+        return pd.DataFrame({"item": block})
+    if batch_format == "pyarrow":
+        import pyarrow as pa
+
+        if isinstance(block, dict):
+            return pa.table({k: pa.array(v) for k, v in block.items()})
+        return pa.table({"item": pa.array(block)})
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def batch_to_block(batch: Any) -> Block:
+    """Normalize a user-returned batch into a block."""
+    if isinstance(batch, (dict, list)):
+        if isinstance(batch, dict):
+            return {k: np.asarray(v) for k, v in batch.items()}
+        return batch
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return {c: batch[c].to_numpy() for c in batch.columns}
+    except ImportError:
+        pass
+    try:
+        import pyarrow as pa
+
+        if isinstance(batch, pa.Table):
+            return {name: batch.column(name).to_numpy(zero_copy_only=False)
+                    for name in batch.column_names}
+    except ImportError:
+        pass
+    raise TypeError(f"unsupported batch type {type(batch)}")
+
+
+def rows_of(block: Block) -> Iterable[Any]:
+    if isinstance(block, dict):
+        keys = list(block)
+        for i in range(block_len(block)):
+            yield {k: block[k][i] for k in keys}
+    else:
+        yield from block

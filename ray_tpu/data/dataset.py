@@ -1,0 +1,310 @@
+"""Dataset: lazy logical plan + streaming execution over the task runtime.
+
+Parity (miniature) with `python/ray/data/dataset.py` +
+`_internal/execution/streaming_executor.py:61`: transformations build a lazy
+plan; execution fuses consecutive per-block ops into one task per block and
+streams blocks through with bounded in-flight tasks (backpressure = window
+size). Barrier ops (repartition/shuffle/sort/groupby) materialize.
+
+TPU-first notes: blocks are numpy column dicts that feed `jax.device_put`
+directly; `iter_batches` re-batches across block boundaries so a fixed
+training batch shape (static XLA shapes!) is always delivered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import (Block, batch_to_block, block_concat,
+                                block_len, block_slice, block_to_batch,
+                                rows_of)
+
+DEFAULT_WINDOW = 8  # in-flight block tasks (concurrency cap backpressure)
+
+
+# ----------------------------------------------------------- logical plan
+@dataclasses.dataclass
+class _Op:
+    kind: str                  # "map_batches" | "map" | "filter" | "flat_map"
+    fn: Callable               # | "repartition" | "shuffle" | "sort" | "limit"
+    arg: Any = None
+    batch_format: str = "numpy"
+
+
+def _apply_op(block: Block, op: _Op) -> Block:
+    if op.kind == "map_batches":
+        batch = block_to_batch(block, op.batch_format)
+        return batch_to_block(op.fn(batch))
+    if op.kind == "map":
+        return _rows_to_block([op.fn(r) for r in rows_of(block)])
+    if op.kind == "filter":
+        return _rows_to_block([r for r in rows_of(block) if op.fn(r)])
+    if op.kind == "flat_map":
+        out = []
+        for r in rows_of(block):
+            out.extend(op.fn(r))
+        return _rows_to_block(out)
+    raise ValueError(f"not a per-block op: {op.kind}")
+
+
+def _rows_to_block(items: List[Any]) -> Block:
+    if items and isinstance(items[0], dict) and all(
+            isinstance(r, dict) for r in items):
+        keys = items[0].keys()
+        if all(r.keys() == keys for r in items):
+            return {k: np.asarray([r[k] for r in items]) for k in keys}
+    return items
+
+
+def _exec_chain(source, ops: List[_Op]) -> Block:
+    block = source() if callable(source) else source
+    for op in ops:
+        block = _apply_op(block, op)
+    return block
+
+
+class Dataset:
+    """Lazy, immutable; every transform returns a new Dataset."""
+
+    def __init__(self, partitions: List[Any], ops: Optional[List[_Op]] = None,
+                 parallelism: int = DEFAULT_WINDOW):
+        # partitions: read thunks (callables) or ObjectRefs of blocks
+        self._partitions = partitions
+        self._ops = ops or []
+        self._parallelism = parallelism
+
+    # ----------------------------------------------------------- transforms
+    def _with_op(self, op: _Op) -> "Dataset":
+        return Dataset(self._partitions, self._ops + [op], self._parallelism)
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    **_ignored) -> "Dataset":
+        return self._with_op(_Op("map_batches", fn, batch_format=batch_format))
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with_op(_Op("map", fn))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with_op(_Op("filter", fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with_op(_Op("flat_map", fn))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = self._barrier_blocks()
+        for o in others:
+            blocks.extend(o._barrier_blocks())
+        return Dataset(blocks, [], self._parallelism)
+
+    def limit(self, n: int) -> "Dataset":
+        out: List[Block] = []
+        total = 0
+        for block in self._stream_blocks():
+            take = min(n - total, block_len(block))
+            if take > 0:
+                out.append(block_slice(block, 0, take))
+                total += take
+            if total >= n:
+                break
+        return Dataset(out, [], self._parallelism)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        full = block_concat(list(self._stream_blocks()))
+        n = block_len(full)
+        sizes = [n // num_blocks + (1 if i < n % num_blocks else 0)
+                 for i in range(num_blocks)]
+        blocks, off = [], 0
+        for s in sizes:
+            blocks.append(block_slice(full, off, off + s))
+            off += s
+        return Dataset(blocks, [], self._parallelism)
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        n_parts = max(len(self._partitions), 1)
+        full = block_concat(list(self._stream_blocks()))
+        n = block_len(full)
+        perm = np.random.default_rng(seed).permutation(n)
+        if isinstance(full, dict):
+            shuffled: Block = {k: v[perm] for k, v in full.items()}
+        else:
+            shuffled = [full[i] for i in perm]
+        return Dataset([shuffled], [], self._parallelism).repartition(n_parts)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        full = block_concat(list(self._stream_blocks()))
+        if isinstance(full, dict):
+            order = np.argsort(full[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            return Dataset([{k: v[order] for k, v in full.items()}], [],
+                           self._parallelism)
+        items = sorted(full, key=lambda r: r[key], reverse=descending)
+        return Dataset([items], [], self._parallelism)
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ------------------------------------------------------------ execution
+    def _stream_blocks(self) -> Iterator[Block]:
+        """The streaming executor: fused per-block tasks, bounded window."""
+        import ray_tpu
+
+        if not self._partitions:
+            return
+        use_tasks = ray_tpu.is_initialized() and (
+            len(self._partitions) > 1 or self._ops)
+        if not use_tasks:
+            for p in self._partitions:
+                yield _exec_chain(p, self._ops)
+            return
+
+        exec_task = ray_tpu.remote(_exec_chain)
+        window = self._parallelism
+        pending: List[Any] = []
+        idx = 0
+        emitted = 0
+        results: Dict[int, Any] = {}
+        submitted = {}
+        while emitted < len(self._partitions):
+            while idx < len(self._partitions) and len(pending) < window:
+                ref = exec_task.remote(self._partitions[idx], self._ops)
+                submitted[ref] = idx
+                pending.append(ref)
+                idx += 1
+            if not pending:
+                break
+            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=300)
+            for ref in ready:
+                results[submitted[ref]] = ray_tpu.get(ref)
+            # emit in order (deterministic iteration, like ordered execution)
+            while emitted in results:
+                yield results.pop(emitted)
+                emitted += 1
+
+    def _barrier_blocks(self) -> List[Block]:
+        return list(self._stream_blocks())
+
+    # ----------------------------------------------------------- consumers
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        carry: Optional[Block] = None
+        for block in self._stream_blocks():
+            if carry is not None:
+                block = block_concat([carry, block])
+                carry = None
+            off = 0
+            n = block_len(block)
+            while n - off >= batch_size:
+                yield block_to_batch(block_slice(block, off, off + batch_size),
+                                     batch_format)
+                off += batch_size
+            if off < n:
+                carry = block_slice(block, off, n)
+        if carry is not None and not drop_last:
+            yield block_to_batch(carry, batch_format)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._stream_blocks():
+            yield from rows_of(block)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(block_len(b) for b in self._stream_blocks())
+
+    def schema(self) -> Optional[List[str]]:
+        for block in self._stream_blocks():
+            if isinstance(block, dict):
+                return list(block)
+            return None
+        return None
+
+    def materialize(self) -> "Dataset":
+        return Dataset(self._barrier_blocks(), [], self._parallelism)
+
+    def num_blocks(self) -> int:
+        return len(self._partitions)
+
+    # --------------------------------------------------------------- splits
+    def split(self, n: int) -> List["Dataset"]:
+        """Shard by partition round-robin (train ingest: one shard per
+        worker; reference streaming_split)."""
+        shards: List[List[Any]] = [[] for _ in range(n)]
+        for i, p in enumerate(self._partitions):
+            shards[i % n].append(p)
+        return [Dataset(s, list(self._ops), self._parallelism) for s in shards]
+
+    streaming_split = split
+
+    # -------------------------------------------------------------- writers
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._stream_blocks()):
+            table = block_to_batch(block, "pyarrow")
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._partitions)}, "
+                f"ops={[o.kind for o in self._ops]})")
+
+
+class GroupedData:
+    """Hash-partitioned groupby + aggregations (miniature hash_shuffle)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _groups(self) -> Dict[Any, Block]:
+        import collections
+
+        groups: Dict[Any, List[Any]] = collections.defaultdict(list)
+        for row in self._ds.iter_rows():
+            groups[row[self._key]].append(row)
+        return {k: _rows_to_block(v) for k, v in groups.items()}
+
+    def _agg(self, col: str, fn: Callable, name: str) -> Dataset:
+        rows = [{self._key: k, name: fn(np.asarray(block[col]))}
+                for k, block in sorted(self._groups().items())]
+        return Dataset([_rows_to_block(rows)])
+
+    def count(self) -> Dataset:
+        rows = [{self._key: k, "count": block_len(b)}
+                for k, b in sorted(self._groups().items())]
+        return Dataset([_rows_to_block(rows)])
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg(col, np.sum, f"sum({col})")
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg(col, np.mean, f"mean({col})")
+
+    def min(self, col: str) -> Dataset:
+        return self._agg(col, np.min, f"min({col})")
+
+    def max(self, col: str) -> Dataset:
+        return self._agg(col, np.max, f"max({col})")
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        blocks = [batch_to_block(fn(block_to_batch(b, "numpy")))
+                  for _, b in sorted(self._groups().items())]
+        return Dataset(blocks)

@@ -1,0 +1,150 @@
+"""Dataset creation: range/from_items/from_numpy + file IO connectors.
+
+Parity (core subset) with `python/ray/data/read_api.py`: parquet/csv/json/
+text/binary/numpy readers produce one read thunk per file (or per range
+shard), executed lazily by the streaming executor.
+"""
+
+from __future__ import annotations
+
+import functools
+import glob as glob_mod
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in glob_mod.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f) and not os.path.basename(f).startswith(".")))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    parallelism = max(1, min(parallelism, n or 1))
+    bounds = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def make(lo: int, hi: int):
+        return lambda: {"id": np.arange(lo, hi, dtype=np.int64)}
+
+    return Dataset([make(int(lo), int(hi))
+                    for lo, hi in zip(bounds[:-1], bounds[1:])])
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    shards = np.array_split(np.arange(len(items)), parallelism)
+
+    def make(idx):
+        chunk = [items[i] for i in idx]
+        return lambda: chunk
+
+    return Dataset([make(idx) for idx in shards if len(idx)])
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *, parallelism: int = 8) -> Dataset:
+    n = len(next(iter(arrays.values())))
+    bounds = np.linspace(0, n, max(1, parallelism) + 1, dtype=np.int64)
+
+    def make(lo, hi):
+        chunk = {k: v[lo:hi] for k, v in arrays.items()}
+        return lambda: chunk
+
+    return Dataset([make(int(lo), int(hi))
+                    for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo])
+
+
+def from_pandas(df) -> Dataset:
+    return from_numpy({c: df[c].to_numpy() for c in df.columns})
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path, columns=columns)
+        return {name: table.column(name).to_numpy(zero_copy_only=False)
+                for name in table.column_names}
+
+    return Dataset([functools.partial(read_one, f) for f in files])
+
+
+def read_csv(paths, **csv_kwargs) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        import pandas as pd
+
+        df = pd.read_csv(path, **csv_kwargs)
+        return {c: df[c].to_numpy() for c in df.columns}
+
+    return Dataset([functools.partial(read_one, f) for f in files])
+
+
+def read_json(paths, *, lines: bool = True) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        import json
+
+        rows = []
+        with open(path) as f:
+            if lines:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+            else:
+                data = json.load(f)
+                rows = data if isinstance(data, list) else [data]
+        return rows
+
+    return Dataset([functools.partial(read_one, f) for f in files])
+
+
+def read_text(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        with open(path) as f:
+            return {"text": np.asarray([ln.rstrip("\n") for ln in f],
+                                       dtype=object)}
+
+    return Dataset([functools.partial(read_one, f) for f in files])
+
+
+def read_binary_files(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        with open(path, "rb") as f:
+            return [{"path": path, "bytes": f.read()}]
+
+    return Dataset([functools.partial(read_one, f) for f in files])
+
+
+def read_numpy(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        arr = np.load(path)
+        return {"data": arr}
+
+    return Dataset([functools.partial(read_one, f) for f in files])
