@@ -1,0 +1,25 @@
+"""ray_tpu.tune: hyperparameter optimization (reference: python/ray/tune/,
+SURVEY §2.7). `tune.report` shares the train session (a trial IS a 1-worker
+train run, matching the reference's Trainable/Train unification in v2)."""
+
+from ray_tpu.train.session import get_context, report  # noqa: F401
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.search import (BasicVariantGenerator, choice, grid_search,
+                                 loguniform, randint, uniform)
+from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
+                                with_resources)
+
+
+def get_checkpoint():
+    return get_context().get_checkpoint()
+
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "with_resources",
+    "report", "get_checkpoint", "get_context",
+    "choice", "uniform", "loguniform", "randint", "grid_search",
+    "BasicVariantGenerator", "FIFOScheduler", "ASHAScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+]

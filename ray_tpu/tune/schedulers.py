@@ -1,0 +1,151 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+Parity (core subset) with `python/ray/tune/schedulers/`: ASHA
+(`async_hyperband.py` rung-based promotion), MedianStoppingRule, and
+PopulationBasedTraining (exploit top quantile + mutate, restart from donor
+checkpoint).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        pass
+
+
+class ASHAScheduler(FIFOScheduler):
+    """Async successive halving: at rungs grace_period * rf^k, stop trials
+    outside the top 1/reduction_factor of results seen at that rung."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_results: Dict[int, List[float]] = defaultdict(list)
+
+    def _better(self, a: float) -> float:
+        return a if self.mode == "min" else -a
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        score = result.get(self.metric)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in self.rungs:
+            if t == rung:
+                recorded = self.rung_results[rung]
+                recorded.append(self._better(float(score)))
+                k = max(1, len(recorded) // self.rf)
+                cutoff = sorted(recorded)[k - 1]
+                if self._better(float(score)) > cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(FIFOScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same step."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 1,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.time_attr = time_attr
+        self.history: Dict[str, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        score = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if score is None:
+            return CONTINUE
+        sign = 1.0 if self.mode == "min" else -1.0
+        self.history[trial_id].append(sign * float(score))
+        if t < self.grace or len(self.history) < 3:
+            return CONTINUE
+        my_avg = sum(self.history[trial_id]) / len(self.history[trial_id])
+        others = [sum(v) / len(v) for k, v in self.history.items()
+                  if k != trial_id]
+        others.sort()
+        median = others[len(others) // 2]
+        return STOP if my_avg > median else CONTINUE
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    """PBT (reference schedulers/pbt.py): every perturbation_interval
+    results, bottom-quantile trials adopt a top-quantile trial's config
+    (mutated) and checkpoint. The controller executes the decision
+    ("EXPLOIT", donor_trial_id, new_config)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 2,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: Optional[int] = None,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self.rng = random.Random(seed)
+        self.latest: Dict[str, float] = {}
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]):
+        score = result.get(self.metric)
+        if score is None:
+            return CONTINUE
+        sign = -1.0 if self.mode == "min" else 1.0
+        self.latest[trial_id] = sign * float(score)
+        self.counts[trial_id] += 1
+        if self.counts[trial_id] % self.interval or len(self.latest) < 4:
+            return CONTINUE
+        ranked = sorted(self.latest, key=self.latest.get, reverse=True)
+        k = max(1, int(len(ranked) * self.quantile))
+        if trial_id in ranked[-k:]:
+            donor = self.rng.choice(ranked[:k])
+            if donor != trial_id:
+                return ("EXPLOIT", donor, self._mutate)
+        return CONTINUE
+
+    def _mutate(self, donor_config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        cfg = dict(donor_config)
+        for key, spec in self.mutations.items():
+            if isinstance(spec, list):
+                cfg[key] = self.rng.choice(spec)
+            elif isinstance(spec, Domain):
+                cfg[key] = spec.sample(self.rng)
+            elif callable(spec):
+                cfg[key] = spec()
+            elif key in cfg and isinstance(cfg[key], (int, float)):
+                cfg[key] = cfg[key] * self.rng.choice([0.8, 1.2])
+        return cfg

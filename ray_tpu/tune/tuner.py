@@ -1,0 +1,195 @@
+"""Tuner + trial controller.
+
+Parity (core subset) with `python/ray/tune/tuner.py` +
+`execution/tune_controller.py`: an event loop managing trial actors (the
+TrainWorker actor is reused as the trial host — same report/poll/stop
+surface), searchers generating variants, schedulers deciding early stops and
+PBT exploits, per-trial checkpoint tracking, ResultGrid output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.worker_group import TrainWorker
+from ray_tpu.tune import schedulers as sched_lib
+from ray_tpu.tune.search import BasicVariantGenerator
+
+POLL_S = 0.1
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 8
+    scheduler: Optional[Any] = None
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+    history: List[Dict[str, Any]]
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self.results = results
+        self._metric = metric
+        self._mode = mode
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self.results
+                  if r.error is None and metric in (r.metrics or {})]
+        if not scored:
+            raise ValueError("no successful trials with the target metric")
+        key = lambda r: r.metrics[metric]
+        return (min if mode == "min" else max)(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {"trial_id": r.trial_id, **r.config, **(r.metrics or {}),
+             "error": bool(r.error)} for r in self.results])
+
+    def __len__(self):
+        return len(self.results)
+
+
+class _Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.id = trial_id
+        self.config = config
+        self.actor = None
+        self.state = "PENDING"
+        self.iteration = 0
+        self.last_metrics: Dict[str, Any] = {}
+        self.history: List[Dict[str, Any]] = []
+        self.error: Optional[str] = None
+        self.checkpoint_path: Optional[str] = None
+        self.resume_path: Optional[str] = None
+
+
+def with_resources(trainable: Callable, resources: Dict[str, float]):
+    trainable._tune_resources = dict(resources)
+    return trainable
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: Dict[str, Any],
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.trainable = trainable
+        self.param_space = param_space
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig(name=f"tune-{uuid.uuid4().hex[:6]}")
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> ResultGrid:
+        from ray_tpu.core.api import _auto_init
+
+        _auto_init()
+        storage = self.run_config.resolved_storage_path()
+        os.makedirs(storage, exist_ok=True)
+        scheduler = self.tune_config.scheduler or sched_lib.FIFOScheduler()
+        gen = BasicVariantGenerator(self.param_space,
+                                    self.tune_config.num_samples,
+                                    seed=self.tune_config.seed)
+        trials = [_Trial(f"trial_{i:04d}", cfg)
+                  for i, cfg in enumerate(gen.variants())]
+        pending = list(trials)
+        running: List[_Trial] = []
+        resources = getattr(self.trainable, "_tune_resources", {"CPU": 1})
+
+        while pending or running:
+            while pending and len(running) < self.tune_config.max_concurrent_trials:
+                t = pending.pop(0)
+                self._start_trial(t, resources)
+                running.append(t)
+            time.sleep(POLL_S)
+            for t in list(running):
+                try:
+                    st = ray_tpu.get(t.actor.poll.remote(), timeout=30)
+                except Exception:
+                    t.state = "ERRORED"
+                    t.error = "trial actor died"
+                    running.remove(t)
+                    continue
+                decision = sched_lib.CONTINUE
+                for rep in st["reports"]:
+                    t.iteration += 1
+                    metrics = dict(rep["metrics"])
+                    metrics.setdefault("training_iteration", t.iteration)
+                    t.last_metrics = metrics
+                    t.history.append(metrics)
+                    if rep["checkpoint_path"]:
+                        t.checkpoint_path = rep["checkpoint_path"]
+                    d = scheduler.on_result(t.id, metrics)
+                    if d != sched_lib.CONTINUE:
+                        decision = d
+                if st["error"]:
+                    t.state = "ERRORED"
+                    t.error = st["error"]
+                    running.remove(t)
+                    self._stop_actor(t)
+                elif st["done"]:
+                    t.state = "COMPLETED"
+                    running.remove(t)
+                    self._stop_actor(t)
+                elif decision == sched_lib.STOP:
+                    t.state = "STOPPED"
+                    running.remove(t)
+                    self._stop_actor(t)
+                elif isinstance(decision, tuple) and decision[0] == "EXPLOIT":
+                    _, donor_id, mutate = decision
+                    donor = next(d for d in trials if d.id == donor_id)
+                    self._exploit(t, donor, mutate)
+        results = [TrialResult(
+            trial_id=t.id, config=t.config, metrics=t.last_metrics,
+            checkpoint=Checkpoint(t.checkpoint_path) if t.checkpoint_path else None,
+            error=t.error, history=t.history) for t in trials]
+        return ResultGrid(results, self.tune_config.metric,
+                          self.tune_config.mode)
+
+    # -------------------------------------------------------------- helpers
+    def _start_trial(self, t: _Trial, resources: Dict[str, float]) -> None:
+        t.actor = TrainWorker.options(
+            resources=resources, num_cpus=resources.get("CPU", 0),
+            name=f"{self.run_config.name}-{t.id}-{uuid.uuid4().hex[:4]}").remote()
+        ray_tpu.get(t.actor.setup_and_start.remote(
+            self.trainable, t.config, 0, 1, 0, 0, t.resume_path, {}),
+            timeout=120)
+        t.state = "RUNNING"
+
+    def _stop_actor(self, t: _Trial) -> None:
+        if t.actor is not None:
+            try:
+                ray_tpu.kill(t.actor)
+            except Exception:
+                pass
+            t.actor = None
+
+    def _exploit(self, t: _Trial, donor: "_Trial", mutate) -> None:
+        """PBT: restart `t` from donor's checkpoint with mutated config."""
+        self._stop_actor(t)
+        t.config = mutate(donor.config)
+        t.resume_path = donor.checkpoint_path
+        resources = getattr(self.trainable, "_tune_resources", {"CPU": 1})
+        self._start_trial(t, resources)

@@ -1,0 +1,116 @@
+"""Tune library: search spaces, trial execution, ASHA early stopping, PBT."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune import (ASHAScheduler, PopulationBasedTraining, TuneConfig,
+                          Tuner)
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, max_workers=16)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_variant_generator():
+    gen = BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.choice([10]),
+         "c": "fixed"},
+        num_samples=2, seed=0)
+    variants = list(gen.variants())
+    assert len(variants) == 6
+    assert {v["a"] for v in variants} == {1, 2, 3}
+    assert all(v["b"] == 10 and v["c"] == "fixed" for v in variants)
+
+
+def _objective(config):
+    score = (config["x"] - 3) ** 2
+    for i in range(3):
+        tune.report({"score": score + (2 - i) * 0.1, "x": config["x"]})
+
+
+def test_tuner_grid(cluster, tmp_path):
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="score", mode="min", num_samples=1),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 5
+    best = grid.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == pytest.approx(0.0, abs=0.2)
+    df = grid.get_dataframe()
+    assert len(df) == 5
+
+
+def _long_objective(config):
+    import time
+
+    for step in range(1, 17):
+        time.sleep(0.05)  # real trials take time; lets the scheduler observe
+        # bad configs plateau high; good configs improve
+        loss = config["quality"] + 10.0 / step
+        tune.report({"loss": loss})
+
+
+def test_asha_stops_bad_trials(cluster, tmp_path):
+    tuner = Tuner(
+        _long_objective,
+        param_space={"quality": tune.grid_search([0.0, 0.5, 50.0, 80.0])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min",
+            scheduler=ASHAScheduler(metric="loss", mode="min", max_t=16,
+                                    grace_period=2, reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["quality"] in (0.0, 0.5)
+    # at least one bad trial got stopped before finishing all 16 reports
+    bad = [r for r in grid.results if r.config["quality"] >= 50.0]
+    assert any(len(r.history) < 16 for r in bad)
+
+
+def _pbt_objective(config):
+    import tempfile
+
+    ctx = tune.get_context()
+    start = 0
+    ck = tune.get_checkpoint()
+    if ck is not None:
+        start = int(open(os.path.join(ck.path, "it.txt")).read())
+    score = config["lr"] * 100
+    for it in range(start, start + 8):
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "it.txt"), "w") as f:
+            f.write(str(it + 1))
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        tune.report({"score": score + it * 0.01, "lr": config["lr"]},
+                    checkpoint=Checkpoint(d))
+
+
+def test_pbt_exploits(cluster, tmp_path):
+    tuner = Tuner(
+        _pbt_objective,
+        param_space={"lr": tune.grid_search([0.001, 0.002, 0.5, 1.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=PopulationBasedTraining(
+                metric="score", mode="max", perturbation_interval=3,
+                hyperparam_mutations={"lr": tune.loguniform(0.001, 1.0)},
+                seed=0)),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 50  # high-lr configs dominate
