@@ -59,6 +59,9 @@ class CoreClient:
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
         self.on_disconnect = None
+        # invoked synchronously inside the start coroutine, right after the
+        # head acks registration and before any pushed task handler can run
+        self.on_registered = None
 
     # ----------------------------------------------------------- lifecycle
     def _run_loop(self):
@@ -84,6 +87,8 @@ class CoreClient:
             "register_worker", worker_id=self.worker_id.binary(), pid=os.getpid(),
             port=self.direct_port, is_driver=self.is_driver,
             node_id=bytes.fromhex(node_id_hex) if node_id_hex else None)
+        if self.on_registered is not None:
+            self.on_registered(self.node_info)
         if self.is_driver:
             # minimal runtime-env: ship the driver's import roots so workers
             # can resolve by-reference pickles of driver-local modules (the

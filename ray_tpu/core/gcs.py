@@ -187,7 +187,8 @@ class Head:
                 node.starting_workers = max(0, node.starting_workers - 1)
                 self._kick()
             return {"node_id": node.node_id.binary(), "session": self.session,
-                    "resources": node.resources, "labels": node.labels}
+                    "resources": node.resources, "labels": node.labels,
+                    "driver_sys_path": self.kv.get(("cluster", b"driver_sys_path"))}
 
         async def register_node(node_id, resources, labels, max_workers):
             nid = NodeID(node_id)

@@ -40,23 +40,42 @@ class WorkerRuntime:
 
     # ------------------------------------------------------------ plumbing
     def start(self):
-        self.client.start(direct_handlers={"actor_call": self._on_actor_call})
-        self.client.on_disconnect = lambda: self.shutdown_event.set()
+        # Attach the global API client BEFORE registering with the head:
+        # registration makes this worker eligible for task dispatch, and a
+        # task using the ray_tpu API (nested .remote/get) must never observe
+        # an unset global client.
         import ray_tpu.core.api as api
 
         api._attach_existing_client(self.client)
-        self._extend_sys_path()
+        self.client.on_disconnect = lambda: self.shutdown_event.set()
+        self.client.on_registered = self._apply_sys_path
+        self.client.start(direct_handlers={"actor_call": self._on_actor_call})
+        if "driver_sys_path" not in (self.client.node_info or {}):
+            self._extend_sys_path()
 
-    def _extend_sys_path(self):
-        """Adopt the driver's import roots (same-machine runtime-env lite)."""
+    @staticmethod
+    def _adopt_sys_path(blob) -> None:
         import json
 
+        if not blob:
+            return
         try:
-            blob = self.client.kv_get("cluster", b"driver_sys_path")
-            if blob:
-                for p in json.loads(blob):
-                    if p not in sys.path and os.path.isdir(p):
-                        sys.path.append(p)
+            for p in json.loads(blob):
+                if p not in sys.path and os.path.isdir(p):
+                    sys.path.append(p)
+        except Exception:
+            pass
+
+    def _apply_sys_path(self, node_info: dict) -> None:
+        """Adopt the driver's import roots before any task can be dispatched
+        to us (same-machine runtime-env lite); the head ships them in the
+        registration ack."""
+        self._adopt_sys_path(node_info.get("driver_sys_path"))
+
+    def _extend_sys_path(self):
+        """Fallback for workers registered before any driver connected."""
+        try:
+            self._adopt_sys_path(self.client.kv_get("cluster", b"driver_sys_path"))
         except Exception:
             pass
 

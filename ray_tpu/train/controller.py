@@ -64,6 +64,22 @@ class TrainControllerLogic:
     # ------------------------------------------------------------ main loop
     def run(self) -> dict:
         """Blocking run; returns a plain-dict Result."""
+        try:
+            return self._run_loop()
+        finally:
+            self._release_slice()
+
+    def _release_slice(self) -> None:
+        if self._slice_reservation is not None:
+            from ray_tpu.util.accelerators import release_tpu_slice
+
+            try:
+                release_tpu_slice(self._slice_reservation)
+            except Exception:
+                pass
+            self._slice_reservation = None
+
+    def _run_loop(self) -> dict:
         error: Optional[str] = None
         while True:
             self.state = "SCHEDULING"
@@ -72,14 +88,21 @@ class TrainControllerLogic:
                 group.start(self.train_fn, self.train_config,
                             resume_checkpoint=self._resume_checkpoint(),
                             backend=self.backend)
+            except RayTpuError:
+                # a worker died mid-start (e.g. host failure racing the gang
+                # launch): retryable, same as a failure observed while polling
+                self._last_error = traceback.format_exc()
+                group.shutdown()
+                outcome = "failed"
             except Exception:
                 error = traceback.format_exc()
                 self.state = "ERRORED"
                 group.shutdown()
                 break
-            self.state = "RUNNING"
-            outcome = self._poll_until_done(group)
-            group.shutdown()
+            else:
+                self.state = "RUNNING"
+                outcome = self._poll_until_done(group)
+                group.shutdown()
             if outcome == "finished":
                 self.state = "FINISHED"
                 break
@@ -90,6 +113,9 @@ class TrainControllerLogic:
                 error = self._last_error or "train worker group failed"
                 self.state = "ERRORED"
                 break
+            # drop the slice reservation: the failed host's slice may come
+            # back under a different name, so restart re-reserves a fresh one
+            self._release_slice()
             self.state = "RESTARTING"
         best = self.ckpt_manager.best_checkpoint()
         return {

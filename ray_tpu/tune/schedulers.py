@@ -45,6 +45,9 @@ class ASHAScheduler(FIFOScheduler):
             self.rungs.append(t)
             t *= reduction_factor
         self.rung_results: Dict[int, List[float]] = defaultdict(list)
+        # rungs each trial has already been judged at (milestone crossing is
+        # evaluated once per rung per trial, like the reference ASHA)
+        self._judged: Dict[str, set] = defaultdict(set)
 
     def _better(self, a: float) -> float:
         return a if self.mode == "min" else -a
@@ -56,14 +59,18 @@ class ASHAScheduler(FIFOScheduler):
             return CONTINUE
         if t >= self.max_t:
             return STOP
-        for rung in self.rungs:
-            if t == rung:
+        # judge at the largest rung <= t not yet seen for this trial; exact
+        # equality would silently no-op for time_attrs that skip rung values
+        for rung in reversed(self.rungs):
+            if t >= rung and rung not in self._judged[trial_id]:
+                self._judged[trial_id].add(rung)
                 recorded = self.rung_results[rung]
                 recorded.append(self._better(float(score)))
                 k = max(1, len(recorded) // self.rf)
                 cutoff = sorted(recorded)[k - 1]
                 if self._better(float(score)) > cutoff:
                     return STOP
+                break
         return CONTINUE
 
 

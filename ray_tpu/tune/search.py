@@ -1,8 +1,9 @@
 """Search spaces + suggestion generators.
 
 Parity (core subset) with `python/ray/tune/search/`: sample-space primitives
-(uniform/loguniform/randint/choice/grid_search), BasicVariantGenerator (grid
-cross-product × random sampling) and a ConcurrencyLimiter.
+(uniform/loguniform/randint/choice/grid_search) and BasicVariantGenerator
+(grid cross-product × random sampling); concurrency is capped by
+`TuneConfig.max_concurrent_trials`.
 """
 
 from __future__ import annotations
@@ -105,16 +106,3 @@ class BasicVariantGenerator:
                     else:
                         cfg[k] = v
                 yield cfg
-
-
-def sample_config(param_space: Dict[str, Any],
-                  rng: random.Random) -> Dict[str, Any]:
-    cfg = {}
-    for k, v in param_space.items():
-        if isinstance(v, GridSearch):
-            cfg[k] = rng.choice(v.values)
-        elif isinstance(v, Domain):
-            cfg[k] = v.sample(rng)
-        else:
-            cfg[k] = v
-    return cfg

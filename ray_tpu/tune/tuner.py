@@ -121,7 +121,14 @@ class Tuner:
         while pending or running:
             while pending and len(running) < self.tune_config.max_concurrent_trials:
                 t = pending.pop(0)
-                self._start_trial(t, resources)
+                try:
+                    self._start_trial(t, resources)
+                except Exception as e:
+                    # per-trial failure: mark this trial errored, keep tuning
+                    t.state = "ERRORED"
+                    t.error = f"trial failed to start: {e!r}"
+                    self._stop_actor(t)
+                    continue
                 running.append(t)
             time.sleep(POLL_S)
             for t in list(running):
@@ -131,6 +138,7 @@ class Tuner:
                     t.state = "ERRORED"
                     t.error = "trial actor died"
                     running.remove(t)
+                    self._stop_actor(t)
                     continue
                 decision = sched_lib.CONTINUE
                 for rep in st["reports"]:
@@ -160,7 +168,13 @@ class Tuner:
                 elif isinstance(decision, tuple) and decision[0] == "EXPLOIT":
                     _, donor_id, mutate = decision
                     donor = next(d for d in trials if d.id == donor_id)
-                    self._exploit(t, donor, mutate)
+                    try:
+                        self._exploit(t, donor, mutate)
+                    except Exception as e:
+                        t.state = "ERRORED"
+                        t.error = f"exploit restart failed: {e!r}"
+                        running.remove(t)
+                        self._stop_actor(t)
         results = [TrialResult(
             trial_id=t.id, config=t.config, metrics=t.last_metrics,
             checkpoint=Checkpoint(t.checkpoint_path) if t.checkpoint_path else None,
