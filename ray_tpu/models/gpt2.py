@@ -43,6 +43,9 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16        # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # attention implementation: auto | dense | flash (pallas) | ring | ulysses
+    # auto: ring when the active mesh has sp>1, flash on TPU, dense otherwise
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -162,6 +165,21 @@ def _layer_norm(x, p, eps=1e-5):
     return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(x.dtype)
 
 
+def _resolve_attn_impl(cfg: GPT2Config, seq_len: int) -> str:
+    impl = cfg.attn_impl
+    if impl != "auto":
+        return impl
+    from ray_tpu.parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return "ring"
+    flash_ok = seq_len <= 128 or seq_len % 128 == 0
+    if jax.default_backend() == "tpu" and flash_ok:
+        return "flash"
+    return "dense"
+
+
 def _attention(x, p, cfg: GPT2Config):
     B, T, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
@@ -174,13 +192,27 @@ def _attention(x, p, cfg: GPT2Config):
     k = constrain(k, "batch", "heads", "seq", None)
     v = constrain(v, "batch", "heads", "seq", None)
 
-    # fp32 softmax for stability; scores computed on MXU in bf16 inputs.
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
-    scores = scores / math.sqrt(Dh)
-    causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
-    scores = jnp.where(causal[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    impl = _resolve_attn_impl(cfg, T)
+    if impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, True)
+    elif impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, causal=True)
+    elif impl == "ulysses":
+        from ray_tpu.ops.ring_attention import ulysses_attention
+
+        out = ulysses_attention(q, k, v, causal=True)
+    else:
+        # fp32 softmax for stability; scores computed on MXU in bf16 inputs.
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / math.sqrt(Dh)
+        causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
     out = out @ p["wo"].astype(cfg.dtype) + p["bo"].astype(cfg.dtype)
     return out

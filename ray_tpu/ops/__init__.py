@@ -1,0 +1,16 @@
+"""TPU-native hot ops (Pallas kernels + shard_map collectives).
+
+The reference has no equivalent (its hot ops live in torch/CUDA inside user
+frameworks); SURVEY.md §5.7 flags long-context attention as new design work
+for the TPU build.
+"""
+
+from ray_tpu.ops.flash_attention import flash_attention, mha_reference
+from ray_tpu.ops.ring_attention import ring_attention, ulysses_attention
+
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "ring_attention",
+    "ulysses_attention",
+]
