@@ -101,6 +101,7 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
         client.start()
         if session is None:
             client.store.session = client.node_info["session"]
+            client.store._arena = None  # re-derive arena name from the session
         _client = client
         atexit.register(shutdown)
         return client.node_info

@@ -153,7 +153,8 @@ class Head:
                                   conn=None, max_workers=head_max, is_head=True)
         self.nodes: Dict[NodeID, NodeInfo] = {self.node_id: self.head_node}
 
-        self.store = SharedMemoryStore(session, capacity_bytes=object_store_bytes)
+        self.store = SharedMemoryStore(session, capacity_bytes=object_store_bytes,
+                                       create_arena=True)
         self.workers: Dict[WorkerID, WorkerInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
@@ -460,7 +461,7 @@ class Head:
             self.store.free(meta)
             return
         self.objects[meta.object_id] = meta
-        if meta.kind == "shm":
+        if meta.kind in ("shm", "arena"):
             self.store.adopt(meta)  # accounting + LRU/spill tracking
         for fut in self.object_waiters.pop(meta.object_id, []):
             if not fut.done():

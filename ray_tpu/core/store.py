@@ -2,11 +2,20 @@
 
 Capability-equivalent of the reference's plasma store + external storage
 (`src/ray/object_manager/plasma/`, `python/ray/_private/external_storage.py`):
-immutable sealed objects in named shm segments, zero-copy reads from any
-process on the node, LRU spill to disk under memory pressure. Re-designed
-rather than ported: Python `multiprocessing.shared_memory` segments (one per
-object) instead of a dlmalloc arena + fd passing; small objects stay inline
-and never touch shm (the reference's in-process memory store fast path).
+immutable sealed objects in node-shared memory, zero-copy reads from any
+process on the node, LRU spill to disk under memory pressure.
+
+Two backends:
+- **native arena** (default when the C++ toolchain is present): one mmap'd
+  shm segment per node managed by `ray_tpu/_native/arena_store.cc` — embedded
+  allocator, object table, LRU, refcount pinning (the plasma equivalent).
+  The node's head daemon creates it and drives watermark spilling; every
+  other process attaches by name.
+- **per-object segments** (fallback, and overflow path when the arena is
+  full): Python `multiprocessing.shared_memory`, one segment per object.
+
+Small objects stay inline and never touch shm (the reference's in-process
+memory store fast path).
 """
 
 from __future__ import annotations
@@ -23,14 +32,16 @@ from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.serialization import SerializedObject
 
 INLINE_THRESHOLD = 100 * 1024  # small objects ride the control plane inline
+ARENA_HIGH_WATERMARK = 0.85    # head starts spilling above this fill ratio
+ARENA_LOW_WATERMARK = 0.75     # ...down to this
 
 
 @dataclasses.dataclass
 class ObjectMeta:
     object_id: ObjectID
     size: int
-    kind: str                      # "inline" | "shm" | "spilled"
-    segment: Optional[str] = None  # shm segment name
+    kind: str                      # "inline" | "shm" | "arena" | "spilled"
+    segment: Optional[str] = None  # shm segment name (or arena name)
     inline: Optional[bytes] = None # inline payload (kind == "inline")
     spill_path: Optional[str] = None
     node_id: Optional[object] = None
@@ -54,7 +65,7 @@ class SharedMemoryStore:
     processes attach read-only by segment name."""
 
     def __init__(self, session: str, capacity_bytes: int = 2 << 30,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None, create_arena: bool = False):
         self.session = session
         self.capacity = capacity_bytes
         self.used = 0
@@ -63,12 +74,45 @@ class SharedMemoryStore:
         self._meta_by_segment: Dict[str, ObjectMeta] = {}
         self._pinned: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # native arena backend (plasma equivalent); the head creates, others
+        # lazily attach. None until first use; False = unavailable.
+        self.owns_arena = create_arena
+        self._arena = None
+        self._arena_metas: Dict[bytes, ObjectMeta] = {}  # head-side, for spill
+        if create_arena and not os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
+            from ray_tpu.core import native_store
+
+            try:
+                self._arena = native_store.Arena.create(
+                    self._arena_name(), capacity_bytes)
+            except Exception:
+                self._arena = False
+
+    def _arena_name(self) -> str:
+        return f"rtpu_arena_{self.session[:16]}"
+
+    def _get_arena(self):
+        if self._arena is not None:
+            return self._arena or None
+        if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
+            self._arena = False
+            return None
+        from ray_tpu.core import native_store
+
+        try:
+            self._arena = native_store.Arena.attach(self._arena_name())
+        except Exception:
+            self._arena = False  # no arena for this session; use segments
+        return self._arena or None
 
     # -- creation ----------------------------------------------------------
     def put_serialized(self, obj_id: ObjectID, ser: SerializedObject) -> ObjectMeta:
         size = ser.frame_bytes
         if size <= INLINE_THRESHOLD:
             return ObjectMeta(obj_id, size, "inline", inline=ser.to_bytes())
+        meta = self._try_put_arena(obj_id, ser, size)
+        if meta is not None:
+            return meta
         # random suffix: a retried task must not collide with a segment left
         # behind by a dead attempt for the same return object id
         name = f"rtpu_{self.session[:8]}_{obj_id.hex()[:12]}_{os.urandom(3).hex()}"
@@ -83,9 +127,40 @@ class SharedMemoryStore:
         self._meta_by_segment[name] = meta
         return meta
 
+    def _try_put_arena(self, obj_id: ObjectID, ser: SerializedObject,
+                       size: int) -> Optional[ObjectMeta]:
+        arena = self._get_arena()
+        if arena is None:
+            return None
+        from ray_tpu.core.native_store import ArenaError, ObjectExistsError
+
+        oid = obj_id.binary()
+        try:
+            try:
+                buf = arena.create_buffer(oid, size)
+            except ObjectExistsError:
+                # a dead retry may have left an unsealed entry; reclaim it
+                arena.delete(oid, force=True)
+                buf = arena.create_buffer(oid, size)
+            ser.write_into(buf)
+            buf.release()
+            arena.seal(oid)
+        except ArenaError:
+            # full (or unhealthy): overflow to a per-object segment; the head
+            # spills arena objects at the watermark to make future room
+            return None
+        if self.owns_arena:
+            self._maybe_spill_arena()
+        return ObjectMeta(obj_id, size, "arena", segment=arena.name)
+
     def adopt(self, meta: ObjectMeta) -> None:
-        """Track a segment created by another process on this node (accounting,
-        LRU ordering, spill eligibility)."""
+        """Track an object created by another process on this node
+        (accounting, LRU ordering, spill eligibility)."""
+        if meta.kind == "arena":
+            if self.owns_arena:
+                self._arena_metas[meta.object_id.binary()] = meta
+                self._maybe_spill_arena()
+            return
         if meta.kind != "shm" or meta.segment is None:
             return
         with self._lock:
@@ -109,6 +184,19 @@ class SharedMemoryStore:
         if meta.kind == "spilled":
             with open(meta.spill_path, "rb") as f:
                 return SerializedObject.from_view(memoryview(f.read()))
+        if meta.kind == "arena":
+            arena = self._get_arena()
+            if arena is None:
+                raise FileNotFoundError(meta.segment)
+            try:
+                # pins the object (plasma semantics: zero-copy views stay
+                # valid until release/free); raises KeyError when the head
+                # evicted/spilled it — surfaced as FileNotFoundError so the
+                # caller refreshes the meta and reads the spill file
+                view = arena.get(meta.object_id.binary(), pin=True)
+            except KeyError:
+                raise FileNotFoundError(meta.segment) from None
+            return SerializedObject.from_view(view)
         with self._lock:
             shm = self._segments.get(meta.segment)
             if shm is not None:
@@ -136,8 +224,13 @@ class SharedMemoryStore:
                     del self._pinned[meta.segment]
 
     def release(self, meta: ObjectMeta) -> None:
-        """Drop this process's mapping of a segment without unlinking it
-        (freeing/unlinking is the owner node's job)."""
+        """Drop this process's mapping/pin without destroying the object
+        (freeing is the owner node's job)."""
+        if meta.kind == "arena":
+            arena = self._get_arena()
+            if arena is not None:
+                arena.release(meta.object_id.binary())
+            return
         if meta.kind != "shm" or not meta.segment:
             return
         with self._lock:
@@ -150,6 +243,15 @@ class SharedMemoryStore:
                 pass  # live memoryviews still reference it; mapping stays
 
     def free(self, meta: ObjectMeta) -> None:
+        if meta.kind == "arena":
+            arena = self._get_arena()
+            if arena is None:
+                return
+            arena.release(meta.object_id.binary())
+            if self.owns_arena:
+                self._arena_metas.pop(meta.object_id.binary(), None)
+                arena.delete(meta.object_id.binary(), force=True)
+            return
         if meta.kind == "shm" and meta.segment:
             with self._lock:
                 shm = self._segments.pop(meta.segment, None)
@@ -174,6 +276,42 @@ class SharedMemoryStore:
                 pass
 
     # -- spilling ----------------------------------------------------------
+    def _maybe_spill_arena(self) -> None:
+        """Head-side watermark spilling (plasma eviction + external storage):
+        above the high watermark, move LRU unpinned arena objects to disk and
+        retarget their metas; readers with stale metas refresh via the head."""
+        arena = self._get_arena()
+        if arena is None or not self.owns_arena:
+            return
+        used, cap, _ = arena.stats()
+        if used <= ARENA_HIGH_WATERMARK * cap:
+            return
+        needed = used - int(ARENA_LOW_WATERMARK * cap)
+        os.makedirs(self.spill_dir, exist_ok=True)
+        for oid in arena.evict_candidates(needed):
+            meta = self._arena_metas.pop(oid, None)
+            if meta is None:
+                continue  # not yet adopted (registration in flight): skip
+            try:
+                view = arena.get(oid, pin=False)
+            except KeyError:
+                continue
+            path = os.path.join(self.spill_dir, oid.hex())
+            with open(path, "wb") as f:
+                f.write(view)
+            del view
+            if not arena.delete(oid, force=False):
+                # pinned between candidate selection and delete: keep it
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                self._arena_metas[oid] = meta
+                continue
+            meta.kind = "spilled"
+            meta.spill_path = path
+            meta.segment = None
+
     def _ensure_capacity(self, incoming: int) -> None:
         """Spill LRU unpinned segments until `incoming` fits. Lock held."""
         if self.used + incoming <= self.capacity:
@@ -212,3 +350,9 @@ class SharedMemoryStore:
                     pass
             self._segments.clear()
             self.used = 0
+        if self._arena:
+            try:
+                self._arena.close(unlink=self.owns_arena)
+            except Exception:
+                pass
+            self._arena = False
