@@ -186,8 +186,13 @@ class RemoteFunction:
         fn_key = self._ensure_exported()
         opts = dict(self._options)
         pg = opts.get("placement_group")
+        num_returns = opts.get("num_returns", 1)
         task_opts = {"resources": _build_resources(opts),
                      "max_retries": opts.get("max_retries", 3),
+                     "max_calls": opts.get("max_calls"),
+                     "num_returns": num_returns,
+                     "_generator_backpressure_num_objects": opts.get(
+                         "_generator_backpressure_num_objects"),
                      "placement_group": pg.id.binary() if pg is not None else None,
                      "placement_group_bundle_index": opts.get(
                          "placement_group_bundle_index"),
@@ -196,8 +201,12 @@ class RemoteFunction:
                      "name": opts.get("name") or getattr(self._fn, "__name__", "task")}
         refs = _global_client().submit_task(
             fn_key, args, kwargs, task_opts,
-            num_returns=opts.get("num_returns", 1))
-        return refs[0] if opts.get("num_returns", 1) == 1 else refs
+            num_returns=1 if num_returns == "streaming" else num_returns)
+        if num_returns == "streaming":
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0].id)
+        return refs[0] if num_returns == 1 else refs
 
     def options(self, **overrides) -> "RemoteFunction":
         rf = RemoteFunction(self._fn, {**self._options, **overrides})
@@ -214,15 +223,19 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str):
+    def __init__(self, handle: "ActorHandle", name: str,
+                 call_options: Optional[dict] = None):
         self._handle = handle
         self._name = name
+        self._call_options = call_options or {}
 
     def remote(self, *args, **kwargs) -> ObjectRef:
-        return self._handle._call(self._name, args, kwargs)
+        return self._handle._call(self._name, args, kwargs,
+                                  group=self._call_options.get("concurrency_group"))
 
     def options(self, **overrides):
-        return self  # per-call options (concurrency groups etc.): later
+        return ActorMethod(self._handle, self._name,
+                           {**self._call_options, **overrides})
 
 
 class ActorHandle:
@@ -230,8 +243,9 @@ class ActorHandle:
         self._actor_id = actor_id
         self._methods = methods
 
-    def _call(self, method: str, args, kwargs) -> ObjectRef:
-        return _global_client().call_actor(self._actor_id, method, args, kwargs)
+    def _call(self, method: str, args, kwargs, group=None) -> ObjectRef:
+        return _global_client().call_actor(self._actor_id, method, args, kwargs,
+                                           group=group)
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -255,9 +269,13 @@ class ActorClass:
         self._client = None
 
     def _methods_meta(self) -> dict:
-        return {name: {} for name in dir(self._cls)
-                if callable(getattr(self._cls, name, None))
-                and not name.startswith("__")}
+        meta = {}
+        for name in dir(self._cls):
+            fn = getattr(self._cls, name, None)
+            if not callable(fn) or name.startswith("__"):
+                continue
+            meta[name] = dict(getattr(fn, "_ray_tpu_method_options", {}))
+        return meta
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         _auto_init()
@@ -275,6 +293,7 @@ class ActorClass:
                       "scheduling_strategy": opts.get("scheduling_strategy", "hybrid"),
                       "max_restarts": opts.get("max_restarts", 0),
                       "max_concurrency": opts.get("max_concurrency", 1),
+                      "concurrency_groups": opts.get("concurrency_groups"),
                       "name": opts.get("name"),
                       "namespace": opts.get("namespace", "default"),
                       "lifetime": opts.get("lifetime"),
@@ -319,8 +338,12 @@ def kill(handle: ActorHandle, *, no_restart: bool = True) -> None:
     _global_client().kill_actor(handle._actor_id, no_restart=no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    pass  # best-effort task cancellation: implemented with task events later
+def cancel(ref: ObjectRef, *, force: bool = False) -> str:
+    """Cancel the task producing `ref`: queued tasks are dropped; running
+    tasks get TaskCancelledError raised in their thread (force kills the
+    worker). `get(ref)` then raises TaskCancelledError."""
+    return _global_client().head_request(
+        "cancel_task", return_id=ref.id.binary(), force=force)
 
 
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:

@@ -377,7 +377,8 @@ class CoreClient:
         return conn
 
     async def _call_actor_async(self, actor_id: ActorID, method: str,
-                                payload, deps, return_id: bytes, retries: int = 30):
+                                payload, deps, return_id: bytes,
+                                retries: int = 30, group=None):
         order_lock = self._actor_order_locks.setdefault(actor_id, asyncio.Lock())
         last_err = None
         for _ in range(retries):
@@ -390,7 +391,8 @@ class CoreClient:
                     conn = await self._actor_conn(actor_id)
                     fut = conn.request_future(
                         "actor_call", actor_id=actor_id.binary(), method=method,
-                        args=payload, deps=deps, return_id=return_id)
+                        args=payload, deps=deps, return_id=return_id,
+                        group=group)
                 return await fut
             except (protocol.ConnectionLost, ConnectionRefusedError, OSError) as e:
                 last_err = e
@@ -399,7 +401,7 @@ class CoreClient:
         raise ActorDiedError(f"actor unreachable: {last_err}")
 
     def call_actor(self, actor_id: ActorID, method: str, args: tuple,
-                   kwargs: dict) -> ObjectRef:
+                   kwargs: dict, group=None) -> ObjectRef:
         """Submit an actor call; returns immediately with the result ref.
 
         The reply (result meta) resolves in the background; `get`/`wait` on
@@ -408,7 +410,7 @@ class CoreClient:
         return_id = ObjectID.generate()
         cfut = asyncio.run_coroutine_threadsafe(
             self._call_actor_async(actor_id, method, payload, deps,
-                                   return_id.binary()), self.loop)
+                                   return_id.binary(), group=group), self.loop)
         with self._pending_lock:
             self._pending_calls[return_id] = cfut
 

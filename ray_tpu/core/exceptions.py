@@ -39,3 +39,7 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 
 class RuntimeEnvSetupError(RayTpuError):
     """Preparing a worker's runtime environment failed."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel (reference TaskCancelledError)."""

@@ -91,6 +91,7 @@ class WorkerInfo:
         self.acquired_bundle: Optional[int] = None
         self.proc: Optional[subprocess.Popen] = None
         self.current_record = None
+        self.retiring = False  # max_calls reached; exiting after current task
 
 
 class ActorInfo:
@@ -112,6 +113,26 @@ class TaskRecord:
         self.submitter = submitter
         self.retries_left = spec["options"].get("max_retries", 3)
         self.pending_deps: Set[ObjectID] = set()
+        self.cancelled = False
+
+
+class GeneratorState:
+    """Streaming-generator bookkeeping (reference: dynamic return refs +
+    `_generator_backpressure_num_objects`, SURVEY §2.12b)."""
+
+    def __init__(self, backpressure: int = 0):
+        self.items: List[bytes] = []      # yielded object ids, in order
+        self.done = False
+        self.backpressure = backpressure
+        self.consumed = 0                 # highest index the consumer fetched
+        self.consumer_waiters: List[asyncio.Future] = []
+        self.producer_waiters: List[asyncio.Future] = []
+
+    def wake(self, waiters: List[asyncio.Future]) -> None:
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+        waiters.clear()
 
 
 class BundleState:
@@ -164,6 +185,7 @@ class Head:
         self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.queue: List[TaskRecord] = []
         self.dep_index: Dict[ObjectID, List[TaskRecord]] = {}
+        self.generators: Dict[bytes, GeneratorState] = {}
         self.subscribers: Dict[str, List[protocol.Connection]] = {}
         self.port: Optional[int] = None
         self._server: Optional[protocol.Server] = None
@@ -417,6 +439,85 @@ class Head:
                 self.notify_task_done(w)
             return True
 
+        async def worker_retiring():
+            # max_calls reached: stop dispatching to this worker; it exits
+            # right after its final task_done (reference max_calls semantics)
+            w = conn_state.get("worker")
+            if w is not None:
+                w.retiring = True
+                node = self.nodes.get(w.node_id)
+                if node is not None and w in node.idle:
+                    node.idle.remove(w)
+            return True
+
+        def _gen(gen_id: bytes, backpressure: int = 0) -> GeneratorState:
+            gs = self.generators.get(gen_id)
+            if gs is None:
+                gs = self.generators[gen_id] = GeneratorState(backpressure)
+            if backpressure:
+                gs.backpressure = backpressure
+            return gs
+
+        async def generator_yield(gen_id, meta, backpressure=0):
+            gs = _gen(gen_id, backpressure)
+            self._seal(meta)
+            gs.items.append(meta.object_id.binary())
+            gs.wake(gs.consumer_waiters)
+            # backpressure: hold the producer's reply until consumed catches up
+            while (gs.backpressure and not gs.done
+                   and len(gs.items) - gs.consumed > gs.backpressure):
+                fut = asyncio.get_running_loop().create_future()
+                gs.producer_waiters.append(fut)
+                await fut
+            return True
+
+        async def generator_done(gen_id):
+            gs = _gen(gen_id)
+            gs.done = True
+            gs.wake(gs.consumer_waiters)
+            gs.wake(gs.producer_waiters)
+            return True
+
+        async def generator_next(gen_id, index):
+            gs = _gen(gen_id)
+            gs.consumed = max(gs.consumed, index)
+            gs.wake(gs.producer_waiters)
+            while True:
+                if index < len(gs.items):
+                    return {"ref": gs.items[index]}
+                # a failed generator task seals gen_id itself with the error;
+                # the consumer receives it once, after draining real items
+                err_meta = self.objects.get(ObjectID(gen_id))
+                if err_meta is not None and err_meta.error:
+                    return {"ref": gen_id, "error": True}
+                if gs.done:
+                    return {"done": True}
+                fut = asyncio.get_running_loop().create_future()
+                gs.consumer_waiters.append(fut)
+                await fut
+
+        async def cancel_task(return_id, force=False):
+            """ray.cancel: drop a queued task, or interrupt/kill a running
+            one (reference CancelTask; force kills the worker)."""
+            for rec in list(self.queue):
+                if return_id in rec.spec["return_ids"]:
+                    self.queue.remove(rec)
+                    rec.cancelled = True
+                    self._fail_task(rec, "task was cancelled", cancelled=True)
+                    return "cancelled_queued"
+            for w in self.workers.values():
+                rec = w.current_record
+                if rec is not None and return_id in rec.spec["return_ids"]:
+                    rec.cancelled = True
+                    rec.retries_left = 0
+                    if force:
+                        self._terminate_worker(w)
+                        return "killed"
+                    w.conn.push("cancel_task",
+                                task_id=rec.spec["task_id"].binary())
+                    return "interrupt_sent"
+            return "not_found"
+
         async def actor_ready(actor_id, address):
             info = self.actors.get(ActorID(actor_id))
             if info is not None:
@@ -463,6 +564,13 @@ class Head:
         self.objects[meta.object_id] = meta
         if meta.kind in ("shm", "arena"):
             self.store.adopt(meta)  # accounting + LRU/spill tracking
+        if meta.error and meta.object_id.binary() in self.generators:
+            # a failed generator task: consumers drain produced items, then
+            # receive the error ref (generator_next checks this meta)
+            gs = self.generators[meta.object_id.binary()]
+            gs.done = True
+            gs.wake(gs.consumer_waiters)
+            gs.wake(gs.producer_waiters)
         for fut in self.object_waiters.pop(meta.object_id, []):
             if not fut.done():
                 fut.set_result(meta)
@@ -676,7 +784,9 @@ class Head:
         self._release(w)
         rec = getattr(w, "current_record", None)
         if rec is not None and w.running_task is not None:
-            if rec.retries_left > 0:
+            if rec.cancelled:
+                self._fail_task(rec, "task was cancelled", cancelled=True)
+            elif rec.retries_left > 0:
                 rec.retries_left -= 1
                 rec.pending_deps = set()
                 self._enqueue(rec)
@@ -752,11 +862,15 @@ class Head:
         except ProcessLookupError:
             pass
 
-    def _fail_task(self, rec: TaskRecord, cause: str) -> None:
+    def _fail_task(self, rec: TaskRecord, cause: str,
+                   cancelled: bool = False) -> None:
         from ray_tpu.core import serialization
-        from ray_tpu.core.exceptions import WorkerCrashedError
+        from ray_tpu.core.exceptions import (TaskCancelledError,
+                                             WorkerCrashedError)
 
-        err = serialization.serialize(WorkerCrashedError(cause))
+        exc = (TaskCancelledError(cause) if cancelled
+               else WorkerCrashedError(cause))
+        err = serialization.serialize(exc)
         for rid in rec.spec["return_ids"]:
             meta = self.store.put_serialized(ObjectID(rid), err)
             meta.error = True
@@ -907,8 +1021,8 @@ class Head:
         w.current_record = None
         self._release(w)
         node = self.nodes.get(w.node_id)
-        if (not w.is_driver and w.actor_id is None and node is not None
-                and w not in node.idle):
+        if (not w.is_driver and w.actor_id is None and not w.retiring
+                and node is not None and w not in node.idle):
             node.idle.append(w)
         self._kick()
 

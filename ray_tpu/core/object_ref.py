@@ -38,3 +38,56 @@ class ObjectRef:
 
         client = _global_client()
         return client.get_async([self]).__await__()
+
+
+class ObjectRefGenerator:
+    """Iterator over the refs a streaming task yields
+    (`num_returns="streaming"`; reference ObjectRefGenerator,
+    `_raylet.pyx` + SURVEY §2.12b). Each `next()` blocks until the producer
+    has yielded the next value, then returns its ObjectRef."""
+
+    def __init__(self, gen_id: ObjectID):
+        self._gen_id = gen_id
+        self._index = 0
+        self._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def _advance(self, rep) -> ObjectRef:
+        if rep.get("done") or self._exhausted:
+            self._exhausted = True
+            raise StopIteration
+        if rep.get("error"):
+            # the producer failed: yield its error ref once, then stop
+            self._exhausted = True
+        self._index += 1
+        return ObjectRef(ObjectID(rep["ref"]))
+
+    def __next__(self) -> ObjectRef:
+        if self._exhausted:
+            raise StopIteration
+        from ray_tpu.core.api import _global_client
+
+        rep = _global_client().head_request(
+            "generator_next", gen_id=self._gen_id.binary(), index=self._index)
+        return self._advance(rep)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        if self._exhausted:
+            raise StopAsyncIteration
+        from ray_tpu.core.api import _global_client
+
+        client = _global_client()
+        rep = await client.conn.request(
+            "generator_next", gen_id=self._gen_id.binary(), index=self._index)
+        try:
+            return self._advance(rep)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._gen_id,))

@@ -4,24 +4,40 @@ Counterpart of the reference's default_worker.py + task-execution path
 (`python/ray/_private/workers/default_worker.py`, `_raylet.pyx:2141
 execute_task_with_cancellation_handler`): receives pushed task specs from the
 head, runs user code on executor threads, stores results, serves direct
-actor calls on its own port.
+actor calls on its own port. Also implements:
+
+- streaming generators (`num_returns="streaming"`): yields become objects
+  reported incrementally with head-enforced backpressure (reference
+  `_generator_backpressure_num_objects`, SURVEY §2.12b);
+- cancellation: `cancel_task` async-raises TaskCancelledError into the task
+  thread (the CPython equivalent of the reference's interrupt path);
+- `max_calls`: worker retires after N executions of a task's function;
+- async actors: `async def` methods run on the event loop under a
+  per-concurrency-group semaphore; sync methods run on per-group thread
+  pools (reference fiber/concurrency-group semantics,
+  `task_execution/concurrency_group_manager.*`).
 """
 
 from __future__ import annotations
 
 import asyncio
+import ctypes
+import inspect
 import os
 import sys
 import threading
 import traceback
 from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
 
 from ray_tpu.core import serialization
 from ray_tpu.core.client import CoreClient
-from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.exceptions import TaskCancelledError, TaskError
 from ray_tpu.core.ids import ActorID, ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.serialization import SerializedObject
+
+DEFAULT_GROUP = "_default"
 
 
 class WorkerRuntime:
@@ -30,13 +46,19 @@ class WorkerRuntime:
                                  handlers={
                                      "exec_task": self._on_exec_task,
                                      "start_actor": self._on_start_actor,
+                                     "cancel_task": self._on_cancel_task,
                                  })
         self.task_executor = ThreadPoolExecutor(max_workers=1,
                                                 thread_name_prefix="task")
-        self.actor_executor = None
+        self.actor_executors: Dict[str, ThreadPoolExecutor] = {}
+        self.actor_semaphores: Dict[str, asyncio.Semaphore] = {}
+        self.actor_method_groups: Dict[str, str] = {}
         self.actor_instance = None
         self.actor_id = None
         self.shutdown_event = threading.Event()
+        self._task_threads: Dict[bytes, int] = {}    # task_id -> thread ident
+        self._fn_calls: Dict[bytes, int] = {}
+        self._retiring = False
 
     # ------------------------------------------------------------ plumbing
     def start(self):
@@ -93,44 +115,118 @@ class WorkerRuntime:
                   for k, v in kwargs.items()}
         return args, kwargs
 
+    async def _resolve_args_async(self, payload) -> tuple:
+        """Event-loop-safe variant (async actor methods run on the loop; the
+        sync path would deadlock calling back into it)."""
+        if "inline" in payload:
+            ser = SerializedObject.from_view(memoryview(payload["inline"]))
+        else:
+            meta = payload["meta"]
+            self.client.local_metas[meta.object_id] = meta
+            ser = self.client.store.get_serialized(meta)
+        args, kwargs = serialization.deserialize(ser)
+        out_args = []
+        for a in args:
+            out_args.append(await self.client.get_async([a])
+                            if isinstance(a, ObjectRef) else a)
+        out_kwargs = {}
+        for k, v in kwargs.items():
+            out_kwargs[k] = (await self.client.get_async([v])
+                             if isinstance(v, ObjectRef) else v)
+        return tuple(out_args), out_kwargs
+
     # -------------------------------------------------------------- tasks
     async def _on_exec_task(self, spec):
         loop = asyncio.get_running_loop()
         loop.run_in_executor(self.task_executor, self._run_task, spec)
         return True
 
+    async def _on_cancel_task(self, task_id):
+        ident = self._task_threads.get(task_id)
+        if ident is not None:
+            # CPython async-raise into the task thread: the closest
+            # single-process analog of the reference's cancellation interrupt
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError))
+        return ident is not None
+
     def _run_task(self, spec):
         return_ids = [ObjectID(b) for b in spec["return_ids"]]
+        opts = spec.get("options", {})
+        task_key = spec["task_id"].binary()
+        self._task_threads[task_key] = threading.get_ident()
+        streaming = opts.get("num_returns") == "streaming"
         try:
             fn = self.client.fn_manager.load(spec["fn_key"])
             args, kwargs = self._resolve_args(spec["args"])
             result = fn(*args, **kwargs)
-            results = [result] if len(return_ids) == 1 else list(result)
-            if len(results) != len(return_ids):
-                raise ValueError(
-                    f"task returned {len(results)} values, expected {len(return_ids)}")
-            for rid, val in zip(return_ids, results):
-                self.client.store_result(rid, val, register=True)
+            if streaming:
+                self._drain_generator(return_ids[0], result, opts)
+            else:
+                results = [result] if len(return_ids) == 1 else list(result)
+                if len(results) != len(return_ids):
+                    raise ValueError(
+                        f"task returned {len(results)} values, expected {len(return_ids)}")
+                for rid, val in zip(return_ids, results):
+                    self.client.store_result(rid, val, register=True)
         except BaseException as e:  # noqa: BLE001 - all failures become error objects
             err = e if isinstance(e, TaskError) else TaskError(
                 repr(e), traceback.format_exc())
+            if isinstance(e, TaskCancelledError):
+                err = e
             for rid in return_ids:
                 try:
                     self.client.store_result(rid, err, register=True, is_error=True)
                 except Exception:
                     pass
         finally:
+            self._task_threads.pop(task_key, None)
+            retire = False
+            max_calls = opts.get("max_calls")
+            if max_calls:
+                fn_key = spec["fn_key"]
+                self._fn_calls[fn_key] = self._fn_calls.get(fn_key, 0) + 1
+                retire = self._fn_calls[fn_key] >= max_calls
             try:
+                if retire:
+                    self.client.head_request("worker_retiring")
                 self.client.head_request("task_done", task_id=spec["task_id"].binary())
             except Exception:
                 pass
+            if retire:
+                self._retiring = True
+                self.shutdown_event.set()
+
+    def _drain_generator(self, gen_id: ObjectID, result, opts) -> None:
+        """Stream yielded values to the head as they materialize."""
+        backpressure = opts.get("_generator_backpressure_num_objects") or 0
+        count = 0
+        for item in result:
+            oid = ObjectID.generate()
+            meta = self.client.store_result(oid, item, register=False)
+            # the head seals the meta; the reply is delayed for backpressure
+            self.client.head_request("generator_yield", gen_id=gen_id.binary(),
+                                     meta=meta, backpressure=backpressure)
+            count += 1
+        self.client.head_request("generator_done", gen_id=gen_id.binary())
 
     # ------------------------------------------------------------- actors
     async def _on_start_actor(self, spec):
         loop = asyncio.get_running_loop()
-        max_conc = spec["options"].get("max_concurrency", 1)
-        self.actor_executor = ThreadPoolExecutor(max_workers=max_conc,
-                                                 thread_name_prefix="actor")
+        opts = spec["options"]
+        max_conc = opts.get("max_concurrency", 1)
+        groups = dict(opts.get("concurrency_groups") or {})
+        self.actor_executors = {
+            DEFAULT_GROUP: ThreadPoolExecutor(max_conc,
+                                              thread_name_prefix="actor")}
+        self.actor_semaphores = {DEFAULT_GROUP: asyncio.Semaphore(max_conc)}
+        for gname, n in groups.items():
+            self.actor_executors[gname] = ThreadPoolExecutor(
+                int(n), thread_name_prefix=f"actor-{gname}")
+            self.actor_semaphores[gname] = asyncio.Semaphore(int(n))
+        self.actor_method_groups = {
+            m: meta.get("concurrency_group") for m, meta in
+            spec.get("methods", {}).items() if meta.get("concurrency_group")}
         self.actor_id = ActorID(spec["actor_id"])
         self.client.current_actor_id = self.actor_id
 
@@ -140,7 +236,7 @@ class WorkerRuntime:
             self.actor_instance = cls(*args, **kwargs)
 
         try:
-            await loop.run_in_executor(self.actor_executor, _init)
+            await loop.run_in_executor(self.actor_executors[DEFAULT_GROUP], _init)
             await self.client.conn.request(
                 "actor_ready", actor_id=spec["actor_id"],
                 address=("127.0.0.1", self.client.direct_port))
@@ -153,15 +249,35 @@ class WorkerRuntime:
                 pass
         return True
 
-    async def _on_actor_call(self, actor_id, method, args, deps, return_id):
+    async def _on_actor_call(self, actor_id, method, args, deps, return_id,
+                             group=None):
         loop = asyncio.get_running_loop()
+        rid = ObjectID(return_id)
+        gname = group or self.actor_method_groups.get(method) or DEFAULT_GROUP
+        fn = getattr(self.actor_instance, method, None)
+
+        if fn is not None and inspect.iscoroutinefunction(fn):
+            # async actor method: runs on this event loop under the group's
+            # semaphore (reference asyncio-actor / fiber semantics)
+            sem = self.actor_semaphores.get(gname) or \
+                self.actor_semaphores[DEFAULT_GROUP]
+            async with sem:
+                try:
+                    a, kw = await self._resolve_args_async(args)
+                    result = await fn(*a, **kw)
+                    meta = self.client.store_result(rid, result, register=False)
+                except BaseException as e:  # noqa: BLE001
+                    err = e if isinstance(e, TaskError) else TaskError(
+                        repr(e), traceback.format_exc())
+                    meta = self.client.store_result(rid, err, register=False,
+                                                    is_error=True)
+            return {"meta": meta}
 
         def _run():
-            rid = ObjectID(return_id)
             try:
-                fn = getattr(self.actor_instance, method)
+                f = getattr(self.actor_instance, method)
                 a, kw = self._resolve_args(args)
-                result = fn(*a, **kw)
+                result = f(*a, **kw)
                 return self.client.store_result(rid, result, register=False)
             except BaseException as e:  # noqa: BLE001
                 err = e if isinstance(e, TaskError) else TaskError(
@@ -169,7 +285,9 @@ class WorkerRuntime:
                 return self.client.store_result(rid, err, register=False,
                                                 is_error=True)
 
-        meta = await loop.run_in_executor(self.actor_executor, _run)
+        executor = self.actor_executors.get(gname) or \
+            self.actor_executors[DEFAULT_GROUP]
+        meta = await loop.run_in_executor(executor, _run)
         return {"meta": meta}
 
     # ---------------------------------------------------------------- run
