@@ -1,0 +1,223 @@
+// Mutable shared-memory channels for compiled graphs — the native
+// counterpart of the reference's mutable plasma objects + semaphores
+// (src/ray/core_worker/experimental_mutable_object_manager.{h,cc},
+// python/ray/experimental/channel/shared_memory_channel.py): a single-slot
+// value in shm, one writer, N readers, blocking handoff via a process-shared
+// mutex + condvar. Steady-state hop latency is a condvar wake, not an RPC.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kChanMagic = 0x52545055'4348414eull;  // "RTPUCHAN"
+
+struct ChanHeader {
+  uint64_t magic;
+  uint64_t capacity;      // payload capacity
+  uint64_t total_size;
+  pthread_mutex_t mutex;
+  pthread_cond_t cond;
+  uint64_t seq;           // id of the value currently in the slot (0 = none)
+  uint64_t acks;          // readers that consumed the current value
+  uint32_t num_readers;
+  uint32_t closed;
+  uint64_t len;           // payload length of current value
+};
+
+struct ChanHandle {
+  void* base;
+  uint64_t total;
+  int fd;
+  char name[128];
+};
+
+inline ChanHeader* chdr(ChanHandle* h) {
+  return reinterpret_cast<ChanHeader*>(h->base);
+}
+inline uint8_t* payload(ChanHandle* h) {
+  return reinterpret_cast<uint8_t*>(h->base) + sizeof(ChanHeader);
+}
+
+int chan_lock(ChanHandle* h) {
+  int rc = pthread_mutex_lock(&chdr(h)->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&chdr(h)->mutex);
+    return 0;
+  }
+  return rc;
+}
+
+// wait on the condvar with optional timeout (ms; <0 = forever).
+// returns 0 or ETIMEDOUT.
+int chan_wait(ChanHandle* h, int64_t timeout_ms) {
+  if (timeout_ms < 0) {
+    return pthread_cond_wait(&chdr(h)->cond, &chdr(h)->mutex);
+  }
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  return pthread_cond_timedwait(&chdr(h)->cond, &chdr(h)->mutex, &ts);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rtpu_chan_create(const char* name, uint64_t capacity,
+                       uint32_t num_readers) {
+  uint64_t total = sizeof(ChanHeader) + capacity;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0666);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  ChanHandle* h = new ChanHandle{base, total, fd, {0}};
+  strncpy(h->name, name, sizeof(h->name) - 1);
+  ChanHeader* H = chdr(h);
+  memset(H, 0, sizeof(ChanHeader));
+  H->capacity = capacity;
+  H->total_size = total;
+  H->num_readers = num_readers ? num_readers : 1;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&H->mutex, &ma);
+  pthread_mutexattr_destroy(&ma);
+
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&H->cond, &ca);
+  pthread_condattr_destroy(&ca);
+
+  __sync_synchronize();
+  H->magic = kChanMagic;
+  return h;
+}
+
+void* rtpu_chan_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0666);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  ChanHandle* h = new ChanHandle{base, (uint64_t)st.st_size, fd, {0}};
+  strncpy(h->name, name, sizeof(h->name) - 1);
+  if (chdr(h)->magic != kChanMagic) {
+    munmap(base, (size_t)st.st_size);
+    close(fd);
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+void rtpu_chan_close(void* hp, int unlink_segment) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  if (!h) return;
+  if (chan_lock(h) == 0) {
+    chdr(h)->closed = 1;
+    pthread_cond_broadcast(&chdr(h)->cond);
+    pthread_mutex_unlock(&chdr(h)->mutex);
+  }
+  munmap(h->base, h->total);
+  close(h->fd);
+  if (unlink_segment) shm_unlink(h->name);
+  delete h;
+}
+
+// Blocks until the slot is free (all readers acked the previous value).
+// 0 ok; -2 closed; -3 timeout; -4 payload too large.
+int rtpu_chan_write(void* hp, const uint8_t* data, uint64_t len,
+                    int64_t timeout_ms) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  ChanHeader* H = chdr(h);
+  if (len > H->capacity) return -4;
+  if (chan_lock(h) != 0) return -1;
+  while (!H->closed && H->seq != 0 && H->acks < H->num_readers) {
+    if (chan_wait(h, timeout_ms) == ETIMEDOUT) {
+      pthread_mutex_unlock(&H->mutex);
+      return -3;
+    }
+  }
+  if (H->closed) {
+    pthread_mutex_unlock(&H->mutex);
+    return -2;
+  }
+  memcpy(payload(h), data, len);
+  H->len = len;
+  H->seq++;
+  H->acks = 0;
+  pthread_cond_broadcast(&H->cond);
+  pthread_mutex_unlock(&H->mutex);
+  return 0;
+}
+
+// Blocks until a value newer than last_seq arrives; copies it into out.
+// 0 ok; -2 closed (and nothing newer); -3 timeout; -4 out buffer too small.
+// On success *seq_out/*len_out describe the value.
+int rtpu_chan_read(void* hp, uint64_t last_seq, uint8_t* out,
+                   uint64_t out_cap, uint64_t* seq_out, uint64_t* len_out,
+                   int64_t timeout_ms) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  ChanHeader* H = chdr(h);
+  if (chan_lock(h) != 0) return -1;
+  while (!H->closed && (H->seq == 0 || H->seq == last_seq)) {
+    if (chan_wait(h, timeout_ms) == ETIMEDOUT) {
+      pthread_mutex_unlock(&H->mutex);
+      return -3;
+    }
+  }
+  if (H->seq == 0 || H->seq == last_seq) {  // closed with nothing newer
+    pthread_mutex_unlock(&H->mutex);
+    return -2;
+  }
+  if (H->len > out_cap) {
+    pthread_mutex_unlock(&H->mutex);
+    return -4;
+  }
+  memcpy(out, payload(h), H->len);
+  *seq_out = H->seq;
+  *len_out = H->len;
+  H->acks++;
+  if (H->acks >= H->num_readers) pthread_cond_broadcast(&H->cond);
+  pthread_mutex_unlock(&H->mutex);
+  return 0;
+}
+
+uint64_t rtpu_chan_capacity(void* hp) {
+  return chdr(reinterpret_cast<ChanHandle*>(hp))->capacity;
+}
+
+}  // extern "C"

@@ -212,6 +212,12 @@ class RemoteFunction:
         rf = RemoteFunction(self._fn, {**self._options, **overrides})
         return rf
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference dag API: fn.bind())."""
+        from ray_tpu.dag.nodes import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self.__name__} cannot be called directly; "
@@ -236,6 +242,12 @@ class ActorMethod:
     def options(self, **overrides):
         return ActorMethod(self._handle, self._name,
                            {**self._call_options, **overrides})
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference dag API: actor.method.bind())."""
+        from ray_tpu.dag.nodes import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
 
 
 class ActorHandle:

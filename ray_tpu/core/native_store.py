@@ -33,9 +33,11 @@ def _build_and_load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) or (
-                os.path.getmtime(_LIB_PATH) <
-                os.path.getmtime(os.path.join(_NATIVE_DIR, "arena_store.cc"))):
+        sources = [os.path.join(_NATIVE_DIR, f)
+                   for f in os.listdir(_NATIVE_DIR) if f.endswith(".cc")]
+        if not os.path.exists(_LIB_PATH) or any(
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(s)
+                for s in sources):
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                                capture_output=True, timeout=120)

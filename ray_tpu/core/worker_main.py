@@ -275,7 +275,16 @@ class WorkerRuntime:
 
         def _run():
             try:
-                f = getattr(self.actor_instance, method)
+                if method == "__rtpu_dag_exec_loop__":
+                    # injected compiled-DAG loop (reference __ray_call__ +
+                    # do_exec_tasks): runs against the hosted instance
+                    import functools
+
+                    from ray_tpu.dag.runtime import exec_dag_loop
+
+                    f = functools.partial(exec_dag_loop, self.actor_instance)
+                else:
+                    f = getattr(self.actor_instance, method)
                 a, kw = self._resolve_args(args)
                 result = f(*a, **kw)
                 return self.client.store_result(rid, result, register=False)

@@ -1,0 +1,24 @@
+"""Lazy DAGs over tasks/actors + compiled execution over shm channels.
+
+Capability parity with the reference's `ray.dag` (`python/ray/dag/`,
+SURVEY §3.7): `.bind()` builds the graph; `.execute()` runs it eagerly as
+tasks/actor calls; `.experimental_compile()` lowers actor-method pipelines
+to long-running per-actor loops connected by native mutable shm channels
+(ray_tpu/_native/channel.cc), replacing per-call RPCs with condvar wakes.
+"""
+
+from ray_tpu.dag.channel import Channel, ChannelClosedError
+from ray_tpu.dag.nodes import (ClassMethodNode, DAGNode, FunctionNode,
+                               InputNode, MultiOutputNode)
+from ray_tpu.dag.compiled import CompiledDAG
+
+__all__ = [
+    "Channel",
+    "ChannelClosedError",
+    "CompiledDAG",
+    "DAGNode",
+    "FunctionNode",
+    "ClassMethodNode",
+    "InputNode",
+    "MultiOutputNode",
+]

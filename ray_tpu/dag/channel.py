@@ -1,0 +1,133 @@
+"""Python wrapper over the native mutable shm channel (channel.cc).
+
+Single-writer / N-reader single-slot handoff; values are serialized with the
+core serializer. This is the data plane of compiled DAGs (reference:
+`python/ray/experimental/channel/shared_memory_channel.py`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.native_store import _build_and_load
+
+
+class ChannelError(Exception):
+    pass
+
+
+class ChannelClosedError(ChannelError):
+    pass
+
+
+def _lib():
+    lib = _build_and_load()
+    if lib is None:
+        raise ChannelError("native channel library unavailable")
+    if not hasattr(lib.rtpu_chan_create, "_configured"):
+        lib.rtpu_chan_create.restype = ctypes.c_void_p
+        lib.rtpu_chan_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                         ctypes.c_uint32]
+        lib.rtpu_chan_attach.restype = ctypes.c_void_p
+        lib.rtpu_chan_attach.argtypes = [ctypes.c_char_p]
+        lib.rtpu_chan_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rtpu_chan_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint64, ctypes.c_int64]
+        lib.rtpu_chan_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
+        lib.rtpu_chan_capacity.restype = ctypes.c_uint64
+        lib.rtpu_chan_capacity.argtypes = [ctypes.c_void_p]
+        lib.rtpu_chan_create._configured = True
+    return lib
+
+
+class Channel:
+    """A named single-slot channel. Writers block until all readers consumed
+    the previous value; readers block until a new value arrives."""
+
+    def __init__(self, name: Optional[str] = None, capacity: int = 4 << 20,
+                 num_readers: int = 1, _create: bool = True):
+        self.name = name or f"rtpu_chan_{os.urandom(6).hex()}"
+        self.capacity = capacity
+        self.num_readers = num_readers
+        self._last_seq = 0
+        lib = _lib()
+        if _create:
+            self._h = lib.rtpu_chan_create(self.name.encode(), capacity,
+                                           num_readers)
+            self._owner = True
+        else:
+            self._h = lib.rtpu_chan_attach(self.name.encode())
+            self._owner = False
+        if not self._h:
+            raise ChannelError(f"cannot open channel {self.name}")
+        self._lib_ref = lib
+
+    @classmethod
+    def attach(cls, name: str) -> "Channel":
+        ch = cls.__new__(cls)
+        ch.name = name
+        ch._last_seq = 0
+        lib = _lib()
+        ch._h = lib.rtpu_chan_attach(name.encode())
+        if not ch._h:
+            raise ChannelError(f"cannot attach channel {name}")
+        ch._owner = False
+        ch._lib_ref = lib
+        ch.capacity = lib.rtpu_chan_capacity(ch._h)
+        ch.num_readers = 0  # unknown on attach; only the header knows
+        return ch
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        data = serialization.dumps(value)
+        rc = self._lib_ref.rtpu_chan_write(
+            self._h, data, len(data),
+            -1 if timeout is None else int(timeout * 1000))
+        if rc == -2:
+            raise ChannelClosedError(self.name)
+        if rc == -3:
+            raise TimeoutError(f"write to {self.name} timed out")
+        if rc == -4:
+            raise ChannelError(
+                f"value of {len(data)} bytes exceeds channel capacity "
+                f"{self.capacity}")
+        if rc != 0:
+            raise ChannelError(f"write failed rc={rc}")
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        # reuse one capacity-sized buffer: create_string_buffer zero-fills,
+        # which would dominate per-read cost for multi-MB channels
+        buf = getattr(self, "_read_buf", None)
+        if buf is None:
+            cap = self._lib_ref.rtpu_chan_capacity(self._h)
+            buf = self._read_buf = ctypes.create_string_buffer(cap)
+        cap = len(buf)
+        seq = ctypes.c_uint64()
+        ln = ctypes.c_uint64()
+        rc = self._lib_ref.rtpu_chan_read(
+            self._h, self._last_seq, buf, cap, ctypes.byref(seq),
+            ctypes.byref(ln), -1 if timeout is None else int(timeout * 1000))
+        if rc == -2:
+            raise ChannelClosedError(self.name)
+        if rc == -3:
+            raise TimeoutError(f"read from {self.name} timed out")
+        if rc != 0:
+            raise ChannelError(f"read failed rc={rc}")
+        self._last_seq = seq.value
+        # string_at copies exactly len bytes (buf.raw would copy the whole
+        # capacity-sized buffer first)
+        return serialization.loads(ctypes.string_at(buf, ln.value))
+
+    def close(self, unlink: bool = False) -> None:
+        if self._h:
+            self._lib_ref.rtpu_chan_close(self._h, 1 if unlink else 0)
+            self._h = None
+
+    def __reduce__(self):
+        # channels travel by name; receivers attach
+        return (Channel.attach, (self.name,))

@@ -1,0 +1,53 @@
+"""Actor-side compiled-DAG loop (reference do_exec_tasks,
+compiled_dag_node.py:191): attach edge channels, then loop
+READ -> COMPUTE -> WRITE until the driver closes the channels."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu.dag.channel import Channel, ChannelClosedError
+
+
+def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
+    chans: Dict[str, Channel] = {}
+
+    def chan(name: str) -> Channel:
+        if name not in chans:
+            chans[name] = Channel.attach(name)
+        return chans[name]
+
+    # attach everything up front so the first iteration doesn't race creation
+    for step in schedule:
+        for kind, val in list(step["args"]) + list(step["kwargs"].values()):
+            if kind == "chan":
+                chan(val)
+        if step["out_chan"]:
+            chan(step["out_chan"])
+
+    iterations = 0
+    try:
+        while True:
+            # one channel may feed several steps in an iteration: read once
+            read_cache: Dict[str, Any] = {}
+
+            def fetch(name: str) -> Any:
+                if name not in read_cache:
+                    read_cache[name] = chan(name).read()
+                return read_cache[name]
+
+            for step in schedule:
+                args = [fetch(v) if kind == "chan" else v
+                        for kind, v in step["args"]]
+                kwargs = {k: (fetch(v) if kind == "chan" else v)
+                          for k, (kind, v) in step["kwargs"].items()}
+                result = getattr(instance, step["method"])(*args, **kwargs)
+                out = step["out_chan"]
+                if out:
+                    # same-actor downstream steps re-read the channel (their
+                    # ack is counted in num_readers); single-slot channels
+                    # support read-after-write in the same thread
+                    chan(out).write(result)
+            iterations += 1
+    except ChannelClosedError:
+        return iterations

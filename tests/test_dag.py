@@ -1,0 +1,190 @@
+"""DAG API + compiled-graph channels (reference python/ray/dag tests +
+experimental/channel tests)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import Channel, ChannelClosedError, InputNode, MultiOutputNode
+from ray_tpu.core.native_store import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+# ----------------------------------------------------------------- channels
+def test_channel_roundtrip():
+    ch = Channel(capacity=1 << 20)
+    try:
+        ch.write({"x": 1, "arr": list(range(100))})
+        reader = Channel.attach(ch.name)
+        assert reader.read(timeout=5) == {"x": 1, "arr": list(range(100))}
+    finally:
+        ch.close(unlink=True)
+
+
+def test_channel_blocking_handoff():
+    import threading
+
+    ch = Channel(capacity=1 << 16, num_readers=1)
+    got = []
+
+    def consume():
+        r = Channel.attach(ch.name)
+        for _ in range(5):
+            got.append(r.read(timeout=5))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(5):
+        ch.write(i, timeout=5)
+    t.join(timeout=10)
+    assert got == [0, 1, 2, 3, 4]
+    ch.close(unlink=True)
+
+
+def test_channel_close_unblocks_reader():
+    import threading
+
+    ch = Channel(capacity=1 << 16)
+    errs = []
+
+    def consume():
+        r = Channel.attach(ch.name)
+        try:
+            r.read(timeout=10)
+        except ChannelClosedError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.2)
+    ch.close(unlink=True)
+    t.join(timeout=5)
+    assert errs
+
+
+# -------------------------------------------------------------- eager DAGs
+def test_eager_function_dag(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        dag = mul.bind(add.bind(inp, 2), 10)
+    ref = dag.execute(3)
+    assert ray_tpu.get(ref) == 50
+
+
+# ------------------------------------------------------------ compiled DAGs
+def test_compiled_linear_pipeline(cluster):
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def fwd(self, x):
+            return x + self.k
+
+    a = Stage.remote(1)
+    b = Stage.remote(10)
+    with InputNode() as inp:
+        dag = b.fwd.bind(a.fwd.bind(inp))
+    cdag = dag.experimental_compile()
+    try:
+        for i in range(5):
+            ref = cdag.execute(i)
+            assert ref.get() == i + 11
+    finally:
+        cdag.teardown(kill_actors=True)
+
+
+def test_compiled_fan_out_fan_in(cluster):
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return 2 * x
+
+        def square(self, x):
+            return x * x
+
+        def merge(self, a, b):
+            return a + b
+
+    w1, w2, w3 = Worker.remote(), Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        d = w1.double.bind(inp)
+        s = w2.square.bind(inp)
+        dag = w3.merge.bind(d, s)
+    cdag = dag.experimental_compile()
+    try:
+        assert cdag.execute(3).get() == 6 + 9
+        assert cdag.execute(5).get() == 10 + 25
+    finally:
+        cdag.teardown(kill_actors=True)
+
+
+def test_compiled_multi_output(cluster):
+    @ray_tpu.remote
+    class Worker:
+        def inc(self, x):
+            return x + 1
+
+        def dec(self, x):
+            return x - 1
+
+    w1, w2 = Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([w1.inc.bind(inp), w2.dec.bind(inp)])
+    cdag = dag.experimental_compile()
+    try:
+        r1, r2 = cdag.execute(10)
+        assert r1.get() == 11
+        assert r2.get() == 9
+    finally:
+        cdag.teardown(kill_actors=True)
+
+
+def test_compiled_throughput_beats_actor_calls(cluster):
+    """The point of compiling: steady-state hops skip the RPC path."""
+
+    @ray_tpu.remote
+    class Echo:
+        def fwd(self, x):
+            return x
+
+    e = Echo.remote()
+    # warm the actor
+    ray_tpu.get(e.fwd.remote(0))
+    n = 50
+    t0 = time.perf_counter()
+    for i in range(n):
+        ray_tpu.get(e.fwd.remote(i))
+    actor_call_dt = time.perf_counter() - t0
+
+    e2 = Echo.remote()
+    with InputNode() as inp:
+        dag = e2.fwd.bind(inp)
+    cdag = dag.experimental_compile()
+    try:
+        cdag.execute(0).get()  # warm the loop
+        t0 = time.perf_counter()
+        for i in range(n):
+            cdag.execute(i).get()
+        compiled_dt = time.perf_counter() - t0
+    finally:
+        cdag.teardown(kill_actors=True)
+    assert compiled_dt < actor_call_dt, (
+        f"compiled {compiled_dt:.4f}s not faster than RPC {actor_call_dt:.4f}s")
