@@ -11,8 +11,8 @@ from ray_tpu._version import version as __version__
 # parallel) don't pay for it, and vice versa.
 _CORE_API = (
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "method", "get_runtime_context", "nodes",
-    "available_resources", "cluster_resources", "ObjectRef", "actor",
+    "kill", "cancel", "method", "get_runtime_context", "nodes", "get_actor",
+    "available_resources", "cluster_resources", "ObjectRef", "actor", "free",
 )
 
 

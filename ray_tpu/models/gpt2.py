@@ -268,6 +268,72 @@ def loss_fn(params: Params, batch: dict, cfg: GPT2Config) -> jax.Array:
     return jnp.mean(logz - gold)
 
 
+# ---------------------------------------------------------------------------
+# KV-cache decode (serving path)
+# ---------------------------------------------------------------------------
+
+def init_cache(cfg: GPT2Config, batch: int, max_len: Optional[int] = None):
+    """Per-layer KV cache: {"k","v"}: [n_layer, B, H, T, Dh] (compute dtype)."""
+    T = max_len or cfg.max_seq_len
+    shape = (cfg.n_layer, batch, cfg.n_head, T, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def decode_step(params: Params, cache, tokens: jax.Array, pos: jax.Array,
+                active: jax.Array, cfg: GPT2Config):
+    """One decode step for a continuous batch.
+
+    tokens [B] int32 (current input token per slot), pos [B] int32 (its
+    position), active [B] bool (slots whose cache should advance). Returns
+    (logits [B, vocab] f32, new_cache). Inactive slots' caches are untouched
+    and their logits are garbage — the engine masks them.
+    """
+    B = tokens.shape[0]
+    H, Dh = cfg.n_head, cfg.head_dim
+    T = cache["k"].shape[3]
+    wte = params["wte"]
+    x = wte[tokens] + params["wpe"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+    x = x.astype(cfg.dtype)                                   # [B, D]
+
+    def upd_one(c_b, val_b, p_b):
+        # c_b [H, T, Dh], val_b [H, Dh] -> write at position p_b
+        return jax.lax.dynamic_update_slice(
+            c_b, val_b[:, None, :], (0, p_b, 0))
+
+    def layer(x, scanned):
+        bp, ck, cv = scanned                                  # ck/cv [B,H,T,Dh]
+        h = _layer_norm(x, bp["ln1"])
+        qkv = h @ bp["attn"]["wqkv"].astype(cfg.dtype) + \
+            bp["attn"]["bqkv"].astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, H, Dh)
+        k = k.reshape(B, H, Dh)
+        v = v.reshape(B, H, Dh)
+        ck_new = jax.vmap(upd_one)(ck, k, pos)
+        cv_new = jax.vmap(upd_one)(cv, v, pos)
+        ck = jnp.where(active[:, None, None, None], ck_new, ck)
+        cv = jnp.where(active[:, None, None, None], cv_new, cv)
+        scores = jnp.einsum("bhd,bhtd->bht", q, ck,
+                            preferred_element_type=jnp.float32)
+        scores = scores / math.sqrt(Dh)
+        t_idx = jnp.arange(T)[None, None, :]
+        scores = jnp.where(t_idx <= pos[:, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bht,bhtd->bhd", probs, cv)
+        attn = attn.reshape(B, H * Dh)
+        attn = attn @ bp["attn"]["wo"].astype(cfg.dtype) + \
+            bp["attn"]["bo"].astype(cfg.dtype)
+        x = x + attn
+        x = x + _mlp(_layer_norm(x, bp["ln2"]), bp["mlp"], cfg)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(layer, x,
+                                 (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["ln_f"])
+    logits = (x @ wte.T.astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def num_params(cfg: GPT2Config) -> int:
     d, f, L, V, S = cfg.d_model, cfg.d_ff, cfg.n_layer, cfg.vocab_size, cfg.max_seq_len
     per_block = (3 * d * d + 3 * d) + (d * d + d) + (2 * d * f + f + d) + 4 * d

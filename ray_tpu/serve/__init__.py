@@ -1,14 +1,18 @@
-"""ray_tpu.serve: model serving — controller, replicas, routing, batching,
-autoscaling. Reference: `python/ray/serve/` (SURVEY §2.5)."""
+"""ray_tpu.serve: model serving — controller, replicas, HTTP proxy, routing,
+batching, multiplexing, autoscaling, LLM deployments.
+Reference: `python/ray/serve/` (SURVEY §2.5)."""
 
 from ray_tpu.serve.api import (Deployment, delete, deployment,
-                               get_deployment_handle, run, shutdown, status)
+                               get_deployment_handle, run, shutdown, start,
+                               status)
 from ray_tpu.serve.autoscaling import AutoscalingConfig
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
-    "Deployment", "deployment", "run", "delete", "shutdown", "status",
-    "get_deployment_handle", "AutoscalingConfig", "batch",
-    "DeploymentHandle", "DeploymentResponse",
+    "Deployment", "deployment", "run", "delete", "shutdown", "start",
+    "status", "get_deployment_handle", "AutoscalingConfig", "batch",
+    "DeploymentHandle", "DeploymentResponse", "multiplexed",
+    "get_multiplexed_model_id",
 ]

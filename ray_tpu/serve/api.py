@@ -89,6 +89,14 @@ def _get_or_create_controller():
             num_cpus=0).remote()
 
 
+def start(http_host: str = "127.0.0.1", http_port: int = 0) -> int:
+    """Start the HTTP ingress proxy; returns the bound port (reference
+    serve.start(http_options=...))."""
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.ensure_proxy.remote(http_host, http_port),
+                       timeout=120)
+
+
 def run(target: Deployment, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None,
         _blocking: bool = True) -> DeploymentHandle:
@@ -97,6 +105,9 @@ def run(target: Deployment, *, name: Optional[str] = None,
     dep_name = name or target.name
     ray_tpu.get(controller.deploy.remote(dep_name, target.to_config()),
                 timeout=60)
+    if route_prefix is not None:
+        ray_tpu.get(controller.set_route.remote(route_prefix, dep_name),
+                    timeout=30)
     handle = DeploymentHandle(dep_name, controller)
     if _blocking:
         _wait_healthy(controller, dep_name)

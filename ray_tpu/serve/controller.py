@@ -48,6 +48,10 @@ class DeploymentInfo:
 class ServeController:
     def __init__(self):
         self.deployments: Dict[str, DeploymentInfo] = {}
+        self.routes: Dict[str, str] = {}        # route prefix -> deployment
+        self.multiplexed: Dict[str, Dict[str, list]] = {}  # dep -> tag -> ids
+        self._proxy = None
+        self._proxy_port: Optional[int] = None
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._last_health = 0.0
@@ -87,7 +91,41 @@ class ServeController:
             if info is None:
                 return None
             return {"version": info.version,
-                    "replicas": {tag: h for tag, h in info.replicas.items()}}
+                    "replicas": {tag: h for tag, h in info.replicas.items()},
+                    "models": dict(self.multiplexed.get(name, {}))}
+
+    # ------------------------------------------------------- routes / proxy
+    def set_route(self, route_prefix: str, deployment_name: str):
+        with self._lock:
+            self.routes[route_prefix] = deployment_name
+        return True
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self.routes)
+
+    def record_multiplexed_models(self, deployment: str, tag: str, ids: list):
+        with self._lock:
+            self.multiplexed.setdefault(deployment, {})[tag] = list(ids)
+        return True
+
+    def ensure_proxy(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start (or return) the HTTP ingress proxy; returns its port."""
+        with self._lock:
+            if self._proxy_port is not None:
+                return self._proxy_port
+        from ray_tpu.serve.proxy import ProxyActor
+
+        # handle to ourselves, resolvable from any process
+        self_handle = ray_tpu.get_actor("serve-controller")
+        proxy = ProxyActor.options(
+            name="serve-proxy", get_if_exists=True, max_concurrency=64,
+            num_cpus=0).remote(self_handle)
+        proxy_port = ray_tpu.get(proxy.start.remote(host, port), timeout=60)
+        with self._lock:
+            self._proxy = proxy
+            self._proxy_port = proxy_port
+        return proxy_port
 
     def list_deployments(self):
         with self._lock:
@@ -110,6 +148,14 @@ class ServeController:
         with self._lock:
             deployments = list(self.deployments.values())
             self.deployments = {}
+            self.routes = {}
+            proxy, self._proxy, self._proxy_port = self._proxy, None, None
+        if proxy is not None:
+            try:
+                ray_tpu.get(proxy.stop.remote(), timeout=10)
+                ray_tpu.kill(proxy)
+            except Exception:
+                pass
         for info in deployments:
             for h in info.replicas.values():
                 self._stop_replica(h)

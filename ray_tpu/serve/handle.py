@@ -36,22 +36,28 @@ class DeploymentResponse:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 multiplexed_model_id: Optional[str] = None):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method_name = method_name
+        self._model_id = multiplexed_model_id
         self._table: Dict[str, Any] = {}
+        self._models: Dict[str, list] = {}
         self._table_version = -1
         self._table_ts = 0.0
         self._inflight: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     # --------------------------------------------------------------- remote
-    def options(self, method_name: str) -> "DeploymentHandle":
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self._controller,
-                             method_name)
+                             method_name or self._method_name,
+                             multiplexed_model_id or self._model_id)
         h._table, h._table_version = self._table, self._table_version
         h._table_ts, h._inflight = self._table_ts, self._inflight
+        h._models = self._models
         h._lock = self._lock
         return h
 
@@ -65,6 +71,8 @@ class DeploymentHandle:
 
     def _submit(self, method: str, args, kwargs) -> DeploymentResponse:
         replica_tag, handle = self._pick_replica()
+        if self._model_id:
+            kwargs = {**kwargs, "_multiplexed_model_id": self._model_id}
         with self._lock:
             self._inflight[replica_tag] = self._inflight.get(replica_tag, 0) + 1
         ref = handle.handle_request.remote(method, args, kwargs)
@@ -89,6 +97,7 @@ class DeploymentHandle:
             raise KeyError(f"deployment {self.deployment_name!r} not found")
         with self._lock:
             self._table = table["replicas"]
+            self._models = table.get("models", {})
             self._table_version = table["version"]
             self._table_ts = now
             self._inflight = {t: self._inflight.get(t, 0) for t in self._table}
@@ -104,6 +113,12 @@ class DeploymentHandle:
             self._refresh_table(force=True)
         with self._lock:
             tags = list(self._table)
+            if self._model_id:
+                # prefer replicas that already have the model loaded
+                warm = [t for t in tags
+                        if self._model_id in self._models.get(t, [])]
+                if warm:
+                    tags = warm
             if len(tags) == 1:
                 tag = tags[0]
             else:  # power of two choices on local in-flight counts

@@ -1,0 +1,217 @@
+"""LLM serving: continuous-batching decode engine on TPU + deployment glue.
+
+Capability counterpart of the reference's serve.llm stack
+(`python/ray/llm/_internal/serve/` — vLLM engine behind deployments). The
+TPU-native engine is ours: a jitted GPT-2 KV-cache decode step over a fixed
+slot batch (ray_tpu/models/gpt2.py decode_step); requests are admitted into
+free slots as others finish (continuous batching), so decode throughput
+stays at the full batch width under load.
+
+No network egress: prompts are byte-level tokenized by default (real
+checkpoints would ship their own tokenizer).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ByteTokenizer:
+    """utf-8 bytes as token ids (0-255); eos = 0. Self-contained fallback so
+    serving works without downloaded vocabularies."""
+
+    eos_id = 0
+
+    def encode(self, text: str) -> List[int]:
+        return [b + 1 for b in text.encode("utf-8")][:2048]
+
+    def decode(self, ids: List[int]) -> str:
+        # ids beyond the byte range (larger model vocabs) wrap; this is a
+        # demo tokenizer, not a real vocabulary
+        return bytes((i - 1) % 256 for i in ids if i > 0).decode(
+            "utf-8", errors="replace")
+
+
+class _Request:
+    def __init__(self, prompt_ids: List[int], max_tokens: int,
+                 temperature: float):
+        self.prompt_ids = prompt_ids
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.generated: List[int] = []
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+
+
+class LLMEngine:
+    """Continuous-batching decode engine over a fixed slot batch."""
+
+    def __init__(self, preset: str = "gpt2-tiny", max_batch: int = 4,
+                 max_seq_len: int = 128, seed: int = 0,
+                 model_overrides: Optional[dict] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import gpt2
+
+        self.jax, self.jnp, self.gpt2 = jax, jnp, gpt2
+        overrides = dict(model_overrides or {})
+        overrides.setdefault("max_seq_len", max_seq_len)
+        self.cfg = gpt2.GPT2Config.preset(preset, **overrides)
+        self.params = gpt2.init_params(jax.random.key(seed), self.cfg)
+        self.max_batch = max_batch
+        self.max_seq_len = self.cfg.max_seq_len
+        self.cache = gpt2.init_cache(self.cfg, max_batch, self.max_seq_len)
+        cfg = self.cfg
+
+        def _step(params, cache, tokens, pos, active):
+            return gpt2.decode_step(params, cache, tokens, pos, active, cfg)
+
+        self._step = jax.jit(_step, donate_argnums=(1,))
+        self.tokenizer = ByteTokenizer()
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._slots: List[Optional[_Request]] = [None] * max_batch
+        self._slot_pos = [0] * max_batch
+        self._slot_prefill: List[List[int]] = [[] for _ in range(max_batch)]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._engine_loop, daemon=True,
+                                        name="llm-engine")
+        self._thread.start()
+        self.total_generated = 0
+
+    # ------------------------------------------------------------- public
+    def generate(self, prompt: str = "", prompt_ids: Optional[List[int]] = None,
+                 max_tokens: int = 16, temperature: float = 0.0,
+                 timeout: float = 120.0) -> Dict[str, Any]:
+        ids = prompt_ids if prompt_ids is not None else self.tokenizer.encode(prompt)
+        ids = ids or [self.tokenizer.eos_id]
+        ids = ids[-(self.max_seq_len - 2):]  # keep room to generate
+        budget = self.max_seq_len - len(ids) - 1
+        req = _Request(ids, max(0, min(max_tokens, budget)), temperature)
+        self._queue.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error:
+            raise RuntimeError(req.error)
+        return {"token_ids": req.generated,
+                "text": self.tokenizer.decode(req.generated)}
+
+    def shutdown(self):
+        self._stop.set()
+
+    # ------------------------------------------------------------- engine
+    def _admit(self):
+        for i in range(self.max_batch):
+            if self._slots[i] is None:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+                self._slots[i] = req
+                self._slot_pos[i] = 0
+                self._slot_prefill[i] = list(req.prompt_ids)
+
+    def _engine_loop(self):
+        import numpy as np
+
+        jnp = self.jnp
+        rng = np.random.default_rng(0)
+        while not self._stop.is_set():
+            self._admit()
+            live = [i for i, r in enumerate(self._slots) if r is not None]
+            if not live:
+                time.sleep(0.005)
+                continue
+            tokens = np.zeros((self.max_batch,), np.int32)
+            pos = np.asarray(self._slot_pos, np.int32)
+            active = np.zeros((self.max_batch,), bool)
+            for i in live:
+                active[i] = True
+                if self._slot_prefill[i]:
+                    tokens[i] = self._slot_prefill[i][0]
+                else:
+                    tokens[i] = (self._slots[i].generated[-1]
+                                 if self._slots[i].generated
+                                 else self._slots[i].prompt_ids[-1])
+            logits, self.cache = self._step(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(pos), jnp.asarray(active))
+            logits = np.asarray(logits)
+            for i in live:
+                req = self._slots[i]
+                self._slot_pos[i] += 1
+                if self._slot_prefill[i]:
+                    self._slot_prefill[i].pop(0)
+                    if self._slot_prefill[i]:
+                        continue  # still prefilling; ignore logits
+                # sample the next token from this step's logits
+                if req.temperature > 0:
+                    p = np.exp((logits[i] - logits[i].max()) / req.temperature)
+                    p /= p.sum()
+                    nxt = int(rng.choice(len(p), p=p))
+                else:
+                    nxt = int(np.argmax(logits[i]))
+                req.generated.append(nxt)
+                self.total_generated += 1
+                if (len(req.generated) >= req.max_tokens
+                        or nxt == self.tokenizer.eos_id
+                        or self._slot_pos[i] >= self.max_seq_len - 1):
+                    self._slots[i] = None
+                    req.done.set()
+
+
+class LLMServer:
+    """Deployment callable: OpenAI-completions-shaped request handling."""
+
+    def __init__(self, preset: str = "gpt2-tiny", max_batch: int = 4,
+                 max_seq_len: int = 128, model_overrides: Optional[dict] = None):
+        self.engine = LLMEngine(preset=preset, max_batch=max_batch,
+                                max_seq_len=max_seq_len,
+                                model_overrides=model_overrides)
+
+    def __call__(self, request: Any) -> dict:
+        body = request if isinstance(request, dict) else getattr(
+            request, "json", None) or {}
+        out = self.engine.generate(
+            prompt=body.get("prompt", ""),
+            prompt_ids=body.get("prompt_ids"),
+            max_tokens=int(body.get("max_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)))
+        return {
+            "object": "text_completion",
+            "choices": [{"text": out["text"], "index": 0,
+                         "token_ids": out["token_ids"],
+                         "finish_reason": "length"}],
+            "usage": {"completion_tokens": len(out["token_ids"])},
+        }
+
+    def stats(self) -> dict:
+        return {"total_generated": self.engine.total_generated,
+                "max_batch": self.engine.max_batch}
+
+    def check_health(self):
+        if not self.engine._thread.is_alive():
+            raise RuntimeError("engine loop died")
+
+
+def build_llm_deployment(preset: str = "gpt2-tiny", max_batch: int = 4,
+                         max_seq_len: int = 128, num_replicas: int = 1,
+                         name: str = "llm",
+                         model_overrides: Optional[dict] = None,
+                         num_tpu_chips: int = 0):
+    """Deployment for an LLM server (reference build_openai_app analog)."""
+    from ray_tpu.serve.api import deployment
+
+    actor_options = {"num_cpus": 1}
+    if num_tpu_chips:
+        actor_options["num_tpu_chips"] = num_tpu_chips
+    dep = deployment(
+        LLMServer, name=name, num_replicas=num_replicas,
+        ray_actor_options=actor_options,
+        max_ongoing_requests=max_batch * 2)
+    return dep.bind(preset=preset, max_batch=max_batch,
+                    max_seq_len=max_seq_len, model_overrides=model_overrides)

@@ -1,0 +1,197 @@
+"""HTTP ingress proxy actor (aiohttp) + async client-side router.
+
+Parity with the reference's per-node proxy actors
+(`python/ray/serve/_private/proxy.py`, starlette/uvicorn) re-based on
+aiohttp: the proxy polls the controller for the route table (long-poll-lite,
+`long_poll.py` role), matches the longest route prefix, pow-2-routes to a
+replica, and awaits the reply on the event loop — requests never block the
+loop thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+ROUTE_REFRESH_S = 1.0
+
+
+class Request:
+    """What a deployment callable receives for an HTTP request."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, str],
+                 headers: Dict[str, str], body: bytes, json: Any):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.json = json
+
+    def __getitem__(self, key):  # dict-style access to the json body
+        return (self.json or {})[key]
+
+    def get(self, key, default=None):
+        return (self.json or {}).get(key, default)
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query, self.headers,
+                          self.body, self.json))
+
+
+class _AsyncRouter:
+    """Pow-2 replica choice with local in-flight counts, all-async."""
+
+    def __init__(self, controller, deployment: str):
+        self._controller = controller
+        self._deployment = deployment
+        self._table: Dict[str, Any] = {}
+        self._model_map: Dict[str, list] = {}
+        self._ts = 0.0
+        self._inflight: Dict[str, int] = {}
+
+    async def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._ts < ROUTE_REFRESH_S:
+            return
+        ref = self._controller.get_routing_table.remote(self._deployment)
+        table = await ref
+        if table:
+            self._table = table["replicas"]
+            self._model_map = table.get("models", {})
+            self._inflight = {t: self._inflight.get(t, 0)
+                              for t in self._table}
+        self._ts = now
+
+    async def submit(self, method: str, args: tuple, kwargs: dict,
+                     model_id: Optional[str] = None):
+        await self._refresh()
+        deadline = time.monotonic() + 30
+        while not self._table:
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"no replicas for {self._deployment}")
+            await asyncio.sleep(0.1)
+            await self._refresh(force=True)
+        tags = list(self._table)
+        if model_id:
+            warm = [t for t in tags
+                    if model_id in self._model_map.get(t, [])]
+            if warm:
+                tags = warm
+            kwargs = {**kwargs, "_multiplexed_model_id": model_id}
+        if len(tags) == 1:
+            tag = tags[0]
+        else:
+            a, b = random.sample(tags, 2)
+            tag = (a if self._inflight.get(a, 0) <= self._inflight.get(b, 0)
+                   else b)
+        self._inflight[tag] = self._inflight.get(tag, 0) + 1
+        try:
+            handle = self._table[tag]
+            # .remote() can block on the head for large payloads (object
+            # registration); keep it off the event loop
+            loop = asyncio.get_running_loop()
+            ref = await loop.run_in_executor(
+                None, lambda: handle.handle_request.remote(
+                    method, args, kwargs))
+            return await ref
+        finally:
+            self._inflight[tag] = max(0, self._inflight.get(tag, 1) - 1)
+
+
+@ray_tpu.remote
+class ProxyActor:
+    """Per-node HTTP ingress. Async actor: aiohttp server on the event loop.
+
+    The controller HANDLE is passed in (never looked up here): proxy code
+    runs on the worker's event loop, where blocking client calls would
+    deadlock — everything control-plane is awaited.
+    """
+
+    def __init__(self, controller_handle):
+        self._controller = controller_handle
+        self._routes: Dict[str, str] = {}
+        self._routers: Dict[str, _AsyncRouter] = {}
+        self._routes_ts = 0.0
+        self._runner = None
+        self.port: Optional[int] = None
+
+    def _get_controller(self):
+        return self._controller
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def _refresh_routes(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._routes_ts < ROUTE_REFRESH_S:
+            return
+        self._routes = await self._get_controller().get_routes.remote()
+        self._routes_ts = now
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        await self._refresh_routes()
+        path = "/" + request.match_info["tail"]
+        match = None
+        for prefix in sorted(self._routes, key=len, reverse=True):
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") \
+                    or prefix == "/":
+                match = prefix
+                break
+        if match is None:
+            await self._refresh_routes(force=True)
+            for prefix in sorted(self._routes, key=len, reverse=True):
+                if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                    match = prefix
+                    break
+        if match is None:
+            return web.json_response({"error": f"no route for {path}"},
+                                     status=404)
+        deployment = self._routes[match]
+        router = self._routers.get(deployment)
+        if router is None:
+            router = self._routers[deployment] = _AsyncRouter(
+                self._get_controller(), deployment)
+        body = await request.read()
+        try:
+            json_body = await request.json() if body else None
+        except Exception:
+            json_body = None
+        req = Request(request.method, path, dict(request.query),
+                      dict(request.headers), body, json_body)
+        model_id = request.headers.get("serve_multiplexed_model_id")
+        try:
+            result = await router.submit("__call__", (req,), {},
+                                         model_id=model_id)
+        except Exception as e:  # noqa: BLE001 - surface as HTTP 500
+            return web.json_response({"error": repr(e)}, status=500)
+        if isinstance(result, web.Response):
+            return result
+        if isinstance(result, (dict, list)):
+            return web.json_response(result)
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        return web.Response(text=str(result))
+
+    async def ready(self) -> int:
+        return self.port
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+        return True

@@ -50,10 +50,16 @@ class ReplicaActor:
     def handle_request(self, method: str, args: tuple, kwargs: dict):
         if self._draining:
             raise RuntimeError(f"replica {self.replica_tag} is draining")
+        model_id = kwargs.pop("_multiplexed_model_id", None)
         with self._ongoing_lock:
             self._ongoing += 1
             self._total += 1
         try:
+            from ray_tpu.serve import multiplex
+
+            if model_id is not None:
+                multiplex._set_request_model_id(model_id)
+            multiplex._replica_reporter.set(self._report_models)
             target = (self.callable if method == "__call__"
                       and not isinstance(self.callable, type)
                       and callable(self.callable)
@@ -64,6 +70,20 @@ class ReplicaActor:
         finally:
             with self._ongoing_lock:
                 self._ongoing -= 1
+
+    def _report_models(self, model_ids):
+        """Push the loaded-model set so routers prefer warm replicas."""
+        try:
+            ctrl = ray_tpu.get_actor("serve-controller")
+            ctrl.record_multiplexed_models.remote(
+                self.deployment_name, self.replica_tag, list(model_ids))
+        except Exception:
+            pass
+
+    def loaded_model_ids(self):
+        from ray_tpu.serve.multiplex import loaded_model_ids_of
+
+        return loaded_model_ids_of(self.callable)
 
     # -------------------------------------------------------------- control
     def reconfigure(self, user_config):
