@@ -233,13 +233,23 @@ def _block(x, bp, cfg: GPT2Config):
     return x
 
 
+def embed(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B,T] int32 -> embeddings [B,T,D] (compute dtype)."""
+    T = tokens.shape[1]
+    x = params["wte"][tokens] + params["wpe"][:T][None]
+    return constrain(x.astype(cfg.dtype), "batch", "seq", "embed")
+
+
+def unembed(params: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """final hidden [B,T,D] -> logits [B,T,vocab] (tied embeddings)."""
+    x = _layer_norm(x, params["ln_f"])
+    logits = x @ params["wte"].T.astype(cfg.dtype)
+    return constrain(logits, "batch", "seq", "vocab")
+
+
 def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
     """tokens [B, T] int32 -> logits [B, T, vocab] (compute dtype)."""
-    B, T = tokens.shape
-    wte = params["wte"]
-    x = wte[tokens] + params["wpe"][:T][None]
-    x = x.astype(cfg.dtype)
-    x = constrain(x, "batch", "seq", "embed")
+    x = embed(params, tokens, cfg)
 
     block_fn = partial(_block, cfg=cfg)
     if cfg.remat:
@@ -249,23 +259,16 @@ def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
         return block_fn(carry, bp), None
 
     x, _ = lax.scan(scan_body, x, params["blocks"])
-    x = _layer_norm(x, params["ln_f"])
-    logits = x @ wte.T.astype(cfg.dtype)  # tied embeddings
-    logits = constrain(logits, "batch", "seq", "vocab")
-    return logits
+    return unembed(params, x, cfg)
 
 
 def loss_fn(params: Params, batch: dict, cfg: GPT2Config) -> jax.Array:
     """Next-token cross-entropy. batch = {"tokens": [B,T+1] int32} or
     {"inputs": [B,T], "targets": [B,T]}."""
-    if "tokens" in batch:
-        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-    else:
-        inputs, targets = batch["inputs"], batch["targets"]
-    logits = forward(params, inputs, cfg).astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    from ray_tpu.models.lm import cross_entropy, split_lm_batch
+
+    inputs, targets = split_lm_batch(batch)
+    return cross_entropy(forward(params, inputs, cfg), targets)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,21 @@
+"""Shared language-model loss plumbing used by every model family."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def split_lm_batch(batch: dict):
+    """{"tokens": [B,T+1]} or {"inputs","targets"} -> (inputs, targets)."""
+    if "tokens" in batch:
+        return batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    return batch["inputs"], batch["targets"]
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy; logits upcast to f32 for the softmax."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

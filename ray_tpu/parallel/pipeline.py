@@ -1,0 +1,143 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over the `pp` mesh axis.
+
+The reference gets pipeline parallelism two ways (SURVEY.md §2.13): vLLM's
+multi-node PP driven through placement groups, and Compiled Graphs
+(`python/ray/dag/compiled_dag_node.py`) whose per-actor READ/COMPUTE/WRITE
+schedules pipeline NCCL send/recv between stages. The TPU-native answer keeps
+the whole pipeline INSIDE one XLA program: stages are a `pp` mesh axis, stage
+hand-off is `lax.ppermute` riding the ICI ring, and the schedule is a
+`lax.scan` over M + F - 1 ticks — XLA overlaps the permute with the next
+tick's compute, no host in the loop.
+
+Design (partial-manual shard_map):
+- only `pp` is manual (`axis_names={'pp'}`); dp/fsdp/tp stay auto, so the
+  stage function can keep its ordinary sharding annotations and XLA still
+  inserts dp gradient allreduces etc.;
+- stage params have a leading stage dim sharded over `pp`; each instance
+  squeezes its own stage's slice;
+- microbatch schedule: at tick t, stage 0 injects microbatch t (t < M), the
+  last stage emits microbatch t-(F-1); a final masked `psum` replicates the
+  output to every stage so downstream (loss/unembed) code sees a plain
+  replicated-over-pp activation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.mesh import current_mesh
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    n_microbatches: int,
+    mesh=None,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run `stage_fn` as a `pp`-deep pipeline over microbatches of `x`.
+
+    stage_params: pytree whose every leaf has leading dim = pp degree
+      (stage-stacked), sharded over `axis`.
+    x: [B, ...] activations; B % n_microbatches == 0.
+    stage_fn(params_for_one_stage, x_mb) -> x_mb.
+    """
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise RuntimeError("pipeline_apply needs a mesh (use_mesh or mesh=)")
+    F = mesh.shape[axis]
+    if F == 1:
+        sp = jax.tree.map(lambda a: a[0], stage_params)
+        return stage_fn(sp, x)
+
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    if M < F:
+        raise ValueError(f"n_microbatches {M} < pipeline depth {F}: "
+                         "bubble would dominate; use M >= pp")
+    # The shard_map boundary runs in f32: XLA's CPU backend (the dryrun/test
+    # platform) miscompiles sub-group bf16 psum in partial-manual regions
+    # ("Invalid binary instruction opcode copy" CHECK), and this also covers
+    # the backward-pass psum of the replicated input's cotangent. Compute
+    # inside the stages stays in x.dtype.
+    compute_dtype = x.dtype
+    xs = x.reshape(M, B // M, *x.shape[1:]).astype(jnp.float32)
+
+    def spmd_fn(stage_p, xs):
+        xs = xs.astype(compute_dtype)
+        stage_p = jax.tree.map(lambda a: a[0], stage_p)   # this stage's slice
+        stage = lax.axis_index(axis)
+        state = jnp.zeros_like(xs[0])
+        outs = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 injects microbatch t
+            inp = lax.dynamic_index_in_dim(xs, jnp.minimum(t, M - 1), 0,
+                                           keepdims=False)
+            state = jnp.where((stage == 0) & (t < M), inp, state)
+            state = stage_fn(stage_p, state)
+            # last stage emits microbatch t-(F-1)
+            out_t = t - (F - 1)
+            idx = jnp.clip(out_t, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
+            new = jnp.where((stage == F - 1) & (out_t >= 0), state, cur)
+            outs = lax.dynamic_update_index_in_dim(outs, new, idx, 0)
+            # rotate activations one stage forward (ICI ring)
+            state = lax.ppermute(state, axis,
+                                 [(i, (i + 1) % F) for i in range(F)])
+            return (state, outs), None
+
+        (state, outs), _ = lax.scan(tick, (state, outs),
+                                    jnp.arange(M + F - 1))
+        # replicate the last stage's outputs to every stage (f32 psum — see
+        # dtype note above)
+        outs = outs.astype(jnp.float32)
+        outs = lax.psum(
+            jnp.where(stage == F - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    out = jax.shard_map(
+        spmd_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )(stage_params, xs)
+    return out.astype(compute_dtype).reshape(B, *x.shape[1:])
+
+
+def stack_stages(block_params: Any, n_stages: int) -> Any:
+    """[L, ...]-stacked block params -> [n_stages, L/n_stages, ...]."""
+
+    def reshape(a):
+        L = a.shape[0]
+        if L % n_stages:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return a.reshape(n_stages, L // n_stages, *a.shape[1:])
+
+    return jax.tree.map(reshape, block_params)
+
+
+def make_stage_fn(block_fn: Callable[[jax.Array, Any], jax.Array],
+                  remat: bool = True) -> Callable:
+    """Lift a single-block fn (x, block_params) -> x into a stage fn that
+    scans its stage's [L/F, ...] blocks."""
+    body = jax.checkpoint(block_fn) if remat else block_fn
+
+    def stage_fn(stage_p, x):
+        x, _ = lax.scan(lambda c, bp: (body(c, bp), None), x, stage_p)
+        return x
+
+    return stage_fn

@@ -144,14 +144,64 @@ def compile_train(
                          batch_sharding=batch_sharding, state_sharding=state_sharding)
 
 
+def compile_model_train(model_mod, cfg, mesh: Mesh, optimizer=None,
+                        rules=None) -> CompiledTrain:
+    """compile_train for any model module exposing loss_fn/init_params/
+    param_specs (ray_tpu.models.{gpt2,llama,moe})."""
+    with mesh_lib.use_mesh(mesh, rules):
+        spec = model_mod.param_specs(cfg)
+    return compile_train(
+        loss_fn=partial(model_mod.loss_fn, cfg=cfg),
+        init_params_fn=partial(model_mod.init_params, cfg=cfg),
+        params_spec=spec,
+        mesh=mesh,
+        optimizer=optimizer,
+        rules=rules,
+    )
+
+
 def compile_gpt2_train(cfg, mesh: Mesh, optimizer=None, rules=None) -> CompiledTrain:
     from ray_tpu.models import gpt2
 
+    return compile_model_train(gpt2, cfg, mesh, optimizer, rules)
+
+
+def compile_pipeline_train(model_mod, cfg, mesh: Mesh, n_microbatches: int,
+                           optimizer=None, rules=None) -> CompiledTrain:
+    """Pipeline-parallel training: the block stack runs as a GPipe microbatch
+    pipeline over the mesh's `pp` axis (ray_tpu.parallel.pipeline), embedding/
+    unembed/loss stay ordinary pjit code. Works for models whose blocks are
+    layer-stacked with a `_block(x, bp, cfg)` body (gpt2, llama).
+
+    Under pp the stacked layer dim is sharded over `pp` (logical rule
+    "layers" -> "pp") so each stage holds only its own layers' weights.
+    """
+    from ray_tpu.parallel.pipeline import (make_stage_fn, pipeline_apply,
+                                           stack_stages)
+
+    F = mesh.shape["pp"]
+    if cfg.n_layer % max(F, 1):
+        raise ValueError(f"n_layer={cfg.n_layer} not divisible by pp={F}")
+    rules = {**(rules or {}), "layers": "pp"}
     with mesh_lib.use_mesh(mesh, rules):
-        spec = gpt2.param_specs(cfg)
+        spec = model_mod.param_specs(cfg)
+
+    stage_fn = make_stage_fn(lambda x, bp: model_mod._block(x, bp, cfg),
+                             remat=cfg.remat)
+
+    from ray_tpu.models.lm import cross_entropy, split_lm_batch
+
+    def loss_fn(params, batch):
+        inputs, targets = split_lm_batch(batch)
+        x = model_mod.embed(params, inputs, cfg)
+        stage_params = stack_stages(params["blocks"], F)
+        x = pipeline_apply(stage_fn, stage_params, x,
+                           n_microbatches=n_microbatches, mesh=mesh)
+        return cross_entropy(model_mod.unembed(params, x, cfg), targets)
+
     return compile_train(
-        loss_fn=partial(gpt2.loss_fn, cfg=cfg),
-        init_params_fn=partial(gpt2.init_params, cfg=cfg),
+        loss_fn=loss_fn,
+        init_params_fn=partial(model_mod.init_params, cfg=cfg),
         params_spec=spec,
         mesh=mesh,
         optimizer=optimizer,
