@@ -235,7 +235,8 @@ def attention(x, p, cfg) -> jax.Array:
 
         out = ulysses_attention(q, k, v, causal=True)
     else:
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
         scores = scores / math.sqrt(Dh)
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
         scores = jnp.where(causal[None, None], scores, -1e30)

@@ -65,13 +65,16 @@ def pipeline_apply(
     if M < F:
         raise ValueError(f"n_microbatches {M} < pipeline depth {F}: "
                          "bubble would dominate; use M >= pp")
-    # The shard_map boundary runs in f32: XLA's CPU backend (the dryrun/test
-    # platform) miscompiles sub-group bf16 psum in partial-manual regions
-    # ("Invalid binary instruction opcode copy" CHECK), and this also covers
-    # the backward-pass psum of the replicated input's cotangent. Compute
-    # inside the stages stays in x.dtype.
+    # On CPU only, the shard_map boundary runs in f32: XLA's CPU backend (the
+    # dryrun/test platform) miscompiles sub-group bf16 psum in partial-manual
+    # regions ("Invalid binary instruction opcode copy" CHECK), and the f32
+    # boundary also covers the backward-pass psum of the replicated input's
+    # cotangent. On TPU the bug doesn't exist and bf16 boundaries halve the
+    # buffer + ICI psum bytes. Compute inside the stages stays in x.dtype.
     compute_dtype = x.dtype
-    xs = x.reshape(M, B // M, *x.shape[1:]).astype(jnp.float32)
+    boundary_dtype = (jnp.float32 if jax.default_backend() == "cpu"
+                      else compute_dtype)
+    xs = x.reshape(M, B // M, *x.shape[1:]).astype(boundary_dtype)
 
     def spmd_fn(stage_p, xs):
         xs = xs.astype(compute_dtype)
@@ -100,9 +103,9 @@ def pipeline_apply(
 
         (state, outs), _ = lax.scan(tick, (state, outs),
                                     jnp.arange(M + F - 1))
-        # replicate the last stage's outputs to every stage (f32 psum — see
-        # dtype note above)
-        outs = outs.astype(jnp.float32)
+        # replicate the last stage's outputs to every stage (psum in the
+        # boundary dtype — see dtype note above)
+        outs = outs.astype(boundary_dtype)
         outs = lax.psum(
             jnp.where(stage == F - 1, outs, jnp.zeros_like(outs)), axis)
         return outs

@@ -1,0 +1,184 @@
+"""Drop-in `multiprocessing.Pool` built on tasks/actors.
+
+Capability-equivalent of the reference's `ray.util.multiprocessing.Pool`
+(`python/ray/util/multiprocessing/pool.py`): a process pool whose workers are
+cluster actors, with the stdlib Pool surface (apply/apply_async, map/map_async,
+starmap, imap, imap_unordered, close/terminate/join) so existing
+multiprocessing code scales across nodes unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+@ray_tpu.remote
+class _PoolActor:
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_batch(self, fn, batch, star):
+        if star:
+            return [fn(*args) for args in batch]
+        return [fn(args) for args in batch]
+
+    def run_apply(self, fn, args, kwargs):
+        return fn(*args, **kwargs)
+
+
+class AsyncResult:
+    """Matches `multiprocessing.pool.AsyncResult`."""
+
+    def __init__(self, refs: List[Any], single: bool, chunked: bool,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        self._refs = refs
+        self._single = single
+        self._chunked = chunked
+        if callback is not None or error_callback is not None:
+            import threading
+
+            def watch():
+                try:
+                    result = self.get()
+                except Exception as e:
+                    if error_callback is not None:
+                        error_callback(e)
+                else:
+                    if callback is not None:
+                        callback(result)
+
+            threading.Thread(target=watch, daemon=True).start()
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        if self._chunked:
+            out = list(itertools.chain.from_iterable(out))
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            ray_tpu.get(self._refs)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Example:
+        with Pool(processes=4) as p:
+            assert p.map(abs, [-1, -2]) == [1, 2]
+    """
+
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs=(), maxtasksperchild: Optional[int] = None,
+                 ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._processes = processes
+        opts = dict(ray_remote_args or {})
+        opts.setdefault("num_cpus", 1)
+        self._actors = [
+            _PoolActor.options(**opts).remote(initializer, tuple(initargs))
+            for _ in range(processes)
+        ]
+        self._pool = ActorPool(self._actors)
+        self._rr = itertools.cycle(self._actors)
+        self._closed = False
+
+    # -------------------------------------------------------------- apply
+    def apply(self, func: Callable, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func: Callable, args=(), kwds=None,
+                    callback: Optional[Callable] = None,
+                    error_callback: Optional[Callable] = None) -> AsyncResult:
+        self._check_running()
+        actor = next(self._rr)
+        ref = actor.run_apply.remote(func, tuple(args), kwds or {})
+        return AsyncResult([ref], single=True, chunked=False,
+                           callback=callback, error_callback=error_callback)
+
+    # ---------------------------------------------------------------- map
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
+
+    def _map_refs(self, func, iterable, chunksize, star):
+        self._check_running()
+        refs = []
+        actors = itertools.cycle(self._actors)
+        for batch in self._chunks(iterable, chunksize):
+            refs.append(next(actors).run_batch.remote(func, batch, star))
+        return refs
+
+    def map(self, func: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return AsyncResult(self._map_refs(func, iterable, chunksize, False),
+                           single=False, chunked=True).get()
+
+    def map_async(self, func, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(self._map_refs(func, iterable, chunksize, False),
+                           single=False, chunked=True)
+
+    def starmap(self, func, iterable, chunksize=None) -> List[Any]:
+        return AsyncResult(self._map_refs(func, iterable, chunksize, True),
+                           single=False, chunked=True).get()
+
+    def starmap_async(self, func, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(self._map_refs(func, iterable, chunksize, True),
+                           single=False, chunked=True)
+
+    def imap(self, func, iterable, chunksize: int = 1):
+        refs = self._map_refs(func, iterable, chunksize, False)
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable, chunksize: int = 1):
+        refs = self._map_refs(func, iterable, chunksize, False)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield from ray_tpu.get(ready[0])
+
+    # -------------------------------------------------------------- admin
+    def _check_running(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for a in self._actors:
+            ray_tpu.kill(a)
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

@@ -111,7 +111,7 @@ def test_concurrency_groups(cluster):
     class Worker:
         @ray_tpu.method(concurrency_group="io")
         def io_wait(self):
-            time.sleep(0.3)
+            time.sleep(0.5)
             return "io"
 
         def compute(self):
@@ -125,7 +125,7 @@ def test_concurrency_groups(cluster):
     out = ray_tpu.get([w.io_wait.remote(), w.io_wait.remote()])
     dt = time.perf_counter() - t0
     assert out == ["io", "io"]
-    assert dt < 0.55, f"io group not concurrent: {dt:.2f}s"
+    assert dt < 0.9, f"io group not concurrent: {dt:.2f}s"
 
 
 # ------------------------------------------------------------ cancellation

@@ -1,0 +1,157 @@
+"""Compat-shim tests: ActorPool, Queue, multiprocessing.Pool, joblib, tqdm.
+
+Mirrors the reference's test strategy for `ray.util.*` drop-ins
+(`python/ray/tests/test_actor_pool.py`, `test_queue.py`,
+`python/ray/util/multiprocessing` tests).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, v):
+        return 2 * v
+
+    def slow_double(self, v):
+        time.sleep(0.05 * (v % 3))
+        return 2 * v
+
+
+def _drain(actors):
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_map(cluster):
+    actors = [_Doubler.remote() for _ in range(3)]
+    pool = ActorPool(actors)
+    assert list(pool.map(lambda a, v: a.double.remote(v), range(10))) == [
+        2 * i for i in range(10)]
+    _drain(actors)
+
+
+def test_actor_pool_map_unordered(cluster):
+    actors = [_Doubler.remote() for _ in range(3)]
+    pool = ActorPool(actors)
+    out = list(pool.map_unordered(
+        lambda a, v: a.slow_double.remote(v), range(9)))
+    assert sorted(out) == [2 * i for i in range(9)]
+    _drain(actors)
+
+
+def test_actor_pool_submit_get_next(cluster):
+    actors = [_Doubler.remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)
+    # more submits than actors buffers
+    pool.submit(lambda a, v: a.double.remote(v), 3)
+    assert pool.has_next()
+    assert [pool.get_next(), pool.get_next(), pool.get_next()] == [2, 4, 6]
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+    _drain(actors)
+
+
+def test_actor_pool_push_pop(cluster):
+    a, b = _Doubler.remote(), _Doubler.remote()
+    pool = ActorPool([a])
+    with pytest.raises(ValueError):
+        pool.push(a)
+    pool.push(b)
+    assert pool.pop_idle() is not None
+    _drain([a, b])
+
+
+def test_queue_basic(cluster):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2 and q.full() and not q.empty()
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.1)
+    q.shutdown()
+
+
+def test_queue_batch_and_cross_task(cluster):
+    q = Queue()
+    q.put_nowait_batch([1, 2, 3])
+
+    @ray_tpu.remote
+    def consume(q):
+        return [q.get() for _ in range(3)]
+
+    assert ray_tpu.get(consume.remote(q)) == [1, 2, 3]
+    q.shutdown()
+
+
+def test_multiprocessing_pool(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(abs, [-1, -2, -3, 4]) == [1, 2, 3, 4]
+        assert p.apply(max, (3, 5)) == 5
+        r = p.apply_async(min, (3, 5))
+        assert r.get(timeout=10) == 3
+        assert p.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        assert sorted(p.imap_unordered(abs, [-5, 6])) == [5, 6]
+        assert list(p.imap(abs, [-5, 6])) == [5, 6]
+
+
+def test_multiprocessing_pool_callbacks(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    hits = []
+    with Pool(processes=1) as p:
+        r = p.apply_async(abs, (-7,), callback=hits.append)
+        assert r.get() == 7
+        for _ in range(100):
+            if hits:
+                break
+            time.sleep(0.05)
+        assert hits == [7]
+
+
+def test_joblib_backend(cluster):
+    from joblib import Parallel, delayed, parallel_backend
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with parallel_backend("ray_tpu", n_jobs=2):
+        out = Parallel()(delayed(abs)(i) for i in [-1, -2, -3])
+    assert out == [1, 2, 3]
+
+
+def test_tqdm_ray(cluster):
+    from ray_tpu.experimental import tqdm_ray
+
+    @ray_tpu.remote
+    def work(n):
+        total = 0
+        for i in tqdm_ray.tqdm(range(n), desc="work"):
+            total += i
+        return total
+
+    assert ray_tpu.get(work.remote(10)) == 45
