@@ -1,0 +1,26 @@
+"""ray_tpu.rllib — JAX-native reinforcement learning library.
+
+Capability parity with the reference's RLlib (`rllib/` — Algorithm/
+AlgorithmConfig, EnvRunnerGroup actor rollouts, Learner updates): rollouts
+run on CPU env-runner actors; the learner update is a single jitted JAX
+function, optionally sharded over a device-mesh dp axis (XLA psum over ICI
+replaces the reference's torch-DDP learner group).
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.env.envs import (Box, CartPole, Discrete, Env, Pendulum,
+                                    VectorEnv, make_env, register_env)
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.core.rl_module import ModuleSpec, RLModule, spec_from_env
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "SAC", "SACConfig", "Box", "CartPole", "Discrete", "Env", "Pendulum",
+    "VectorEnv", "make_env", "register_env", "SingleAgentEnvRunner",
+    "EnvRunnerGroup", "ModuleSpec", "RLModule", "spec_from_env",
+]

@@ -1,0 +1,98 @@
+"""AlgorithmConfig: the fluent builder the reference uses everywhere.
+
+Parity: `rllib/algorithms/algorithm_config.py` — `.environment()`,
+`.env_runners()`, `.training()`, `.learners()`, `.evaluation()`, `.build()`.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional, Tuple
+
+
+class AlgorithmConfig:
+    algo_class = None  # set by subclasses (PPOConfig → PPO, ...)
+
+    def __init__(self):
+        # environment
+        self.env: Any = "CartPole-v1"
+        self.env_kwargs: dict = {}
+        # env runners
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 1
+        self.rollout_fragment_length: int = 128
+        # training (shared knobs; algo subclasses add their own)
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.grad_clip: Optional[float] = 0.5
+        self.train_batch_size: int = 512
+        self.hiddens: Tuple[int, ...] = (64, 64)
+        self.seed: int = 0
+        # learners: mesh_shape=(dp,) shards the update batch over devices
+        self.mesh_devices: Optional[int] = None
+        # evaluation
+        self.evaluation_interval: int = 0
+        self.evaluation_num_episodes: int = 5
+
+    # fluent setters — each returns self, mirroring the reference exactly
+    def environment(self, env=None, *, env_config: Optional[dict] = None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_kwargs = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def learners(self, *, mesh_devices: Optional[int] = None):
+        """TPU-first replacement for the reference's num_learners: instead of
+        N DDP learner actors, one learner whose update is sharded over an
+        N-device mesh dp axis (XLA psum over ICI)."""
+        if mesh_devices is not None:
+            self.mesh_devices = mesh_devices
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_num_episodes: Optional[int] = None):
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_episodes is not None:
+            self.evaluation_num_episodes = evaluation_num_episodes
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":  # noqa: F821
+        if self.algo_class is None:
+            raise ValueError("use an algorithm-specific config (PPOConfig, ...)")
+        return self.algo_class(self.copy())
+
+    # Tune integration: dict-style access for param_space sweeps
+    def update_from_dict(self, d: dict) -> "AlgorithmConfig":
+        for k, v in d.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown config key {k!r}")
+            setattr(self, k, v)
+        return self

@@ -1,0 +1,107 @@
+"""DQN: double Q-learning with target network and epsilon-greedy exploration.
+
+Parity: `rllib/algorithms/dqn/` (dqn.py, default_dqn_rl_module.py, torch
+learner) — double-DQN target per the reference's default config, uniform
+replay (`rllib/utils/replay_buffers/`), linear epsilon schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.replay import ReplayBuffer
+from ray_tpu.rllib.core.rl_module import ModuleSpec, spec_from_env
+
+
+class DQNLearner(JaxLearner):
+    def __init__(self, spec, cfg: "DQNConfig", mesh=None):
+        self.cfg = cfg
+        super().__init__(spec, lr=cfg.lr, grad_clip=cfg.grad_clip,
+                         seed=cfg.seed, mesh=mesh)
+        self.target_params = jax.tree.map(jnp.asarray, self.params)
+        self._steps = 0
+
+    def loss(self, params, batch, rng) -> Tuple[jnp.ndarray, dict]:
+        c = self.cfg
+        q = self.module.pi_out(params, batch["obs"])
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+        # double DQN: online net picks the argmax, target net evaluates it
+        next_q_online = self.module.pi_out(params, batch["next_obs"])
+        next_a = jnp.argmax(next_q_online, axis=-1)
+        next_q_target = self.module.pi_out(batch["_target"], batch["next_obs"])
+        next_q = jnp.take_along_axis(next_q_target, next_a[:, None], axis=-1)[:, 0]
+        target = batch["rewards"] + c.gamma * (1 - batch["dones"]) * \
+            jax.lax.stop_gradient(next_q)
+        td = q_taken - target
+        loss = jnp.where(jnp.abs(td) < 1.0, 0.5 * td**2,
+                         jnp.abs(td) - 0.5).mean()  # Huber
+        return loss, {"qf_loss": loss, "q_mean": q_taken.mean()}
+
+    def update(self, batch) -> dict:
+        batch = dict(batch)
+        batch["_target"] = self.target_params
+        out = super().update(batch)
+        self._steps += 1
+        if self._steps % self.cfg.target_network_update_freq == 0:
+            self.target_params = jax.tree.map(jnp.asarray, self.params)
+        return out
+
+    def get_state(self) -> dict:
+        s = super().get_state()
+        s["target_params"] = jax.tree.map(np.asarray, self.target_params)
+        return s
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = jax.tree.map(jnp.asarray, state["target_params"])
+
+
+class DQN(Algorithm):
+    needs_epsilon = True
+
+    def _module_spec(self, env) -> ModuleSpec:
+        spec = spec_from_env(env)
+        if not spec.discrete:
+            raise ValueError("DQN requires a discrete action space")
+        return ModuleSpec(**{**spec.__dict__, "q_network": True,
+                             "hiddens": tuple(self.config.hiddens)})
+
+    def _build_learner(self, mesh):
+        self.replay = ReplayBuffer(self.config.replay_buffer_capacity,
+                                   self.module_spec.obs_dim, discrete=True,
+                                   seed=self.config.seed)
+        return DQNLearner(self.module_spec, self.config, mesh=mesh)
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._timesteps / max(1, c.epsilon_timesteps))
+        return c.initial_epsilon + frac * (c.final_epsilon - c.initial_epsilon)
+
+    def training_step(self) -> dict:
+        metrics = self._off_policy_step(epsilon=self._epsilon())
+        metrics["epsilon"] = self._epsilon()
+        return metrics
+
+
+class DQNConfig(AlgorithmConfig):
+    algo_class = DQN
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.train_batch_size = 64
+        self.replay_buffer_capacity = 50_000
+        self.target_network_update_freq = 100
+        self.initial_epsilon = 1.0
+        self.final_epsilon = 0.05
+        self.epsilon_timesteps = 5_000
+        self.num_steps_sampled_before_learning_starts = 500
+        self.num_updates_per_iteration = 32

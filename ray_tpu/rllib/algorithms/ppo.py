@@ -1,0 +1,124 @@
+"""PPO: clipped-surrogate policy optimization with GAE.
+
+Parity: `rllib/algorithms/ppo/` (ppo.py, ppo_learner.py, default configs) —
+the loss math follows the reference's torch learner
+(`rllib/algorithms/ppo/torch/ppo_torch_learner.py`): clip objective, value
+clipping, entropy bonus, GAE(λ). GAE and the minibatch epochs are all jitted;
+the minibatch update shards over the mesh dp axis when configured.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+
+
+def compute_gae(rewards, values, dones, last_values, gamma, lam):
+    """[T, N] leaves → (advantages, value_targets), vectorized lax.scan over
+    time (reference: `rllib/evaluation/postprocessing.py` compute_advantages)."""
+    def step(carry, xs):
+        r, v, d = xs
+        next_v, adv = carry
+        delta = r + gamma * next_v * (1 - d) - v
+        adv = delta + gamma * lam * (1 - d) * adv
+        return (v, adv), adv
+
+    (_, _), advs = jax.lax.scan(
+        step, (last_values, jnp.zeros_like(last_values)),
+        (rewards, values, dones), reverse=True)
+    return advs, advs + values
+
+
+class PPOLearner(JaxLearner):
+    def __init__(self, spec, cfg: "PPOConfig", mesh=None):
+        self.cfg = cfg
+        super().__init__(spec, lr=cfg.lr, grad_clip=cfg.grad_clip,
+                         seed=cfg.seed, mesh=mesh)
+
+    def loss(self, params, batch, rng) -> Tuple[jnp.ndarray, dict]:
+        c = self.cfg
+        dist = self.module.dist(params, batch["obs"])
+        logp = dist.log_prob(batch["actions"])
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg = -jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - c.clip_param, 1 + c.clip_param) * adv).mean()
+        v = self.module.value(params, batch["obs"])
+        v_clipped = batch["values"] + jnp.clip(
+            v - batch["values"], -c.vf_clip_param, c.vf_clip_param)
+        vf_loss = jnp.maximum((v - batch["value_targets"]) ** 2,
+                              (v_clipped - batch["value_targets"]) ** 2).mean()
+        entropy = dist.entropy().mean()
+        total = pg + c.vf_loss_coeff * vf_loss - c.entropy_coeff * entropy
+        return total, {"policy_loss": pg, "vf_loss": vf_loss, "entropy": entropy,
+                       "mean_kl": (batch["logp"] - logp).mean()}
+
+
+class PPO(Algorithm):
+    def _build_learner(self, mesh):
+        return PPOLearner(self.module_spec, self.config, mesh=mesh)
+
+    def training_step(self) -> dict:
+        c = self.config
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+        fragments = self.env_runner_group.sample(c.rollout_fragment_length)
+        if not fragments:
+            # every remote runner failed this iteration; they've been
+            # replaced — skip the update rather than crash
+            return {"num_failed_sample_rounds": 1}
+        ep_metrics = [f.pop("_metrics") for f in fragments]
+
+        # concatenate runner fragments along the env axis, compute GAE, flatten
+        cat = {k: np.concatenate([f[k] for f in fragments], axis=1)
+               for k in fragments[0] if k not in ("next_obs", "last_values")}
+        last_v = np.concatenate([f["last_values"] for f in fragments])
+        # bootstrap through time-limit truncation: fold γV(final_obs) into the
+        # reward at truncated (non-terminated) steps, then treat the step as
+        # done — an exact rewrite of the truncation-aware GAE recursion
+        boot = cat["truncateds"] & ~cat["terminateds"]
+        rewards = cat["rewards"] + c.gamma * cat["final_values"] * boot
+        advs, targets = jax.jit(compute_gae, static_argnums=(4, 5))(
+            rewards, cat["values"], cat["dones"].astype(np.float32),
+            last_v, c.gamma, c.lambda_)
+        T, N = cat["rewards"].shape
+        flat = lambda x: np.asarray(x).reshape(T * N, *x.shape[2:])
+        train_batch = {"obs": flat(cat["obs"]), "actions": flat(cat["actions"]),
+                       "logp": flat(cat["logp"]), "values": flat(cat["values"]),
+                       "advantages": flat(advs), "value_targets": flat(targets)}
+        self._timesteps += T * N
+
+        rng = np.random.default_rng(c.seed + self.iteration)
+        n = train_batch["obs"].shape[0]
+        mb = min(c.minibatch_size, n)
+        metrics: Dict[str, float] = {}
+        for _ in range(c.num_epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n - mb + 1, mb):
+                idx = perm[s:s + mb]
+                metrics = self.learner.update({k: v[idx] for k, v in
+                                               train_batch.items()})
+        metrics.update(self._episode_metrics(ep_metrics))
+        return metrics
+
+
+class PPOConfig(AlgorithmConfig):
+    algo_class = PPO
+
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.lambda_ = 0.95
+        self.num_epochs = 4
+        self.minibatch_size = 128

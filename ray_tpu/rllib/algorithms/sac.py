@@ -1,0 +1,127 @@
+"""SAC: soft actor-critic with twin Q, target nets, auto-tuned temperature.
+
+Parity: `rllib/algorithms/sac/` (sac.py, torch learner) — squashed-Gaussian
+policy, twin Q with min-target, polyak-averaged target networks, entropy
+temperature auto-tuned toward -|A| (the reference's default target entropy).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+from ray_tpu.rllib.core.replay import ReplayBuffer
+from ray_tpu.rllib.core.rl_module import ModuleSpec, spec_from_env
+
+
+class SACLearner(JaxLearner):
+    def __init__(self, spec, cfg: "SACConfig", mesh=None):
+        self.cfg = cfg
+        super().__init__(spec, lr=cfg.lr, grad_clip=cfg.grad_clip,
+                         seed=cfg.seed, mesh=mesh)
+        self.target_params = jax.tree.map(jnp.asarray, self.params)
+        self.log_alpha = jnp.zeros(())
+        self.alpha_opt = optax.adam(cfg.lr)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self.target_entropy = -float(spec.action_dim)
+
+        @jax.jit
+        def _alpha_update(log_alpha, opt_state, logp):
+            def alpha_loss(la):
+                return -(jnp.exp(la) * jax.lax.stop_gradient(
+                    logp + self.target_entropy)).mean()
+
+            g = jax.grad(alpha_loss)(log_alpha)
+            upd, opt_state = self.alpha_opt.update(g, opt_state)
+            return optax.apply_updates(log_alpha, upd), opt_state
+
+        self._alpha_update = _alpha_update
+
+    def loss(self, params, batch, rng) -> Tuple[jnp.ndarray, dict]:
+        c = self.cfg
+        alpha = jnp.exp(batch["_log_alpha"])
+        k1, k2 = jax.random.split(rng)
+        # critic loss: y = r + γ(1-d)(min Q_targ(s', a') - α logπ(a'|s'))
+        next_dist = self.module.dist(params, batch["next_obs"])
+        next_a, next_logp = next_dist.sample_with_logp(k1)
+        q1_t, q2_t = self.module.q_values(batch["_target"], batch["next_obs"],
+                                          next_a)
+        y = batch["rewards"] + c.gamma * (1 - batch["dones"]) * \
+            jax.lax.stop_gradient(jnp.minimum(q1_t, q2_t) - alpha * next_logp)
+        q1, q2 = self.module.q_values(params, batch["obs"], batch["actions"])
+        critic_loss = ((q1 - y) ** 2).mean() + ((q2 - y) ** 2).mean()
+        # actor loss: α logπ(a|s) - min Q(s, a), through the reparam sample
+        dist = self.module.dist(params, batch["obs"])
+        a, logp = dist.sample_with_logp(k2)
+        q1_pi, q2_pi = self.module.q_values(
+            jax.lax.stop_gradient(params), batch["obs"], a)
+        actor_loss = (alpha * logp - jnp.minimum(q1_pi, q2_pi)).mean()
+        total = critic_loss + actor_loss
+        return total, {"critic_loss": critic_loss, "actor_loss": actor_loss,
+                       "alpha": alpha, "logp_mean": logp.mean()}
+
+    def update(self, batch) -> dict:
+        batch = dict(batch)
+        batch["_target"] = self.target_params
+        batch["_log_alpha"] = self.log_alpha
+        out = super().update(batch)
+        # polyak target update + temperature step
+        tau = self.cfg.tau
+        self.target_params = jax.tree.map(
+            lambda t, p: (1 - tau) * t + tau * p, self.target_params, self.params)
+        dist = self.module.dist(self.params, jnp.asarray(batch["obs"]))
+        self._rng, sub = jax.random.split(self._rng)
+        _, logp = dist.sample_with_logp(sub)
+        self.log_alpha, self.alpha_opt_state = self._alpha_update(
+            self.log_alpha, self.alpha_opt_state, logp)
+        return out
+
+    def get_state(self) -> dict:
+        s = super().get_state()
+        s["target_params"] = jax.tree.map(np.asarray, self.target_params)
+        s["log_alpha"] = np.asarray(self.log_alpha)
+        return s
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = jax.tree.map(jnp.asarray, state["target_params"])
+        self.log_alpha = jnp.asarray(state["log_alpha"])
+
+
+class SAC(Algorithm):
+    def _module_spec(self, env) -> ModuleSpec:
+        spec = spec_from_env(env)
+        if spec.discrete:
+            raise ValueError("this SAC implementation targets Box action spaces")
+        return ModuleSpec(**{**spec.__dict__, "squashed": True,
+                             "hiddens": tuple(self.config.hiddens)})
+
+    def _build_learner(self, mesh):
+        self.replay = ReplayBuffer(self.config.replay_buffer_capacity,
+                                   self.module_spec.obs_dim, discrete=False,
+                                   action_dim=self.module_spec.action_dim,
+                                   seed=self.config.seed)
+        return SACLearner(self.module_spec, self.config, mesh=mesh)
+
+    def training_step(self) -> dict:
+        return self._off_policy_step()
+
+
+class SACConfig(AlgorithmConfig):
+    algo_class = SAC
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.tau = 0.005
+        self.num_steps_sampled_before_learning_starts = 500
+        self.num_updates_per_iteration = 32

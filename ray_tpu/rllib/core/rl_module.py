@@ -1,0 +1,190 @@
+"""RLModule: policy/value networks + action distributions, plain JAX.
+
+Parity target: the reference's RLModule abstraction
+(`rllib/core/rl_module/rl_module.py` — forward_inference / forward_exploration
+/ forward_train) re-done as pure functions over parameter pytrees so the whole
+learner update jits and shards under a mesh (pjit DP), instead of torch
+modules wrapped in DDP (`rllib/core/learner/torch/torch_learner.py:432`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+
+def _init_mlp(rng, sizes: Sequence[int], scale_last: float = 0.01) -> Params:
+    """Orthogonal-init MLP (the reference's default for PPO-style nets)."""
+    layers = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for i, k in enumerate(keys):
+        fan_in, fan_out = sizes[i], sizes[i + 1]
+        w = jax.nn.initializers.orthogonal(
+            np.sqrt(2) if i < len(keys) - 1 else scale_last)(
+                k, (fan_in, fan_out), jnp.float32)
+        layers.append({"w": w, "b": jnp.zeros((fan_out,), jnp.float32)})
+    return layers
+
+
+def _apply_mlp(layers: Params, x: jnp.ndarray) -> jnp.ndarray:
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuleSpec:
+    """What the reference calls RLModuleSpec (`rllib/core/rl_module/rl_module.py`)."""
+    obs_dim: int
+    action_dim: int
+    discrete: bool
+    hiddens: Tuple[int, ...] = (64, 64)
+    # DQN-style modules output one Q-value per action instead of a policy head
+    q_network: bool = False
+    # SAC-style modules: tanh-squashed state-dependent Gaussian + twin Q(s,a)
+    squashed: bool = False
+    # Box envs with bounds beyond [-1, 1]: policy outputs are scaled by this
+    action_scale: float = 1.0
+
+
+class RLModule:
+    """Separate policy and value MLP towers (reference default catalog config)."""
+
+    def __init__(self, spec: ModuleSpec):
+        self.spec = spec
+
+    def init(self, rng) -> Params:
+        s = self.spec
+        k_pi, k_v, k_q1, k_q2 = jax.random.split(rng, 4)
+        head = 2 * s.action_dim if s.squashed else s.action_dim
+        params = {
+            "pi": _init_mlp(k_pi, (s.obs_dim, *s.hiddens, head),
+                            scale_last=1.0 if s.q_network else 0.01),
+            "vf": _init_mlp(k_v, (s.obs_dim, *s.hiddens, 1), scale_last=1.0),
+        }
+        if s.squashed:
+            params["q1"] = _init_mlp(
+                k_q1, (s.obs_dim + s.action_dim, *s.hiddens, 1), scale_last=1.0)
+            params["q2"] = _init_mlp(
+                k_q2, (s.obs_dim + s.action_dim, *s.hiddens, 1), scale_last=1.0)
+        elif not s.discrete and not s.q_network:
+            params["log_std"] = jnp.zeros((s.action_dim,), jnp.float32)
+        return params
+
+    def q_values(self, params: Params, obs, act) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = jnp.concatenate([obs, act], axis=-1)
+        return (_apply_mlp(params["q1"], x)[..., 0],
+                _apply_mlp(params["q2"], x)[..., 0])
+
+    # --- forward passes (reference: forward_inference/_exploration/_train) ---
+    def value(self, params: Params, obs) -> jnp.ndarray:
+        return _apply_mlp(params["vf"], obs)[..., 0]
+
+    def pi_out(self, params: Params, obs) -> jnp.ndarray:
+        """Logits (discrete / q_network) or mean (continuous)."""
+        return _apply_mlp(params["pi"], obs)
+
+    def dist(self, params: Params, obs):
+        out = self.pi_out(params, obs)
+        if self.spec.discrete or self.spec.q_network:
+            return Categorical(out)
+        if self.spec.squashed:
+            mean, log_std = jnp.split(out, 2, axis=-1)
+            return SquashedGaussian(mean, jnp.clip(log_std, -20.0, 2.0))
+        return DiagGaussian(out, params["log_std"])
+
+
+class Categorical:
+    def __init__(self, logits):
+        self.logits = logits - jax.scipy.special.logsumexp(
+            logits, axis=-1, keepdims=True)
+
+    def sample(self, rng):
+        return jax.random.categorical(rng, self.logits)
+
+    def log_prob(self, a):
+        return jnp.take_along_axis(
+            self.logits, a[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def entropy(self):
+        p = jnp.exp(self.logits)
+        return -jnp.sum(p * self.logits, axis=-1)
+
+    def mode(self):
+        return jnp.argmax(self.logits, axis=-1)
+
+
+class DiagGaussian:
+    def __init__(self, mean, log_std):
+        self.mean, self.log_std = mean, log_std
+
+    def sample(self, rng):
+        return self.mean + jnp.exp(self.log_std) * jax.random.normal(
+            rng, self.mean.shape)
+
+    def log_prob(self, a):
+        var = jnp.exp(2 * self.log_std)
+        return jnp.sum(-((a - self.mean) ** 2) / (2 * var) - self.log_std
+                       - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+    def entropy(self):
+        return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    def mode(self):
+        return self.mean
+
+
+class SquashedGaussian:
+    """tanh(Normal) with the change-of-variables log-prob correction
+    (reference: `rllib/models/torch/torch_distributions.py` TorchSquashedGaussian)."""
+
+    def __init__(self, mean, log_std):
+        self.mean, self.log_std = mean, log_std
+
+    def _base(self):
+        return DiagGaussian(self.mean, self.log_std)
+
+    def sample_with_logp(self, rng):
+        u = self.mean + jnp.exp(self.log_std) * jax.random.normal(
+            rng, self.mean.shape)
+        a = jnp.tanh(u)
+        # log|det tanh'(u)| = sum 2(log2 - u - softplus(-2u))
+        logp = self._base().log_prob(u) - jnp.sum(
+            2 * (jnp.log(2.0) - u - jax.nn.softplus(-2 * u)), axis=-1)
+        return a, logp
+
+    def sample(self, rng):
+        return self.sample_with_logp(rng)[0]
+
+    def log_prob(self, a):
+        a = jnp.clip(a, -1 + 1e-6, 1 - 1e-6)
+        u = jnp.arctanh(a)
+        return self._base().log_prob(u) - jnp.sum(
+            2 * (jnp.log(2.0) - u - jax.nn.softplus(-2 * u)), axis=-1)
+
+    def entropy(self):
+        return self._base().entropy()  # gaussian entropy (upper bound)
+
+    def mode(self):
+        return jnp.tanh(self.mean)
+
+
+def spec_from_env(env) -> ModuleSpec:
+    from ray_tpu.rllib.env.envs import Discrete
+
+    space = env.action_space
+    if isinstance(space, Discrete):
+        return ModuleSpec(obs_dim=int(np.prod(env.observation_space.shape)),
+                          action_dim=space.n, discrete=True)
+    return ModuleSpec(obs_dim=int(np.prod(env.observation_space.shape)),
+                      action_dim=int(np.prod(space.shape)), discrete=False,
+                      action_scale=float(np.max(np.abs(
+                          np.asarray([space.low, space.high])))))

@@ -1,0 +1,140 @@
+"""EnvRunner: vectorized rollout collection with a jitted policy step.
+
+Parity: `rllib/env/single_agent_env_runner.py` (sample() over vectorized
+gymnasium envs) — but the action-selection path is one jitted JAX function,
+so on-device inference batches across the env vector.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModule, ModuleSpec
+from ray_tpu.rllib.env.envs import VectorEnv
+
+
+class SingleAgentEnvRunner:
+    """Collects fixed-length rollout fragments; usable in-process or as an
+    actor via EnvRunnerGroup (`rllib/env/env_runner_group.py`)."""
+
+    def __init__(self, env_spec, module_spec: ModuleSpec, num_envs: int = 1,
+                 seed: int = 0, explore: bool = True,
+                 epsilon: Optional[float] = None, env_kwargs: Optional[dict] = None):
+        self._env_spec = env_spec
+        self._env_kwargs = dict(env_kwargs or {})
+        self.vec = VectorEnv(env_spec, num_envs, seed=seed, **self._env_kwargs)
+        self.module = RLModule(module_spec)
+        self.explore = explore
+        self.epsilon = epsilon  # when set: epsilon-greedy over q-values (DQN)
+        self._rng = jax.random.key(seed + 17)
+        self._obs = self.vec.start()
+        self._ep_returns: list = []
+        self._params = None
+
+        @jax.jit
+        def _step(params, obs, rng, eps):
+            dist = self.module.dist(params, obs)
+            k1, k2, k3 = jax.random.split(rng, 3)
+            if self.epsilon is not None:
+                greedy = dist.mode()
+                rand = jax.random.randint(k2, greedy.shape, 0,
+                                          self.module.spec.action_dim)
+                take_rand = jax.random.uniform(k3, greedy.shape) < eps
+                a = jnp.where(take_rand, rand, greedy)
+                logp = jnp.zeros(a.shape[0])
+            elif self.explore:
+                a = dist.sample(k1)
+                logp = dist.log_prob(a)
+            else:
+                a = dist.mode()
+                logp = dist.log_prob(a)
+            v = self.module.value(params, obs)
+            return a, logp, v
+
+        self._policy_step = _step
+        self._greedy_step = jax.jit(
+            lambda params, obs: self.module.dist(params, obs).mode())
+        self._value_fn = jax.jit(self.module.value)
+
+    def set_weights(self, params) -> None:
+        self._params = jax.tree.map(jnp.asarray, params)
+
+    def get_weights(self):
+        return self._params
+
+    def sample(self, num_steps: int, epsilon: float = 0.0) -> Dict[str, np.ndarray]:
+        """Collect `num_steps` env steps per sub-env. Returns a flat batch with
+        [T, N, ...] leaves plus bootstrap values for GAE."""
+        assert self._params is not None, "set_weights() before sample()"
+        obs_buf, act_buf, rew_buf, logp_buf, val_buf = ([] for _ in range(5))
+        term_buf, trunc_buf, next_buf, finalv_buf = ([] for _ in range(4))
+        for _ in range(num_steps):
+            self._rng, sub = jax.random.split(self._rng)
+            a, logp, v = self._policy_step(self._params, self._obs, sub,
+                                           jnp.float32(epsilon))
+            a_np = np.asarray(a)
+            obs_buf.append(self._obs)
+            env_a = a_np if self.module.spec.discrete else \
+                a_np * self.module.spec.action_scale
+            next_obs, r, term, trunc, final_obs, ep_ret = self.vec.step(env_a)
+            act_buf.append(a_np)
+            rew_buf.append(r)
+            term_buf.append(term)
+            trunc_buf.append(trunc)
+            # the true successor state: pre-reset final obs at episode ends
+            next_buf.append(final_obs)
+            # V(final_obs) where truncated (not terminated): lets consumers
+            # bootstrap through time limits (gymnasium-correct semantics)
+            boot = trunc & ~term
+            fv = np.zeros(self.vec.num_envs, np.float32)
+            if boot.any():
+                fv[boot] = np.asarray(
+                    self._value_fn(self._params, final_obs[boot]))
+            finalv_buf.append(fv)
+            logp_buf.append(np.asarray(logp))
+            val_buf.append(np.asarray(v))
+            self._ep_returns.extend(ep_ret[~np.isnan(ep_ret)].tolist())
+            self._obs = next_obs
+        self._rng, sub = jax.random.split(self._rng)
+        _, _, last_v = self._policy_step(self._params, self._obs, sub,
+                                         jnp.float32(epsilon))
+        terms = np.stack(term_buf)
+        truncs = np.stack(trunc_buf)
+        return {
+            "obs": np.stack(obs_buf), "actions": np.stack(act_buf),
+            "rewards": np.stack(rew_buf), "dones": terms | truncs,
+            "terminateds": terms, "truncateds": truncs,
+            "next_obs_seq": np.stack(next_buf),
+            "final_values": np.stack(finalv_buf),
+            "logp": np.stack(logp_buf), "values": np.stack(val_buf),
+            "next_obs": self._obs.copy(), "last_values": np.asarray(last_v),
+        }
+
+    def episode_metrics(self) -> dict:
+        """Drain completed-episode returns collected since the last call."""
+        rets, self._ep_returns = self._ep_returns, []
+        return {"episodes": len(rets),
+                "episode_return_mean": float(np.mean(rets)) if rets else float("nan")}
+
+    def evaluate(self, num_episodes: int = 5) -> dict:
+        """Greedy evaluation on a fresh env (same spec + kwargs as training)."""
+        from ray_tpu.rllib.env.envs import make_env
+
+        env = make_env(self._env_spec, **self._env_kwargs)
+        rets = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=10_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = np.asarray(self._greedy_step(self._params, obs[None]))[0]
+                if not self.module.spec.discrete:
+                    a = a * self.module.spec.action_scale
+                obs, r, term, trunc, _ = env.step(a)
+                total += r
+                done = term or trunc
+            rets.append(total)
+        return {"episode_return_mean": float(np.mean(rets))}
