@@ -27,14 +27,24 @@ class _TqdmManager:
 
     def __init__(self):
         self._bars = {}
+        self._positions = {}   # bar_id -> terminal row (freed on close)
+
+    def _alloc_position(self, bar_id: str) -> int:
+        used = set(self._positions.values())
+        pos = 0
+        while pos in used:
+            pos += 1
+        self._positions[bar_id] = pos
+        return pos
 
     def update(self, bar_id: str, desc: str, total: Optional[int],
                delta: int, close: bool = False):
         try:
             import tqdm as _tqdm
             if bar_id not in self._bars and not close:
-                self._bars[bar_id] = _tqdm.tqdm(desc=desc, total=total,
-                                                position=len(self._bars))
+                self._bars[bar_id] = _tqdm.tqdm(
+                    desc=desc, total=total,
+                    position=self._alloc_position(bar_id))
             bar = self._bars.get(bar_id)
             if bar is None:
                 return True
@@ -45,6 +55,7 @@ class _TqdmManager:
             if close:
                 bar.close()
                 del self._bars[bar_id]
+                self._positions.pop(bar_id, None)
         except Exception:
             pass
         return True

@@ -24,8 +24,10 @@ class ActorPool:
         self._idle_actors: List[Any] = list(actors)
         # in-flight: ObjectRef -> actor that produced it
         self._future_to_actor = {}
-        # ordering for get_next(): index -> ref
+        # ordering for get_next(): index -> ref (+ reverse for O(1)
+        # removal from get_next_unordered)
         self._index_to_future = {}
+        self._future_to_index = {}
         self._next_task_index = 0
         self._next_return_index = 0
         # tasks buffered while no actor is free
@@ -39,6 +41,7 @@ class ActorPool:
             future = fn(actor, value)
             self._future_to_actor[future] = actor
             self._index_to_future[self._next_task_index] = future
+            self._future_to_index[future] = self._next_task_index
             self._next_task_index += 1
         else:
             self._pending_submits.append((fn, value))
@@ -66,7 +69,8 @@ class ActorPool:
                 if ignore_if_timedout:
                     return None
                 raise TimeoutError(f"no result within {timeout}s")
-        del self._index_to_future[self._next_return_index]
+        future = self._index_to_future.pop(self._next_return_index)
+        self._future_to_index.pop(future, None)
         self._next_return_index += 1
         actor = self._future_to_actor.pop(future)
         self._return_actor(actor)
@@ -83,11 +87,7 @@ class ActorPool:
         future = ready[0]
         actor = self._future_to_actor.pop(future)
         self._return_actor(actor)
-        # drop from the ordered index too
-        for i, f in list(self._index_to_future.items()):
-            if f == future:
-                del self._index_to_future[i]
-                break
+        self._index_to_future.pop(self._future_to_index.pop(future), None)
         return ray_tpu.get(future)
 
     # ---------------------------------------------------------------- map

@@ -10,10 +10,11 @@ multiprocessing code scales across nodes unchanged.
 from __future__ import annotations
 
 import itertools
+import multiprocessing
 from typing import Any, Callable, Iterable, List, Optional
 
 import ray_tpu
-from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.core.exceptions import GetTimeoutError
 
 
 @ray_tpu.remote
@@ -56,7 +57,12 @@ class AsyncResult:
             threading.Thread(target=watch, daemon=True).start()
 
     def get(self, timeout: Optional[float] = None):
-        out = ray_tpu.get(self._refs, timeout=timeout)
+        try:
+            out = ray_tpu.get(self._refs, timeout=timeout)
+        except GetTimeoutError:
+            # stdlib contract: multiprocessing.TimeoutError (ProcessError
+            # subclass), which is what drop-in callers catch
+            raise multiprocessing.TimeoutError()
         if self._chunked:
             out = list(itertools.chain.from_iterable(out))
         return out[0] if self._single else out
@@ -70,6 +76,9 @@ class AsyncResult:
         return len(ready) == len(self._refs)
 
     def successful(self) -> bool:
+        if not self.ready():
+            # stdlib contract: ValueError before completion, never block
+            raise ValueError("AsyncResult is not ready")
         try:
             ray_tpu.get(self._refs)
             return True
@@ -95,13 +104,36 @@ class Pool:
         self._processes = processes
         opts = dict(ray_remote_args or {})
         opts.setdefault("num_cpus", 1)
-        self._actors = [
-            _PoolActor.options(**opts).remote(initializer, tuple(initargs))
-            for _ in range(processes)
-        ]
-        self._pool = ActorPool(self._actors)
-        self._rr = itertools.cycle(self._actors)
+        self._opts = opts
+        self._init = (initializer, tuple(initargs))
+        self._maxtasksperchild = maxtasksperchild
+        self._actors = [self._spawn_actor() for _ in range(processes)]
+        self._task_counts = [0] * processes
+        self._next_idx = 0
+        self._inflight: List[Any] = []
         self._closed = False
+
+    def _spawn_actor(self):
+        return _PoolActor.options(**self._opts).remote(*self._init)
+
+    def _next_actor(self):
+        """Round-robin with maxtasksperchild recycling (stdlib semantics:
+        a worker is replaced after executing that many tasks)."""
+        i = self._next_idx
+        self._next_idx = (self._next_idx + 1) % self._processes
+        if (self._maxtasksperchild is not None
+                and self._task_counts[i] >= self._maxtasksperchild):
+            ray_tpu.kill(self._actors[i])
+            self._actors[i] = self._spawn_actor()
+            self._task_counts[i] = 0
+        self._task_counts[i] += 1
+        return self._actors[i]
+
+    def _track(self, refs):
+        self._inflight = [r for r in self._inflight
+                          if ray_tpu.wait([r], timeout=0)[1]]
+        self._inflight.extend(refs)
+        return refs
 
     # -------------------------------------------------------------- apply
     def apply(self, func: Callable, args=(), kwds=None):
@@ -111,8 +143,9 @@ class Pool:
                     callback: Optional[Callable] = None,
                     error_callback: Optional[Callable] = None) -> AsyncResult:
         self._check_running()
-        actor = next(self._rr)
+        actor = self._next_actor()
         ref = actor.run_apply.remote(func, tuple(args), kwds or {})
+        self._track([ref])
         return AsyncResult([ref], single=True, chunked=False,
                            callback=callback, error_callback=error_callback)
 
@@ -126,10 +159,9 @@ class Pool:
     def _map_refs(self, func, iterable, chunksize, star):
         self._check_running()
         refs = []
-        actors = itertools.cycle(self._actors)
         for batch in self._chunks(iterable, chunksize):
-            refs.append(next(actors).run_batch.remote(func, batch, star))
-        return refs
+            refs.append(self._next_actor().run_batch.remote(func, batch, star))
+        return self._track(refs)
 
     def map(self, func: Callable, iterable: Iterable,
             chunksize: Optional[int] = None) -> List[Any]:
@@ -174,8 +206,13 @@ class Pool:
             ray_tpu.kill(a)
 
     def join(self) -> None:
+        """Blocks until every task submitted before close() finishes
+        (stdlib contract), so terminate()/__exit__ cannot kill mid-task."""
         if not self._closed:
             raise ValueError("Pool is still running")
+        if self._inflight:
+            ray_tpu.wait(self._inflight, num_returns=len(self._inflight))
+            self._inflight = []
 
     def __enter__(self):
         return self

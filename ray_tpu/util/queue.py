@@ -26,16 +26,21 @@ class Full(Exception):
 class _QueueActor:
     def __init__(self, maxsize: int):
         self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        self._active = 0   # blocking puts/gets currently in flight
 
     async def put(self, item, timeout: Optional[float] = None):
-        if timeout is None:
-            await self._q.put(item)
-            return True
+        self._active += 1
         try:
-            await asyncio.wait_for(self._q.put(item), timeout)
-            return True
-        except asyncio.TimeoutError:
-            return False
+            if timeout is None:
+                await self._q.put(item)
+                return True
+            try:
+                await asyncio.wait_for(self._q.put(item), timeout)
+                return True
+            except asyncio.TimeoutError:
+                return False
+        finally:
+            self._active -= 1
 
     async def put_nowait(self, item):
         try:
@@ -52,12 +57,16 @@ class _QueueActor:
         return True
 
     async def get(self, timeout: Optional[float] = None):
-        if timeout is None:
-            return True, await self._q.get()
+        self._active += 1
         try:
-            return True, await asyncio.wait_for(self._q.get(), timeout)
-        except asyncio.TimeoutError:
-            return False, None
+            if timeout is None:
+                return True, await self._q.get()
+            try:
+                return True, await asyncio.wait_for(self._q.get(), timeout)
+            except asyncio.TimeoutError:
+                return False, None
+        finally:
+            self._active -= 1
 
     async def get_nowait(self):
         try:
@@ -75,6 +84,14 @@ class _QueueActor:
 
     async def empty(self) -> bool:
         return self._q.empty()
+
+    async def drain(self) -> bool:
+        """Graceful-shutdown barrier: resolves once no blocking put/get is
+        in flight (the client caps the wait, so a forever-blocked get
+        cannot hang shutdown)."""
+        while self._active > 0:
+            await asyncio.sleep(0.01)
+        return True
 
     async def full(self) -> bool:
         return self._q.full()
@@ -149,6 +166,14 @@ class Queue:
         return ray_tpu.get(self.actor.full.remote())
 
     def shutdown(self, force: bool = False) -> None:
+        """force=False waits for already-submitted actor calls to finish
+        before killing the queue actor (reference semantics:
+        `ray.util.queue.Queue.shutdown`); force=True kills immediately."""
+        if not force:
+            try:
+                ray_tpu.get(self.actor.drain.remote(), timeout=30)
+            except Exception:
+                pass
         ray_tpu.kill(self.actor)
 
 

@@ -155,3 +155,71 @@ def test_tqdm_ray(cluster):
         return total
 
     assert ray_tpu.get(work.remote(10)) == 45
+
+
+def test_pool_join_waits_for_inflight(cluster):
+    """stdlib contract: close()+join() blocks until submitted work finishes."""
+    import time
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    def slow(x):
+        time.sleep(0.5)
+        return x * 2
+
+    with Pool(processes=2) as p:
+        r = p.map_async(slow, [1, 2])
+        p.close()
+        t0 = time.time()
+        p.join()
+        assert time.time() - t0 > 0.2  # actually waited
+        assert r.get(timeout=5) == [2, 4]
+
+
+def test_pool_stdlib_timeout_and_successful(cluster):
+    import multiprocessing
+    import time
+
+    import pytest as _pytest
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    def slow(x):
+        time.sleep(2)
+        return x
+
+    p = Pool(processes=1)
+    r = p.apply_async(slow, (1,))
+    with _pytest.raises(multiprocessing.TimeoutError):
+        r.get(timeout=0.1)
+    with _pytest.raises(ValueError):
+        r.successful()  # not ready yet → ValueError, never blocks
+    assert r.get(timeout=10) == 1
+    assert r.successful() is True
+    p.terminate()
+
+
+def test_pool_maxtasksperchild(cluster):
+    import os
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    p = Pool(processes=1, maxtasksperchild=2)
+    pids = [p.apply(os.getpid) for _ in range(5)]
+    # worker replaced after every 2 tasks → more than one distinct pid
+    assert len(set(pids)) >= 2, pids
+    p.terminate()
+
+
+def test_queue_graceful_shutdown(cluster):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+    q.put(1)
+    q.shutdown(force=False)  # no blocked consumers → returns promptly
+    import pytest as _pytest
+
+    from ray_tpu.core.exceptions import ActorDiedError
+
+    with _pytest.raises(Exception):
+        q.get_nowait()
