@@ -21,6 +21,10 @@ def __getattr__(name):
         from ray_tpu.core import api as _api
 
         return getattr(_api, name)
+    if name == "timeline":
+        from ray_tpu.util.timeline import timeline
+
+        return timeline
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
 
 

@@ -1,0 +1,5 @@
+"""`python -m ray_tpu` → the CLI (same surface as the `ray-tpu` script)."""
+
+from ray_tpu.scripts.cli import main
+
+main()

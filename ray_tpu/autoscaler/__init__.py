@@ -1,0 +1,12 @@
+"""Autoscaler: demand-driven node scale-up/down over a NodeProvider.
+
+Parity (core subset) with `python/ray/autoscaler/_private/autoscaler.py`
+(StandardAutoscaler + resource_demand_scheduler): read unmet resource
+demand from the head, bin-pack it onto provider node types, launch/terminate
+nodes; idle non-head nodes are reclaimed after `idle_timeout_s`.
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider"]

@@ -1,0 +1,152 @@
+"""StandardAutoscaler: the reactive scaling loop.
+
+Parity: `autoscaler/_private/autoscaler.py` StandardAutoscaler +
+`resource_demand_scheduler.py` — each tick: read unmet demand from the head,
+bin-pack demand onto node types (first-fit over per-type capacity), launch
+up to `max_launch_batch` nodes, and terminate nodes idle longer than
+`idle_timeout_s`. Runs as a driver-side thread (the reference runs the same
+loop in the head-node `monitor.py` process).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+def bin_pack(demand: List[Dict[str, float]],
+             node_types: Dict[str, dict],
+             headroom: Optional[Dict[str, int]] = None,
+             pending_capacity: Optional[List[Dict[str, float]]] = None
+             ) -> Dict[str, int]:
+    """First-fit-decreasing: how many nodes of each type to add to satisfy
+    `demand` (list of resource asks), respecting per-type max_nodes minus
+    already-running counts in `headroom`. `pending_capacity` (e.g. nodes
+    already launched but still booting) absorbs demand before anything new
+    is launched."""
+    headroom = dict(headroom or {})
+    to_launch: Dict[str, int] = {}
+    # remaining capacity per new/booting node
+    open_nodes: List[Dict[str, float]] = [dict(c) for c in pending_capacity or []]
+    for ask in sorted(demand, key=lambda d: -sum(d.values())):
+        placed = False
+        for cap in open_nodes:
+            if all(cap.get(r, 0) >= v for r, v in ask.items()):
+                for r, v in ask.items():
+                    cap[r] -= v
+                placed = True
+                break
+        if placed:
+            continue
+        for t, spec in node_types.items():
+            res = spec.get("resources", {})
+            used = headroom.get(t, 0) + to_launch.get(t, 0)
+            if used >= spec.get("max_nodes", 1):
+                continue
+            if all(res.get(r, 0) >= v for r, v in ask.items()):
+                to_launch[t] = to_launch.get(t, 0) + 1
+                cap = dict(res)
+                for r, v in ask.items():
+                    cap[r] -= v
+                open_nodes.append(cap)
+                placed = True
+                break
+        # unplaceable asks are simply skipped (reference logs them as
+        # infeasible demand)
+    return to_launch
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, *,
+                 idle_timeout_s: float = 60.0,
+                 poll_interval_s: float = 1.0,
+                 max_launch_batch: int = 8):
+        self.provider = provider
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.max_launch_batch = max_launch_batch
+        self._idle_since: Dict[str, float] = {}
+        # provider_id -> (node_type, launch_ts): launched, not yet registered
+        self._booting: Dict[str, tuple] = {}
+        self.boot_timeout_s = 120.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # ------------------------------------------------------------- one tick
+    def update(self) -> None:
+        from ray_tpu.core.api import _global_client
+
+        client = _global_client()
+        demand = client.head_request("cluster_demand")
+        nodes = client.head_request("list_state", kind="nodes")
+        by_provider_id = {
+            n["labels"].get("ray_tpu.io/provider-node-id"): n
+            for n in nodes if not n["is_head"]}
+
+        # a launched node is "booting" until it registers with the head
+        # (or times out); its capacity absorbs demand so the same unmet ask
+        # doesn't trigger a fresh launch every poll tick
+        now0 = time.time()
+        alive = self.provider.non_terminated_nodes()
+        for pid, (_t, ts) in list(self._booting.items()):
+            if (pid in by_provider_id or pid not in alive
+                    or now0 - ts > self.boot_timeout_s):
+                del self._booting[pid]
+
+        # scale up
+        if demand:
+            running_counts: Dict[str, int] = {}
+            for pid in alive:
+                t = self.provider.node_type_of(pid)
+                running_counts[t] = running_counts.get(t, 0) + 1
+            pending_cap = [dict(self.provider.node_types[t].get("resources", {}))
+                           for t, _ts in self._booting.values()]
+            plan = bin_pack(demand, self.provider.node_types, running_counts,
+                            pending_capacity=pending_cap)
+            budget = self.max_launch_batch
+            for node_type, count in plan.items():
+                for _ in range(min(count, budget)):
+                    pid = self.provider.create_node(node_type)
+                    self._booting[pid] = (node_type, time.time())
+                    self.num_launches += 1
+                budget -= min(count, budget)
+
+        # scale down: idle (all resources free, no workers busy) too long
+        now = time.time()
+        for pid in self.provider.non_terminated_nodes():
+            n = by_provider_id.get(pid)
+            if n is None:
+                continue  # still booting/registering
+            busy = any(n["available"].get(r, 0) < v
+                       for r, v in n["resources"].items())
+            if busy or demand:
+                self._idle_since.pop(pid, None)
+                continue
+            since = self._idle_since.setdefault(pid, now)
+            if now - since > self.idle_timeout_s:
+                self.provider.terminate_node(pid)
+                self._idle_since.pop(pid, None)
+                self.num_terminations += 1
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.poll_interval_s):
+                try:
+                    self.update()
+                except Exception:
+                    pass  # transient head hiccups must not kill the loop
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

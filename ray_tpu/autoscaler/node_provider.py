@@ -1,0 +1,86 @@
+"""NodeProvider ABC + the local (subprocess) provider.
+
+Parity: `python/ray/autoscaler/node_provider.py` ABC and the
+fake-multi-node provider (`autoscaler/_private/fake_multi_node/
+node_provider.py`) the reference uses to test autoscaling without a cloud:
+here each "node" is a `node_main` daemon subprocess joining the head.
+Cloud providers implement the same three methods against their fleet API.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """A node type is a dict: {"resources": {...}, "labels": {...},
+    "max_nodes": int}."""
+
+    def __init__(self, node_types: Dict[str, dict]):
+        self.node_types = node_types
+
+    def create_node(self, node_type: str) -> str:
+        """Launch one node of `node_type`; returns a provider node id."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_type_of(self, provider_id: str) -> str:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    def __init__(self, node_types: Dict[str, dict], head_address: str):
+        super().__init__(node_types)
+        self.head_address = head_address
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._types: Dict[str, str] = {}
+        self._counter = 0
+
+    def create_node(self, node_type: str) -> str:
+        spec = self.node_types[node_type]
+        self._counter += 1
+        provider_id = f"local-{node_type}-{self._counter}"
+        import json
+
+        from ray_tpu.core.resources import strip_device_env
+
+        res = dict(spec.get("resources", {"CPU": 1}))
+        cmd = [sys.executable, "-m", "ray_tpu.core.node_main",
+               "--address", self.head_address,
+               "--resources", json.dumps(res)]
+        labels = {**spec.get("labels", {}),
+                  "ray_tpu.io/provider-node-id": provider_id}
+        cmd += ["--labels", json.dumps(labels)]
+        self._procs[provider_id] = subprocess.Popen(
+            cmd, env=strip_device_env(dict(os.environ)),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self._types[provider_id] = node_type
+        return provider_id
+
+    def terminate_node(self, provider_id: str) -> None:
+        proc = self._procs.pop(provider_id, None)
+        self._types.pop(provider_id, None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [pid for pid, p in self._procs.items() if p.poll() is None]
+
+    def node_type_of(self, provider_id: str) -> str:
+        return self._types[provider_id]
+
+    def shutdown(self) -> None:
+        for pid in list(self._procs):
+            self.terminate_node(pid)

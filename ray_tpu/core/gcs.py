@@ -193,6 +193,21 @@ class Head:
         self.job_counter = 0
         self.start_time = time.time()
         self._spawned: Dict[int, subprocess.Popen] = {}
+        # ring buffer of task lifecycle events (reference: task_event_buffer
+        # → gcs_task_manager; feeds the state API + `timeline()`)
+        from collections import deque
+        self.task_events: deque = deque(maxlen=20000)
+
+    def _task_event(self, task_id, name: str, state: str, *,
+                    worker=None, node_id=None, error: str = None) -> None:
+        self.task_events.append({
+            "task_id": task_id.hex() if hasattr(task_id, "hex") else str(task_id),
+            "name": name, "state": state, "ts": time.time(),
+            "worker_id": worker.worker_id.hex() if worker else None,
+            "node_id": (node_id.hex() if node_id is not None else
+                        (worker.node_id.hex() if worker else None)),
+            "error": error,
+        })
 
     # ------------------------------------------------------------------ rpc
     def _handlers(self, conn_state: dict):
@@ -424,7 +439,48 @@ class Head:
                 "num_nodes": len([n for n in self.nodes.values() if n.alive]),
                 "actors": {a.hex(): info.state for a, info in self.actors.items()},
                 "uptime": time.time() - self.start_time,
+                "dashboard_port": getattr(self, "dashboard_port", None),
             }
+
+        async def submit_job(entrypoint, metadata=None, env=None,
+                             working_dir=None, job_id=None):
+            return await self.job_manager.submit(
+                entrypoint, metadata=metadata, env=env,
+                working_dir=working_dir, job_id=job_id)
+
+        async def get_job(job_id):
+            return self.job_manager.get(job_id)
+
+        async def list_jobs():
+            return self.job_manager.list()
+
+        async def stop_job(job_id):
+            return self.job_manager.stop(job_id)
+
+        async def job_logs(job_id):
+            return self.job_manager.logs(job_id)
+
+        async def cluster_demand():
+            """Unmet resource demand: queued, dep-ready tasks whose asks
+            don't fit any alive node's *available* resources right now
+            (feeds the autoscaler, reference load_metrics semantics)."""
+            demand = []
+            for rec in self.queue:
+                if rec.pending_deps:
+                    continue
+                if rec.spec["options"].get("placement_group"):
+                    continue  # counted via its PG's unplaced bundles below
+                res = rec.spec["options"].get("resources", {"CPU": 1})
+                sel = rec.spec["options"].get("label_selector")
+                if not any(n.matches_labels(sel) and n.fits(res)
+                           for n in self._alive_nodes()):
+                    demand.append(res)
+            # pending placement groups count too
+            for pg in self.pgs.values():
+                if pg.state == "PENDING":
+                    demand.extend(b.resources for b in pg.bundles
+                                  if b.node_id is None)
+            return demand
 
         async def job_counter_next():
             self.job_counter += 1
@@ -436,6 +492,7 @@ class Head:
         async def task_done(task_id):
             w = conn_state.get("worker")
             if w is not None:
+                self._task_event(TaskID(task_id), "", "FINISHED", worker=w)
                 self.notify_task_done(w)
             return True
 
@@ -552,6 +609,9 @@ class Head:
                 rec.pending_deps.add(oid)
                 self.dep_index.setdefault(oid, []).append(rec)
         self.queue.append(rec)
+        self._task_event(rec.task_id, rec.spec["options"].get("name", "task"),
+                         "PENDING_ARGS_AVAIL" if rec.pending_deps
+                         else "PENDING_NODE_ASSIGNMENT")
         self._kick()
 
     def _seal(self, meta: ObjectMeta) -> None:
@@ -689,6 +749,8 @@ class Head:
             self._acquire(w, resources)
         w.running_task = rec.task_id
         w.current_record = rec
+        self._task_event(rec.task_id, rec.spec["options"].get("name", "task"),
+                         "RUNNING", worker=w)
         w.conn.push("exec_task", spec=rec.spec)
         return None
 
@@ -868,6 +930,9 @@ class Head:
         from ray_tpu.core.exceptions import (TaskCancelledError,
                                              WorkerCrashedError)
 
+        self._task_event(rec.task_id, rec.spec["options"].get("name", "task"),
+                         "FAILED", error=cause)
+
         exc = (TaskCancelledError(cause) if cancelled
                else WorkerCrashedError(cause))
         err = serialization.serialize(exc)
@@ -979,6 +1044,8 @@ class Head:
             return [{"task_id": r.task_id.hex(),
                      "name": r.spec["options"].get("name"),
                      "pending_deps": len(r.pending_deps)} for r in self.queue]
+        if kind == "task_events":
+            return list(self.task_events)
         if kind == "nodes":
             return [{"node_id": n.node_id.hex(), "resources": n.resources,
                      "available": n.available, "labels": n.labels,
@@ -1014,6 +1081,9 @@ class Head:
         # handlers installed per-connection (they close over conn_state)
         self._server = protocol.Server({}, on_connect=on_connect, name="head")
         self.port = await self._server.start(port=port)
+        from ray_tpu.core.job_manager import JobManager
+
+        self.job_manager = JobManager(self.session, self.port)
         return self.port
 
     def notify_task_done(self, w: WorkerInfo) -> None:

@@ -24,6 +24,25 @@ async def amain(args) -> None:
                 labels=json.loads(args.labels) if args.labels else None)
     port = await head.start(port=args.port)
     print(f"RAY_TPU_HEAD_PORT={port}", flush=True)
+    ports = {"port": port}
+    if not args.no_dashboard:
+        try:
+            from ray_tpu.dashboard import start_dashboard
+
+            dport = await start_dashboard(head, port=args.dashboard_port)
+            print(f"RAY_TPU_DASHBOARD_PORT={dport}", flush=True)
+            ports["dashboard_port"] = dport
+        except Exception as e:  # dashboard is best-effort, never blocks boot
+            print(f"RAY_TPU_DASHBOARD_ERROR={e!r}", file=sys.stderr, flush=True)
+    if args.port_file:
+        # atomic write so pollers never read a partial file; lets the CLI
+        # spawn the head fully detached (stdout→devnull, no inherited pipe)
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(ports, f)
+        import os
+
+        os.replace(tmp, args.port_file)
     try:
         await asyncio.Event().wait()
     finally:
@@ -40,6 +59,9 @@ def main() -> None:
     p.add_argument("--object-store-bytes", type=int, default=2 << 30)
     p.add_argument("--max-workers", type=int, default=None)
     p.add_argument("--labels", type=str, default=None)
+    p.add_argument("--no-dashboard", action="store_true")
+    p.add_argument("--port-file", type=str, default=None)
+    p.add_argument("--dashboard-port", type=int, default=0)
     args = p.parse_args()
     try:
         asyncio.run(amain(args))

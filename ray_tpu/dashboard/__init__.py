@@ -1,0 +1,11 @@
+"""Dashboard: HTTP API + Prometheus metrics endpoint on the head node.
+
+Parity (core subset) with `python/ray/dashboard/head.py` + its module
+backends (node/state/metrics): REST endpoints over the head's live tables
+and a `/metrics` Prometheus scrape target aggregating every process's
+pushed snapshots (`ray_tpu.util.metrics`).
+"""
+
+from ray_tpu.dashboard.head_http import start_dashboard
+
+__all__ = ["start_dashboard"]

@@ -1,0 +1,143 @@
+"""aiohttp app serving cluster state + metrics from inside the head process.
+
+Runs on the head's event loop; all reads are against in-memory tables so no
+locking is needed (single-threaded asyncio, like the reference's
+GCS-backed StateHead).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #ccc;padding:4px 8px;text-align:left}</style></head>
+<body><h2>ray_tpu cluster</h2><div id="out">loading…</div>
+<script>
+async function refresh(){
+  const c = await (await fetch('/api/cluster')).json();
+  const n = await (await fetch('/api/nodes')).json();
+  const a = await (await fetch('/api/summary')).json();
+  let h = `<p>session <b>${c.session}</b> · uptime ${c.uptime.toFixed(0)}s ·
+    ${c.num_nodes} nodes · ${c.num_workers} workers</p>`;
+  h += '<h3>resources</h3><table><tr><th>resource</th><th>avail</th><th>total</th></tr>';
+  for (const k of Object.keys(c.total_resources))
+    h += `<tr><td>${k}</td><td>${c.available_resources[k]??0}</td><td>${c.total_resources[k]}</td></tr>`;
+  h += '</table><h3>tasks</h3><pre>' + JSON.stringify(a.tasks, null, 1) + '</pre>';
+  h += '<h3>actors</h3><pre>' + JSON.stringify(a.actors, null, 1) + '</pre>';
+  h += '<h3>nodes</h3><table><tr><th>node</th><th>alive</th><th>head</th><th>resources</th></tr>';
+  for (const x of n) h += `<tr><td>${x.node_id.slice(0,12)}</td><td>${x.alive}</td><td>${x.is_head}</td><td>${JSON.stringify(x.resources)}</td></tr>`;
+  h += '</table>';
+  document.getElementById('out').innerHTML = h;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+def _json(data) -> web.Response:
+    return web.Response(text=json.dumps(data, default=str),
+                        content_type="application/json")
+
+
+def build_app(head) -> web.Application:
+    app = web.Application()
+
+    async def index(_req):
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+    async def cluster(_req):
+        info = await head._handlers({})["cluster_info"]()
+        info.pop("node_id", None)  # bytes; not JSON-friendly
+        return _json(info)
+
+    def state_route(kind):
+        async def handler(req):
+            limit = req.query.get("limit")
+            rows = head._list_state(kind)
+            return _json(rows[:int(limit)] if limit else rows)
+
+        return handler
+
+    async def summary(_req):
+        from ray_tpu.util.state.api import (summarize_actor_rows,
+                                            summarize_object_rows,
+                                            summarize_task_rows)
+
+        return _json({
+            "tasks": summarize_task_rows(head._list_state("task_events")),
+            "actors": summarize_actor_rows(head._list_state("actors")),
+            "objects": summarize_object_rows(head._list_state("objects")),
+        })
+
+    async def metrics(_req):
+        from ray_tpu.util.metrics import render_prometheus
+
+        snapshots = {}
+        for (ns, key), value in list(head.kv.items()):
+            if ns == "_metrics":
+                try:
+                    snapshots[key.decode()] = json.loads(value)
+                except Exception:
+                    continue
+        return web.Response(text=render_prometheus(snapshots),
+                            content_type="text/plain")
+
+    app.router.add_get("/", index)
+    app.router.add_get("/api/cluster", cluster)
+    for kind in ("nodes", "actors", "workers", "tasks", "task_events",
+                 "objects", "placement_groups"):
+        app.router.add_get(f"/api/{kind}", state_route(kind))
+    # ------------------------------------------------------ job REST API
+    # (reference: dashboard/modules/job REST surface)
+    async def jobs_post(req):
+        body = await req.json()
+        job_id = await head.job_manager.submit(
+            body["entrypoint"], metadata=body.get("metadata"),
+            env=(body.get("runtime_env") or {}).get("env_vars"),
+            working_dir=(body.get("runtime_env") or {}).get("working_dir"),
+            job_id=body.get("submission_id"))
+        return _json({"job_id": job_id, "submission_id": job_id})
+
+    async def jobs_list(_req):
+        return _json(head.job_manager.list())
+
+    async def job_get(req):
+        info = head.job_manager.get(req.match_info["job_id"])
+        if info is None:
+            raise web.HTTPNotFound()
+        return _json(info)
+
+    async def job_logs(req):
+        return web.Response(text=head.job_manager.logs(
+            req.match_info["job_id"]), content_type="text/plain")
+
+    async def job_stop(req):
+        return _json({"stopped": head.job_manager.stop(
+            req.match_info["job_id"])})
+
+    app.router.add_post("/api/jobs/", jobs_post)
+    app.router.add_get("/api/jobs/", jobs_list)
+    app.router.add_get("/api/jobs/{job_id}", job_get)
+    app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
+    app.router.add_post("/api/jobs/{job_id}/stop", job_stop)
+    app.router.add_get("/api/summary", summary)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+async def start_dashboard(head, port: int = 0) -> int:
+    """Start the dashboard on the running event loop; returns the bound port."""
+    app = build_app(head)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    bound = site._server.sockets[0].getsockname()[1]
+    head.dashboard_port = bound
+    head._dashboard_runner = runner
+    return bound

@@ -1,0 +1,78 @@
+"""Job submission SDK.
+
+Parity: `ray.job_submission.JobSubmissionClient`
+(`python/ray/dashboard/modules/job/sdk.py:36`) — submit shell entrypoints
+that run as drivers on the cluster, poll status, fetch logs. Talks the
+head's RPC protocol directly (the REST mirror lives on the dashboard).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = {SUCCEEDED, FAILED, STOPPED}
+
+
+class JobSubmissionClient:
+    """`JobSubmissionClient("127.0.0.1:6379")` or, with no address, the
+    cluster this driver is already attached to."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if address is not None and not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        elif not ray_tpu.is_initialized():
+            ray_tpu.init()
+        from ray_tpu.core.api import _global_client
+
+        self._client = _global_client()
+
+    def submit_job(self, *, entrypoint: str,
+                   metadata: Optional[Dict[str, str]] = None,
+                   runtime_env: Optional[dict] = None,
+                   submission_id: Optional[str] = None) -> str:
+        env = dict((runtime_env or {}).get("env_vars") or {})
+        working_dir = (runtime_env or {}).get("working_dir")
+        return self._client.head_request(
+            "submit_job", entrypoint=entrypoint, metadata=metadata, env=env,
+            working_dir=working_dir, job_id=submission_id)
+
+    def get_job_info(self, job_id: str) -> dict:
+        info = self._client.head_request("get_job", job_id=job_id)
+        if info is None:
+            raise RuntimeError(f"no job {job_id!r}")
+        return info
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._client.head_request("job_logs", job_id=job_id)
+
+    def list_jobs(self) -> List[dict]:
+        return self._client.head_request("list_jobs")
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._client.head_request("stop_job", job_id=job_id)
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0,
+                            poll_s: float = 0.25) -> str:
+        deadline = time.time() + timeout
+        while True:
+            status = self.get_job_status(job_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {status} after {timeout}s")
+            time.sleep(poll_s)

@@ -1,0 +1,271 @@
+"""`ray-tpu` CLI: cluster bring-up, introspection, jobs, timeline.
+
+Parity (core subset) with the reference CLI (`python/ray/scripts/scripts.py`:
+`ray start/stop/status/list/summary/timeline/job`): head and worker-node
+bring-up print a joinable address; every other subcommand attaches via
+--address / RAY_TPU_ADDRESS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ADDR_FILE = "/tmp/ray_tpu/last_address"
+
+
+def _save_address(addr: str) -> None:
+    os.makedirs(os.path.dirname(ADDR_FILE), exist_ok=True)
+    with open(ADDR_FILE, "w") as f:
+        f.write(addr)
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr and os.path.exists(ADDR_FILE):
+        addr = open(ADDR_FILE).read().strip()
+    if not addr:
+        sys.exit("no cluster address: pass --address, set RAY_TPU_ADDRESS, "
+                 "or run `ray-tpu start --head` on this machine first")
+    return addr
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+    from ray_tpu.core.api import _global_client
+
+    return _global_client()
+
+
+# ------------------------------------------------------------------- start
+def cmd_start(args) -> None:
+    if args.head:
+        cmd = [sys.executable, "-m", "ray_tpu.core.head_main",
+               "--session", f"cli{os.getpid()}{int(time.time())%100000}",
+               "--port", str(args.port)]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpu_chips is not None:
+            cmd += ["--num-tpu-chips", str(args.num_tpu_chips)]
+        if args.resources:
+            cmd += ["--resources", args.resources]
+        import tempfile
+
+        fd, port_file = tempfile.mkstemp(prefix="ray_tpu_ports_")
+        os.close(fd)
+        os.unlink(port_file)   # head_main atomically re-creates it when ready
+        cmd += ["--port-file", port_file]
+        # fully detach stdio: a live head must not hold the CLI's pipes
+        # (otherwise `ray-tpu start --head | tee` never sees EOF)
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=None, start_new_session=True)
+        port = dash = None
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    sys.exit("head failed to start")
+                if os.path.exists(port_file):
+                    ports = json.load(open(port_file))
+                    port, dash = ports["port"], ports.get("dashboard_port")
+                    break
+                time.sleep(0.05)
+        finally:
+            try:
+                os.unlink(port_file)
+            except OSError:
+                pass
+        if port is None:
+            proc.terminate()
+            sys.exit("head failed to start (timeout)")
+        addr = f"127.0.0.1:{port}"
+        _save_address(addr)
+        print(f"started head at {addr}")
+        if dash:
+            print(f"dashboard: http://127.0.0.1:{dash}")
+        print(f"join with: ray-tpu start --address={addr}")
+        print(f"drivers:   RAY_TPU_ADDRESS={addr} python my_script.py")
+        if args.block:
+            try:
+                proc.wait()
+            except KeyboardInterrupt:
+                proc.terminate()
+    else:
+        if not args.address:
+            sys.exit("worker nodes need --address=<head host:port>")
+        cmd = [sys.executable, "-m", "ray_tpu.core.node_main",
+               "--address", args.address]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpu_chips is not None:
+            cmd += ["--num-tpu-chips", str(args.num_tpu_chips)]
+        if args.resources:
+            cmd += ["--resources", args.resources]
+        # same detachment as the head branch: the daemon must not hold the
+        # CLI's pipes or die with the terminal
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=None, start_new_session=True)
+        print(f"node daemon started (pid {proc.pid}), joined {args.address}")
+        if args.block:
+            try:
+                proc.wait()
+            except KeyboardInterrupt:
+                proc.terminate()
+
+
+def cmd_stop(args) -> None:
+    import glob
+
+    n = 0
+    for pat in ("ray_tpu.core.head_main", "ray_tpu.core.node_main",
+                "ray_tpu.core.worker_main"):
+        out = subprocess.run(["pkill", "-f", pat], capture_output=True)
+        n += out.returncode == 0
+    for seg in glob.glob("/dev/shm/rtpu_*"):
+        try:
+            os.unlink(seg)
+        except OSError:
+            pass
+    if os.path.exists(ADDR_FILE):
+        os.unlink(ADDR_FILE)
+    print("stopped" if n else "nothing to stop")
+
+
+# ------------------------------------------------------------------ status
+def cmd_status(args) -> None:
+    client = _connect(args)
+    info = client.head_request("cluster_info")
+    print(f"session:  {info['session']}")
+    print(f"uptime:   {info['uptime']:.0f}s")
+    print(f"nodes:    {info['num_nodes']}  workers: {info['num_workers']}")
+    if info.get("dashboard_port"):
+        print(f"dashboard: http://127.0.0.1:{info['dashboard_port']}")
+    print("resources:")
+    for r, total in sorted(info["total_resources"].items()):
+        avail = info["available_resources"].get(r, 0)
+        print(f"  {r}: {avail:g}/{total:g} available")
+
+
+def cmd_list(args) -> None:
+    client = _connect(args)
+    kind = {"pgs": "placement_groups"}.get(args.kind, args.kind)
+    rows = client.head_request("list_state", kind=kind)
+    print(json.dumps(rows[:args.limit] if args.limit else rows, indent=2,
+                     default=str))
+
+
+def cmd_summary(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    print(json.dumps({"tasks": state.summarize_tasks(),
+                      "actors": state.summarize_actors(),
+                      "objects": state.summarize_objects()}, indent=2))
+
+
+def cmd_timeline(args) -> None:
+    _connect(args)
+    import ray_tpu
+
+    events = ray_tpu.timeline(args.output)
+    print(f"wrote {len(events)} trace events to {args.output}")
+
+
+# --------------------------------------------------------------------- job
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    if args.job_cmd == "submit":
+        parts = args.entrypoint
+        if parts and parts[0] == "--":   # `job submit -- python x.py`
+            parts = parts[1:]
+        entrypoint = " ".join(parts)
+        job_id = client.submit_job(entrypoint=entrypoint)
+        print(f"submitted {job_id}")
+        if not args.no_wait:
+            status = client.wait_until_finished(job_id, timeout=args.timeout)
+            print(f"status: {status}")
+            print(client.get_job_logs(job_id), end="")
+            if status != "SUCCEEDED":
+                sys.exit(1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.job_id) else "not running")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray-tpu",
+                                description="TPU-native distributed runtime")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpu-chips", type=int, default=None)
+    sp.add_argument("--resources", default=None, help="JSON dict")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop all local cluster processes")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("summary", cmd_summary)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--address", default=None)
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["tasks", "task_events", "actors",
+                                     "workers", "objects", "nodes", "pgs"])
+    sp.add_argument("--limit", type=int, default=None)
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("timeline", help="dump a Chrome trace")
+    sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("job")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address", default=None)
+    j.add_argument("--no-wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    j.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+        j.add_argument("--address", default=None)
+        j.set_defaults(fn=cmd_job)
+    j = jsub.add_parser("list")
+    j.add_argument("--address", default=None)
+    j.set_defaults(fn=cmd_job)
+    return p
+
+
+def main(argv=None) -> None:
+    # die quietly when downstream of a closed pipe (`ray-tpu list ... | head`)
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,202 @@
+"""Metrics API: Counter / Gauge / Histogram with Prometheus export.
+
+Parity: `ray.util.metrics` (`python/ray/util/metrics.py` → Cython
+`includes/metric.pxi` → per-node agent → Prometheus). Here every process
+keeps a local registry and a background thread pushes snapshots into the
+head's KV (`_metrics` namespace, one key per process); the dashboard's
+`/metrics` endpoint aggregates all snapshots into Prometheus text
+exposition format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "Metric"] = {}
+_LOCK = threading.Lock()
+_PUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_METRICS_PUSH_INTERVAL_S", "2.0"))
+_pusher: Optional[threading.Thread] = None
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0]
+
+
+class Metric:
+    """Base: a named metric with fixed tag keys; `.set_default_tags` then
+    record with per-call tag values (reference API shape)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        # (sorted tag-value tuple) -> value
+        self._series: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        with _LOCK:
+            _REGISTRY[(name, self.tag_keys)] = self
+        _ensure_pusher()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]):
+        merged = {**self._default_tags, **(tags or {})}
+        unknown = set(merged) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag(s) {unknown} for metric {self.name}")
+        return tuple(sorted(merged.items()))
+
+    def _snapshot(self) -> List[dict]:
+        with _LOCK:
+            return [{"tags": dict(k), "value": v}
+                    for k, v in self._series.items()]
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with _LOCK:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with _LOCK:
+            self._series[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        # series value: {"buckets": [...], "sum": s, "count": n}
+        self._hseries: Dict[Tuple, dict] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with _LOCK:
+            h = self._hseries.setdefault(
+                k, {"buckets": [0] * (len(self.boundaries) + 1),
+                    "sum": 0.0, "count": 0})
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            h["buckets"][i] += 1
+            h["sum"] += value
+            h["count"] += 1
+
+    def _snapshot(self) -> List[dict]:
+        with _LOCK:
+            return [{"tags": dict(k), "histogram": dict(v),
+                     "boundaries": list(self.boundaries)}
+                    for k, v in self._hseries.items()]
+
+
+# ------------------------------------------------------------------ export
+def snapshot_all() -> List[dict]:
+    with _LOCK:
+        metrics = list(_REGISTRY.values())
+    return [{"name": m.name, "kind": m.kind, "description": m.description,
+             "series": m._snapshot()} for m in metrics]
+
+
+def _push_once() -> bool:
+    from ray_tpu.core import api as core_api
+
+    if not core_api.is_initialized():
+        return False
+    client = core_api._global_client()
+    try:
+        client.head_request(
+            "kv_put", ns="_metrics",
+            key=f"proc:{client.worker_id.hex()}".encode(),
+            value=json.dumps(snapshot_all()).encode(), overwrite=True)
+        return True
+    except Exception:
+        return False
+
+
+def _ensure_pusher() -> None:
+    global _pusher
+    with _LOCK:
+        if _pusher is not None:
+            return
+
+        def loop():
+            while True:
+                time.sleep(_PUSH_INTERVAL_S)
+                _push_once()
+
+        _pusher = threading.Thread(target=loop, daemon=True,
+                                   name="metrics-pusher")
+        _pusher.start()
+
+
+def flush() -> bool:
+    """Push this process's metrics to the head immediately."""
+    return _push_once()
+
+
+# -------------------------------------------------- Prometheus text format
+def _esc_label(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_tags(tags: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> str:
+    merged = {**tags, **(extra or {})}
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{_esc_label(v)}"' for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def render_prometheus(snapshots: Dict[str, List[dict]]) -> str:
+    """snapshots: {process_key: snapshot_all() output} → exposition text."""
+    seen_help = set()
+    out: List[str] = []
+    for proc, metrics in sorted(snapshots.items()):
+        for m in metrics:
+            name = f"ray_tpu_{m['name']}"
+            if name not in seen_help:
+                desc = str(m["description"]).replace("\\", "\\\\").replace(
+                    "\n", "\\n")
+                out.append(f"# HELP {name} {desc}")
+                out.append(f"# TYPE {name} {m['kind']}")
+                seen_help.add(name)
+            for s in m["series"]:
+                tags = {**s["tags"], "proc": proc}
+                if "histogram" in s:
+                    h, bounds = s["histogram"], s["boundaries"]
+                    acc = 0
+                    for b, c in zip(bounds + [float("inf")], h["buckets"]):
+                        acc += c
+                        le = "+Inf" if b == float("inf") else repr(b)
+                        out.append(f"{name}_bucket"
+                                   f"{_fmt_tags(tags, {'le': le})} {acc}")
+                    out.append(f"{name}_sum{_fmt_tags(tags)} {h['sum']}")
+                    out.append(f"{name}_count{_fmt_tags(tags)} {h['count']}")
+                else:
+                    out.append(f"{name}{_fmt_tags(tags)} {s['value']}")
+    return "\n".join(out) + "\n"

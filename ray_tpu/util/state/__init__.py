@@ -1,0 +1,18 @@
+"""State API: programmatic cluster introspection.
+
+Parity: `python/ray/util/state/api.py` (`ray list tasks/actors/objects/...`,
+summary APIs) backed by the head's live tables instead of a separate
+dashboard StateHead process.
+"""
+
+from ray_tpu.util.state.api import (get_actor, get_placement_group, list_actors,
+                                    list_nodes, list_objects,
+                                    list_placement_groups, list_task_events,
+                                    list_tasks, list_workers, summarize_actors,
+                                    summarize_objects, summarize_tasks)
+
+__all__ = [
+    "get_actor", "get_placement_group", "list_actors", "list_nodes",
+    "list_objects", "list_placement_groups", "list_task_events", "list_tasks",
+    "list_workers", "summarize_actors", "summarize_objects", "summarize_tasks",
+]

@@ -1,0 +1,111 @@
+"""State API implementation.
+
+Each `list_*` supports the reference's filter grammar subset:
+`filters=[("key", "=", value), ("key", "!=", value)]` plus `limit`
+(`python/ray/util/state/api.py` list_tasks/list_actors/... semantics).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, List, Optional, Sequence, Tuple
+
+
+def _client():
+    from ray_tpu.core.api import _auto_init, _global_client
+
+    _auto_init()
+    return _global_client()
+
+
+def _apply_filters(rows: List[dict],
+                   filters: Optional[Sequence[Tuple[str, str, Any]]],
+                   limit: Optional[int]) -> List[dict]:
+    if filters:
+        for key, op, val in filters:
+            if op == "=":
+                rows = [r for r in rows if r.get(key) == val]
+            elif op == "!=":
+                rows = [r for r in rows if r.get(key) != val]
+            else:
+                raise ValueError(f"unsupported filter op {op!r} (use '=' or '!=')")
+    return rows[:limit] if limit else rows
+
+
+def _list(kind: str, filters=None, limit: Optional[int] = None) -> List[dict]:
+    rows = _client().head_request("list_state", kind=kind)
+    return _apply_filters(rows, filters, limit)
+
+
+def list_tasks(filters=None, limit=None) -> List[dict]:
+    """Queued (not-yet-dispatched) tasks; completed ones are in
+    `list_task_events`."""
+    return _list("tasks", filters, limit)
+
+
+def list_task_events(filters=None, limit=None) -> List[dict]:
+    """Task lifecycle transitions (PENDING_* / RUNNING / FINISHED / FAILED)."""
+    return _list("task_events", filters, limit)
+
+
+def list_actors(filters=None, limit=None) -> List[dict]:
+    return _list("actors", filters, limit)
+
+
+def list_workers(filters=None, limit=None) -> List[dict]:
+    return _list("workers", filters, limit)
+
+
+def list_objects(filters=None, limit=None) -> List[dict]:
+    return _list("objects", filters, limit)
+
+
+def list_nodes(filters=None, limit=None) -> List[dict]:
+    return _list("nodes", filters, limit)
+
+
+def list_placement_groups(filters=None, limit=None) -> List[dict]:
+    return _list("placement_groups", filters, limit)
+
+
+def get_actor(actor_id: str) -> Optional[dict]:
+    rows = list_actors(filters=[("actor_id", "=", actor_id)])
+    return rows[0] if rows else None
+
+
+def get_placement_group(pg_id: str) -> Optional[dict]:
+    rows = list_placement_groups(filters=[("pg_id", "=", pg_id)])
+    return rows[0] if rows else None
+
+
+# ------------------------------------------------------------------ summary
+# pure row-level helpers shared with the dashboard's /api/summary
+def summarize_task_rows(events: List[dict]) -> dict:
+    """Latest state per task id, counted (reference `ray summary tasks`)."""
+    latest: dict = {}
+    for ev in events:
+        latest[ev["task_id"]] = ev["state"]
+    return {"total": len(latest), "by_state": dict(Counter(latest.values()))}
+
+
+def summarize_actor_rows(rows: List[dict]) -> dict:
+    counts = Counter(a["state"] for a in rows)
+    return {"total": sum(counts.values()), "by_state": dict(counts)}
+
+
+def summarize_object_rows(rows: List[dict]) -> dict:
+    return {"total": len(rows),
+            "total_size_bytes": sum(r.get("size") or 0 for r in rows),
+            "by_kind": dict(Counter(r["kind"] for r in rows))}
+
+
+def summarize_tasks() -> dict:
+    return summarize_task_rows(_list("task_events"))
+
+
+def summarize_actors() -> dict:
+    return summarize_actor_rows(_list("actors"))
+
+
+def summarize_objects() -> dict:
+    return summarize_object_rows(_list("objects"))

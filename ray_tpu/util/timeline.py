@@ -1,0 +1,53 @@
+"""`ray_tpu.timeline()`: Chrome-trace dump of task execution.
+
+Parity: the `ray timeline` CLI (`python/ray/scripts/scripts.py`) which turns
+profile events into a chrome://tracing JSON file. Here RUNNING→FINISHED/
+FAILED transitions from the head's task-event buffer become complete ("X")
+trace events, one row per worker.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Build Chrome trace events; write to `filename` if given."""
+    from ray_tpu.util.state import list_task_events
+
+    events = list_task_events()
+    open_spans = {}   # task_id -> RUNNING event
+    trace: List[dict] = []
+    names = {}
+    for ev in events:
+        if ev["state"] == "RUNNING":
+            open_spans[ev["task_id"]] = ev
+            if ev["name"]:
+                names[ev["task_id"]] = ev["name"]
+        elif ev["state"] in ("FINISHED", "FAILED"):
+            start = open_spans.pop(ev["task_id"], None)
+            if start is None:
+                continue
+            trace.append({
+                "name": names.get(ev["task_id"], "task"),
+                "cat": "task",
+                "ph": "X",
+                "ts": start["ts"] * 1e6,
+                "dur": (ev["ts"] - start["ts"]) * 1e6,
+                "pid": start["node_id"] or "head",
+                "tid": start["worker_id"] or "worker",
+                "args": {"task_id": ev["task_id"],
+                         "failed": ev["state"] == "FAILED"},
+            })
+    # still-running tasks: begin events so they show in the trace
+    for task_id, start in open_spans.items():
+        trace.append({"name": names.get(task_id, "task"), "cat": "task",
+                      "ph": "B", "ts": start["ts"] * 1e6,
+                      "pid": start["node_id"] or "head",
+                      "tid": start["worker_id"] or "worker",
+                      "args": {"task_id": task_id}})
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

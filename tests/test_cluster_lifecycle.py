@@ -1,0 +1,158 @@
+"""Job submission, CLI, and autoscaler tests.
+
+Mirrors the reference's job manager tests (`dashboard/modules/job/tests`)
+and fake-multi-node autoscaler tests (`autoscaler/_private/fake_multi_node`).
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=2, num_tpu_chips=0, max_workers=6)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_job_submit_end_to_end(cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    status = client.wait_until_finished(job_id, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_runs_as_cluster_driver(cluster):
+    """The entrypoint joins THIS cluster via RAY_TPU_ADDRESS and runs a task."""
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    script = ("import ray_tpu; ray_tpu.init(); "
+              "f = ray_tpu.remote(lambda: 41 + 1); "
+              "print('answer', ray_tpu.get(f.remote()))")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} -c \"{script}\"")
+    status = client.wait_until_finished(job_id, timeout=120)
+    logs = client.get_job_logs(job_id)
+    assert status == "SUCCEEDED", logs
+    assert "answer 42" in logs
+
+
+def test_job_failure_and_stop(cluster):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(bad, timeout=60) == "FAILED"
+    assert "exit code 3" in client.get_job_info(bad)["message"]
+
+    slow = client.submit_job(entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    time.sleep(0.5)
+    assert client.stop_job(slow)
+    assert client.wait_until_finished(slow, timeout=30) == "STOPPED"
+
+
+def test_job_rest_api(cluster):
+    info = ray_tpu.core.api._global_client().head_request("cluster_info")
+    port = info["dashboard_port"]
+    body = json.dumps({"entrypoint": f"{sys.executable} -c \"print('via rest')\""}).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/api/jobs/",
+                                 data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        job_id = json.loads(r.read())["job_id"]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/jobs/{job_id}", timeout=10) as r:
+            st = json.loads(r.read())["status"]
+        if st in ("SUCCEEDED", "FAILED", "STOPPED"):
+            break
+        time.sleep(0.2)
+    assert st == "SUCCEEDED"
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/jobs/{job_id}/logs", timeout=10) as r:
+        assert "via rest" in r.read().decode()
+
+
+def test_cli_status_and_list(cluster):
+    addr = f"127.0.0.1:{ray_tpu.core.api._global_client().head_port}"
+    env = {"RAY_TPU_ADDRESS": addr, "PATH": "/usr/bin:/bin",
+           "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "status"],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert out.returncode == 0, out.stderr
+    assert "nodes:" in out.stdout and "CPU" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "list", "nodes"],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)[0]["is_head"]
+
+
+def test_bin_pack():
+    from ray_tpu.autoscaler.autoscaler import bin_pack
+
+    types = {"small": {"resources": {"CPU": 2}, "max_nodes": 10},
+             "big": {"resources": {"CPU": 8, "TPU": 4}, "max_nodes": 2}}
+    # 3 × 2-CPU asks → one small node each
+    plan = bin_pack([{"CPU": 2}] * 3, types)
+    assert plan == {"small": 3}
+    # two 1-CPU asks pack onto ONE small node
+    plan = bin_pack([{"CPU": 1}] * 2, types)
+    assert plan == {"small": 1}
+    # TPU ask must go to big
+    plan = bin_pack([{"TPU": 4}], types)
+    assert plan == {"big": 1}
+    # respects max_nodes
+    plan = bin_pack([{"TPU": 4}] * 5, types, headroom={"big": 1})
+    assert plan == {"big": 1}
+    # infeasible demand is skipped
+    assert bin_pack([{"GPU": 1}], types) == {}
+
+
+def test_autoscaler_scales_up_and_down():
+    """Fresh cluster: 1-CPU head; a 4-CPU task forces a node launch; idle
+    node is reclaimed afterwards."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_tpu_chips=0, max_workers=4)
+    try:
+        from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
+
+        client = ray_tpu.core.api._global_client()
+        addr = f"127.0.0.1:{client.head_port}"
+        provider = LocalNodeProvider(
+            {"worker4": {"resources": {"CPU": 4}, "max_nodes": 2}}, addr)
+        scaler = StandardAutoscaler(provider, idle_timeout_s=3.0,
+                                    poll_interval_s=0.5)
+        scaler.start()
+        try:
+            @ray_tpu.remote(num_cpus=4)
+            def big():
+                return "ran"
+
+            assert ray_tpu.get(big.remote(), timeout=90) == "ran"
+            assert scaler.num_launches >= 1
+            deadline = time.time() + 60
+            while time.time() < deadline and provider.non_terminated_nodes():
+                time.sleep(0.5)
+            assert not provider.non_terminated_nodes(), "idle node not reclaimed"
+            assert scaler.num_terminations >= 1
+        finally:
+            scaler.stop()
+            provider.shutdown()
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,164 @@
+"""State API, task events, metrics, dashboard, timeline tests.
+
+Mirrors the reference's state-API tests (`python/ray/tests/test_state_api*.py`)
+and metrics export path (`dashboard/modules/metrics`).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4, num_tpu_chips=0, max_workers=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def _work(x):
+    time.sleep(0.05)
+    return x + 1
+
+
+@ray_tpu.remote
+def _boom():
+    raise ValueError("boom")
+
+
+@ray_tpu.remote
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def incr(self):
+        self.n += 1
+        return self.n
+
+
+def test_list_and_task_events(cluster):
+    from ray_tpu.util import state
+
+    refs = [_work.remote(i) for i in range(4)]
+    assert ray_tpu.get(refs) == [1, 2, 3, 4]
+    events = state.list_task_events()
+    states = {e["state"] for e in events}
+    assert "RUNNING" in states and "FINISHED" in states
+    finished = [e for e in events if e["state"] == "FINISHED"]
+    assert all(e["worker_id"] for e in finished)
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["is_head"]
+    workers = state.list_workers()
+    assert len(workers) >= 1
+
+
+def test_failed_task_event(cluster):
+    from ray_tpu.util import state
+
+    ref = _boom.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref)
+    # user exceptions are FINISHED (task ran; error is in the object) —
+    # FAILED is reserved for system failures. Just check the event exists.
+    evs = state.list_task_events(filters=[("name", "=", "_boom")])
+    assert evs
+
+
+def test_state_filters_and_summary(cluster):
+    from ray_tpu.util import state
+
+    h = _Counter.remote()
+    assert ray_tpu.get(h.incr.remote()) == 1
+    actors = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert any(a["actor_id"] == h._actor_id.hex() for a in actors)
+    s = state.summarize_actors()
+    assert s["by_state"].get("ALIVE", 0) >= 1
+    ts = state.summarize_tasks()
+    assert ts["total"] >= 4
+    with pytest.raises(ValueError):
+        state.list_actors(filters=[("state", ">", "ALIVE")])
+    ray_tpu.kill(h)
+
+
+def test_metrics_registry_and_prometheus():
+    from ray_tpu.util import metrics as m
+
+    c = m.Counter("test_requests", "total requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = m.Gauge("test_inflight", "in flight", tag_keys=())
+    g.set(7)
+    h = m.Histogram("test_latency", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    snap = {s["name"]: s for s in m.snapshot_all()}
+    assert snap["test_requests"]["series"][0]["value"] == 3.0
+    assert snap["test_inflight"]["series"][0]["value"] == 7.0
+    hs = snap["test_latency"]["series"][0]["histogram"]
+    assert hs["count"] == 3 and hs["buckets"] == [1, 1, 1]
+
+    text = m.render_prometheus({"p0": m.snapshot_all()})
+    assert 'ray_tpu_test_requests{proc="p0",route="/a"} 3.0' in text
+    assert "# TYPE ray_tpu_test_latency histogram" in text
+    assert 'le="+Inf"' in text
+
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(tags={"bad_key": "x"})
+
+
+def test_metrics_flush_to_head(cluster):
+    from ray_tpu.util import metrics as m
+
+    g = m.Gauge("test_pushed", "pushed gauge")
+    g.set(42)
+    assert m.flush()
+    client = ray_tpu.core.api._global_client()
+    raw = client.head_request("kv_get", ns="_metrics",
+                              key=f"proc:{client.worker_id.hex()}".encode())
+    names = [x["name"] for x in json.loads(raw)]
+    assert "test_pushed" in names
+
+
+def test_dashboard_http(cluster):
+    info = ray_tpu.core.api._global_client().head_request("cluster_info")
+    port = info["dashboard_port"]
+    assert port, "dashboard did not start"
+
+    def fetch(path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=10) as r:
+            return r.read().decode()
+
+    cluster_json = json.loads(fetch("/api/cluster"))
+    assert cluster_json["num_nodes"] == 1
+    nodes = json.loads(fetch("/api/nodes"))
+    assert nodes[0]["is_head"]
+    summary = json.loads(fetch("/api/summary"))
+    assert summary["tasks"]["total"] >= 1
+    from ray_tpu.util import metrics as m
+
+    m.Gauge("test_dash", "x").set(1)
+    m.flush()
+    text = fetch("/metrics")
+    assert "ray_tpu_test_dash" in text
+    html = fetch("/")
+    assert "ray_tpu" in html
+
+
+def test_timeline(cluster, tmp_path):
+    ray_tpu.get([_work.remote(i) for i in range(3)])
+    out = tmp_path / "trace.json"
+    events = ray_tpu.timeline(str(out))
+    complete = [e for e in events if e["ph"] == "X"]
+    assert complete and all(e["dur"] > 0 for e in complete)
+    assert json.load(open(out))
