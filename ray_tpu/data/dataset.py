@@ -33,12 +33,16 @@ class _Op:
     fn: Callable               # | "repartition" | "shuffle" | "sort" | "limit"
     arg: Any = None
     batch_format: str = "numpy"
+    # actor-pool compute (reference actor_pool_map_operator): fn is a class;
+    # `concurrency` actors each hold one instance
+    concurrency: int = 0
 
 
 def _apply_op(block: Block, op: _Op) -> Block:
     if op.kind == "map_batches":
         batch = block_to_batch(block, op.batch_format)
-        return batch_to_block(op.fn(batch))
+        fn = op.fn() if isinstance(op.fn, type) else op.fn
+        return batch_to_block(fn(batch))
     if op.kind == "map":
         return _rows_to_block([op.fn(r) for r in rows_of(block)])
     if op.kind == "filter":
@@ -49,6 +53,58 @@ def _apply_op(block: Block, op: _Op) -> Block:
             out.extend(op.fn(r))
         return _rows_to_block(out)
     raise ValueError(f"not a per-block op: {op.kind}")
+
+
+def _zip_blocks(lb: Block, rb: Block) -> Block:
+    def to_cols(b, side):
+        if not isinstance(b, dict):
+            b = _rows_to_block(list(b))
+        if not isinstance(b, dict):
+            raise ValueError(
+                f"zip() requires tabular (column) data; {side} side has "
+                "non-dict rows")
+        return b
+
+    merged = dict(to_cols(lb, "left"))
+    for k, v in to_cols(rb, "right").items():
+        merged[k if k not in merged else f"{k}_1"] = v
+    return merged
+
+
+def _join_blocks(lb: Block, rb: Block, on: str, how: str) -> Block:
+    """Hash-join two co-partitioned blocks into row dicts."""
+    import collections
+
+    lrows = list(rows_of(lb))
+    rrows = list(rows_of(rb))
+    rindex: Dict[Any, List[dict]] = collections.defaultdict(list)
+    for r in rrows:
+        rindex[r[on]].append(r)
+    lkeys = {r[on] for r in lrows}
+    out: List[dict] = []
+    lcols = set().union(*(r.keys() for r in lrows)) if lrows else set()
+    rcols = set().union(*(r.keys() for r in rrows)) if rrows else set()
+
+    def merge(l, r):
+        row = dict(l or {k: None for k in lcols})
+        for k, v in (r or {k: None for k in rcols}).items():
+            if k == on:
+                row[on] = row.get(on) if row.get(on) is not None else v
+            else:
+                row[k if k not in lcols or k == on else f"{k}_1"] = v
+        return row
+
+    for l in lrows:
+        matches = rindex.get(l[on], [])
+        if matches:
+            out.extend(merge(l, r) for r in matches)
+        elif how in ("left", "outer"):
+            out.append(merge(l, None))
+    if how in ("right", "outer"):
+        for r in rrows:
+            if r[on] not in lkeys:
+                out.append(merge(None, r))
+    return out
 
 
 def _rows_to_block(items: List[Any]) -> Block:
@@ -67,6 +123,36 @@ def _exec_chain(source, ops: List[_Op]) -> Block:
     return block
 
 
+def _make_block_actor():
+    import ray_tpu
+
+    @ray_tpu.remote
+    class _BlockActorImpl:
+        """One instance of a callable-class UDF; blocks stream through it
+        (reference actor_pool_map_operator worker)."""
+
+        def __init__(self, fn_cls):
+            self.fn = fn_cls() if isinstance(fn_cls, type) else fn_cls
+
+        def apply(self, block, batch_format):
+            return batch_to_block(self.fn(block_to_batch(block, batch_format)))
+
+    return _BlockActorImpl
+
+
+class _BlockActorProxy:
+    _cls = None
+
+    @classmethod
+    def remote(cls, fn):
+        if cls._cls is None:
+            cls._cls = _make_block_actor()
+        return cls._cls.remote(fn)
+
+
+_BlockActor = _BlockActorProxy
+
+
 class Dataset:
     """Lazy, immutable; every transform returns a new Dataset."""
 
@@ -82,8 +168,15 @@ class Dataset:
         return Dataset(self._partitions, self._ops + [op], self._parallelism)
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
-                    **_ignored) -> "Dataset":
-        return self._with_op(_Op("map_batches", fn, batch_format=batch_format))
+                    concurrency: Optional[int] = None,
+                    compute: Optional[str] = None, **_ignored) -> "Dataset":
+        """`fn` may be a callable class (reference semantics): it is then
+        instantiated once per pool actor and blocks stream through the pool."""
+        use_actors = (isinstance(fn, type) or compute == "actors"
+                      or (concurrency or 0) > 0)
+        return self._with_op(_Op("map_batches", fn, batch_format=batch_format,
+                                 concurrency=(concurrency or 2) if use_actors
+                                 else 0))
 
     def map(self, fn: Callable) -> "Dataset":
         return self._with_op(_Op("map", fn))
@@ -112,78 +205,280 @@ class Dataset:
                 break
         return Dataset(out, [], self._parallelism)
 
+    def _shuffled(self, P: int, mode: str, **kw) -> "Dataset":
+        """Two-stage distributed shuffle; blocks never touch the driver
+        (ray_tpu.data.shuffle). Falls back to local execution when no
+        cluster is up."""
+        import ray_tpu
+        from ray_tpu.data import shuffle as shf
+
+        if not ray_tpu.is_initialized():
+            # local fallback: same algorithm, thunks instead of tasks
+            base = kw.get("seed")
+            parts = [shf._map_partition(p, self._ops, P, mode,
+                                        kw.get("key"),
+                                        None if base is None
+                                        else base + 7919 * i,
+                                        kw.get("boundaries"))
+                     for i, p in enumerate(self._partitions)]
+            reduce_fn = kw.get("reduce_fn") or shf._reduce_concat
+            extra = kw.get("reduce_extra_args", ())
+            blocks = []
+            for i in range(P):
+                cols = [(pp[i] if P > 1 else pp) for pp in parts]
+                blocks.append(reduce_fn(*extra, *cols))
+            return Dataset(blocks, [], self._parallelism)
+        refs = shf.shuffle_refs(self._partitions, self._ops, P, mode, **kw)
+        return Dataset(refs, [], self._parallelism)
+
     def repartition(self, num_blocks: int) -> "Dataset":
-        full = block_concat(list(self._stream_blocks()))
-        n = block_len(full)
+        """Order-preserving (reference semantics): block i holds a
+        contiguous range of the global row order."""
+        from ray_tpu.data import shuffle as shf
+
+        lens = shf.block_lens(self._partitions, self._ops)
+        n = sum(lens)
         sizes = [n // num_blocks + (1 if i < n % num_blocks else 0)
                  for i in range(num_blocks)]
-        blocks, off = [], 0
-        for s in sizes:
-            blocks.append(block_slice(full, off, off + s))
-            off += s
-        return Dataset(blocks, [], self._parallelism)
+        return self._reshard_to_sizes(sizes, lens=lens)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        n_parts = max(len(self._partitions), 1)
-        full = block_concat(list(self._stream_blocks()))
-        n = block_len(full)
-        perm = np.random.default_rng(seed).permutation(n)
-        if isinstance(full, dict):
-            shuffled: Block = {k: v[perm] for k, v in full.items()}
-        else:
-            shuffled = [full[i] for i in perm]
-        return Dataset([shuffled], [], self._parallelism).repartition(n_parts)
+        from ray_tpu.data import shuffle as shf
+
+        P = max(len(self._partitions), 1)
+        return self._shuffled(P, "random", seed=seed,
+                              reduce_fn=shf._reduce_shuffled,
+                              reduce_extra_args=(
+                                  np.random.randint(1 << 31)
+                                  if seed is None else seed + 13,))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        full = block_concat(list(self._stream_blocks()))
-        if isinstance(full, dict):
-            order = np.argsort(full[key], kind="stable")
-            if descending:
-                order = order[::-1]
-            return Dataset([{k: v[order] for k, v in full.items()}], [],
-                           self._parallelism)
-        items = sorted(full, key=lambda r: r[key], reverse=descending)
-        return Dataset([items], [], self._parallelism)
+        """Distributed sample sort: range-partition on sampled boundaries,
+        then sort each partition (partitions emerge globally ordered)."""
+        import ray_tpu
+        from ray_tpu.data import shuffle as shf
+
+        P = max(len(self._partitions), 1)
+        if ray_tpu.is_initialized() and P > 1:
+            bounds = shf.sample_boundaries(self._partitions, self._ops, key, P)
+        else:
+            allv = []
+            for b in Dataset(list(self._partitions), list(self._ops))._stream_blocks():
+                if isinstance(b, dict):
+                    allv.append(np.asarray(b[key]))
+                else:
+                    allv.append(np.asarray([r[key] for r in rows_of(b)]))
+            cat = np.sort(np.concatenate(allv)) if allv else np.zeros(0)
+            qs = np.linspace(0, max(len(cat) - 1, 0), P + 1)[1:-1].astype(int)
+            bounds = cat[qs] if len(cat) else np.zeros(P - 1)
+        ds = self._shuffled(P, "range", key=key, boundaries=bounds,
+                            reduce_fn=shf._reduce_sorted,
+                            reduce_extra_args=(key, descending))
+        if descending:
+            ds._partitions = list(reversed(ds._partitions))
+        return ds
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join (reference `Dataset.join` /
+        `_internal/execution/operators/join.py`): both sides hash-partition
+        on `on`; co-partitions join in reduce tasks."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported how={how!r}")
+        import ray_tpu
+
+        P = num_partitions or max(len(self._partitions),
+                                  len(other._partitions), 1)
+        left = self._shuffled(P, "hash", key=on)
+        right = other._shuffled(P, "hash", key=on)
+
+        def join_parts(lb, rb):
+            return _join_blocks(lb, rb, on, how)
+
+        if ray_tpu.is_initialized():
+            task = ray_tpu.remote(join_parts)
+            refs = [task.remote(l, r) for l, r in
+                    zip(left._partitions, right._partitions)]
+            return Dataset(refs, [], self._parallelism)
+        return Dataset([join_parts(l() if callable(l) else l,
+                                   r() if callable(r) else r)
+                        for l, r in zip(left._partitions, right._partitions)],
+                       [], self._parallelism)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of equal-length tabular datasets (reference
+        `Dataset.zip`); the right side is resharded once to the left's
+        block sizes, then blocks merge pairwise in tasks. Each side's op
+        chain executes exactly once; only row counts reach the driver."""
+        import ray_tpu
+        from ray_tpu.data import shuffle as shf
+
+        left = self.materialize()
+        lsizes = shf.block_lens(left._partitions)
+        rlens = shf.block_lens(other._partitions, other._ops)
+        if sum(rlens) != sum(lsizes):
+            raise ValueError("zip() requires equal row counts")
+        right = other._reshard_to_sizes(lsizes, lens=rlens)
+
+        if ray_tpu.is_initialized():
+            task = ray_tpu.remote(_zip_blocks)
+            return Dataset([task.remote(l, r) for l, r in
+                            zip(left._partitions, right._partitions)], [],
+                           self._parallelism)
+        rblocks = list(Dataset(list(right._partitions), [])._stream_blocks())
+        return Dataset([_zip_blocks(l() if callable(l) else l, r)
+                        for l, r in zip(left._partitions, rblocks)], [],
+                       self._parallelism)
+
+    def _reshard_to_sizes(self, sizes: List[int],
+                          lens: Optional[List[int]] = None) -> "Dataset":
+        """Reshard so block i has exactly sizes[i] rows, preserving global
+        row order (zip alignment + order-preserving repartition)."""
+        from ray_tpu.data import shuffle as shf
+
+        lens = lens if lens is not None else shf.block_lens(
+            self._partitions, self._ops)
+        if sum(lens) != sum(sizes):
+            raise ValueError("reshard requires equal row counts")
+        bounds = np.cumsum(sizes)[:-1]  # searchsorted(.., 'right') boundaries
+        offsets = np.concatenate([[0], np.cumsum(lens)[:-1]])
+        import ray_tpu
+
+        P = len(sizes)
+        if ray_tpu.is_initialized():
+            map_task = ray_tpu.remote(shf._map_partition).options(num_returns=P)
+            reducer = ray_tpu.remote(shf._reduce_concat)
+            map_out = []
+            for src, off in zip(self._partitions, offsets):
+                refs = map_task.remote(src, self._ops, P, "offset",
+                                       None, int(off), bounds)
+                map_out.append([refs] if P == 1 else refs)
+            return Dataset([reducer.remote(*[m[p] for m in map_out])
+                            for p in range(P)], [], self._parallelism)
+        parts = [shf._map_partition(src, self._ops, P, "offset", None,
+                                    int(off), bounds)
+                 for src, off in zip(self._partitions, offsets)]
+        return Dataset([shf._reduce_concat(*[(pp[p] if P > 1 else pp)
+                                             for pp in parts])
+                        for p in range(P)], [], self._parallelism)
+
     # ------------------------------------------------------------ execution
+    def _segments(self):
+        """Split the op chain at actor-pool ops: [task-ops] → actor-op →
+        [task-ops] … (reference: TaskPoolMapOperator vs ActorPoolMapOperator
+        stages of one streaming topology)."""
+        segs: List[tuple] = []   # ("tasks", ops) | ("actor", op)
+        cur: List[_Op] = []
+        for op in self._ops:
+            if op.concurrency:
+                segs.append(("tasks", cur))
+                segs.append(("actor", op))
+                cur = []
+            else:
+                cur.append(op)
+        segs.append(("tasks", cur))
+        return segs
+
     def _stream_blocks(self) -> Iterator[Block]:
-        """The streaming executor: fused per-block tasks, bounded window."""
+        """The streaming executor: fused per-block tasks (actor-pool stages
+        pipelined between them), bounded in-flight window."""
+        import time as _time
+
         import ray_tpu
 
         if not self._partitions:
             return
+        t0 = _time.time()
+        nrows = 0
         use_tasks = ray_tpu.is_initialized() and (
             len(self._partitions) > 1 or self._ops)
         if not use_tasks:
             for p in self._partitions:
-                yield _exec_chain(p, self._ops)
+                block = p() if callable(p) else p
+                for op in self._ops:
+                    block = _apply_op(block, op)
+                nrows += block_len(block)
+                yield block
+            self._record_stats(len(self._partitions), nrows, _time.time() - t0)
             return
 
+        segs = self._segments()
         exec_task = ray_tpu.remote(_exec_chain)
+        # one actor pool per actor-stage, shared across all blocks
+        pools: Dict[int, List[Any]] = {}
+        for i, (kind, op) in enumerate(segs):
+            if kind == "actor":
+                pools[i] = [_BlockActor.remote(op.fn)
+                            for _ in range(op.concurrency)]
+        rr: Dict[int, int] = {i: 0 for i in pools}
+
+        def submit(partition_idx: int, src) -> Any:
+            """Chain every segment for one partition; returns final ref."""
+            ref = src
+            for i, (kind, seg_ops) in enumerate(segs):
+                if kind == "tasks":
+                    if seg_ops or i == 0:
+                        ref = exec_task.remote(ref, seg_ops)
+                else:
+                    pool = pools[i]
+                    actor = pool[rr[i] % len(pool)]
+                    rr[i] += 1
+                    op = seg_ops
+                    ref = actor.apply.remote(ref, op.batch_format)
+            return ref
+
         window = self._parallelism
         pending: List[Any] = []
         idx = 0
         emitted = 0
         results: Dict[int, Any] = {}
         submitted = {}
-        while emitted < len(self._partitions):
-            while idx < len(self._partitions) and len(pending) < window:
-                ref = exec_task.remote(self._partitions[idx], self._ops)
-                submitted[ref] = idx
-                pending.append(ref)
-                idx += 1
-            if not pending:
-                break
-            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=300)
-            for ref in ready:
-                results[submitted[ref]] = ray_tpu.get(ref)
-            # emit in order (deterministic iteration, like ordered execution)
-            while emitted in results:
-                yield results.pop(emitted)
-                emitted += 1
+        try:
+            while emitted < len(self._partitions):
+                while idx < len(self._partitions) and len(pending) < window:
+                    ref = submit(idx, self._partitions[idx])
+                    submitted[ref] = idx
+                    pending.append(ref)
+                    idx += 1
+                if not pending:
+                    break
+                ready, pending = ray_tpu.wait(pending, num_returns=1,
+                                              timeout=300)
+                for ref in ready:
+                    results[submitted[ref]] = ray_tpu.get(ref)
+                # emit in order (deterministic, like ordered execution)
+                while emitted in results:
+                    block = results.pop(emitted)
+                    nrows += block_len(block)
+                    yield block
+                    emitted += 1
+        finally:
+            # runs on GeneratorExit too: limit()/take() abandon the
+            # generator early and must not leak pool actors
+            for pool in pools.values():
+                for a in pool:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass
+            self._record_stats(len(self._partitions), nrows,
+                               _time.time() - t0)
+
+    def _record_stats(self, nblocks: int, nrows: int, wall: float) -> None:
+        self._last_stats = {"num_blocks": nblocks, "num_rows": nrows,
+                            "wall_time_s": wall}
+
+    def stats(self) -> str:
+        """Execution stats of the last run (reference `Dataset.stats()`)."""
+        st = getattr(self, "_last_stats", None)
+        if st is None:
+            return "Dataset not executed yet"
+        return (f"{st['num_blocks']} blocks, {st['num_rows']} rows in "
+                f"{st['wall_time_s']:.3f}s "
+                f"({st['num_rows'] / max(st['wall_time_s'], 1e-9):.0f} rows/s)")
 
     def _barrier_blocks(self) -> List[Block]:
         return list(self._stream_blocks())
@@ -267,44 +562,98 @@ class Dataset:
                 f"ops={[o.kind for o in self._ops]})")
 
 
+def _block_groups(block: Block, key: str) -> Dict[Any, Block]:
+    """Split one (already key-co-partitioned) block into per-key blocks."""
+    import collections
+
+    groups: Dict[Any, List[Any]] = collections.defaultdict(list)
+    for row in rows_of(block):
+        groups[row[key]].append(row)
+    return {k: _rows_to_block(v) for k, v in sorted(groups.items(),
+                                                    key=lambda kv: str(kv[0]))}
+
+
+def _agg_partition(key, specs, block) -> Block:
+    """Reduce-stage groupby: aggregate every key group in this hash
+    partition. specs = [(col, op_name, out_name)] with op in
+    count/sum/mean/min/max/std."""
+    fns = {"sum": np.sum, "mean": np.mean, "min": np.min, "max": np.max,
+           "std": np.std}
+    rows = []
+    for k, b in _block_groups(block, key).items():
+        row = {key: k}
+        for col, op, name in specs:
+            if op == "count":
+                row[name] = block_len(b)
+            else:
+                row[name] = fns[op](np.asarray(b[col]))
+        rows.append(row)
+    return _rows_to_block(rows)
+
+
+def _map_groups_partition(key, fn, block) -> Block:
+    outs = [batch_to_block(fn(block_to_batch(b, "numpy")))
+            for _, b in _block_groups(block, key).items()]
+    return block_concat(outs) if outs else []
+
+
 class GroupedData:
-    """Hash-partitioned groupby + aggregations (miniature hash_shuffle)."""
+    """Distributed groupby: hash-shuffle by key, then per-partition
+    aggregation tasks (reference `hash_aggregate` operator) — each key's
+    rows land in exactly one partition, so partial results are final."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    def _groups(self) -> Dict[Any, Block]:
-        import collections
+    def _agg_ds(self, specs) -> Dataset:
+        import functools
 
-        groups: Dict[Any, List[Any]] = collections.defaultdict(list)
-        for row in self._ds.iter_rows():
-            groups[row[self._key]].append(row)
-        return {k: _rows_to_block(v) for k, v in groups.items()}
+        P = max(min(len(self._ds._partitions), DEFAULT_WINDOW), 1)
+        shuffled = self._ds._shuffled(P, "hash", key=self._key)
+        return shuffled._with_op(_Op(
+            "map_batches",
+            functools.partial(_agg_partition_batch, self._key, specs)))
 
-    def _agg(self, col: str, fn: Callable, name: str) -> Dataset:
-        rows = [{self._key: k, name: fn(np.asarray(block[col]))}
-                for k, block in sorted(self._groups().items())]
-        return Dataset([_rows_to_block(rows)])
+    def aggregate(self, *specs) -> Dataset:
+        """specs: (col, op) or (col, op, out_name) tuples."""
+        return self._agg_ds([(c, op, rest[0] if rest else f"{op}({c})")
+                             for c, op, *rest in specs])
 
     def count(self) -> Dataset:
-        rows = [{self._key: k, "count": block_len(b)}
-                for k, b in sorted(self._groups().items())]
-        return Dataset([_rows_to_block(rows)])
+        return self._agg_ds([(None, "count", "count")])
 
     def sum(self, col: str) -> Dataset:
-        return self._agg(col, np.sum, f"sum({col})")
+        return self._agg_ds([(col, "sum", f"sum({col})")])
 
     def mean(self, col: str) -> Dataset:
-        return self._agg(col, np.mean, f"mean({col})")
+        return self._agg_ds([(col, "mean", f"mean({col})")])
 
     def min(self, col: str) -> Dataset:
-        return self._agg(col, np.min, f"min({col})")
+        return self._agg_ds([(col, "min", f"min({col})")])
 
     def max(self, col: str) -> Dataset:
-        return self._agg(col, np.max, f"max({col})")
+        return self._agg_ds([(col, "max", f"max({col})")])
+
+    def std(self, col: str) -> Dataset:
+        return self._agg_ds([(col, "std", f"std({col})")])
 
     def map_groups(self, fn: Callable) -> Dataset:
-        blocks = [batch_to_block(fn(block_to_batch(b, "numpy")))
-                  for _, b in sorted(self._groups().items())]
-        return Dataset(blocks)
+        import functools
+
+        P = max(min(len(self._ds._partitions), DEFAULT_WINDOW), 1)
+        shuffled = self._ds._shuffled(P, "hash", key=self._key)
+        return shuffled._with_op(_Op(
+            "map_batches",
+            functools.partial(_map_groups_partition_batch, self._key, fn)))
+
+
+def _agg_partition_batch(key, specs, batch):
+    return block_to_batch(_agg_partition(key, specs, batch_to_block(batch)),
+                          "numpy")
+
+
+def _map_groups_partition_batch(key, fn, batch):
+    return block_to_batch(_map_groups_partition(key, fn,
+                                                batch_to_block(batch)),
+                          "numpy")
