@@ -157,6 +157,23 @@ def free(refs: Sequence[ObjectRef]) -> None:
 
 
 # ------------------------------------------------------------------- tasks
+def _package_renv_cached(holder, client, opts: dict):
+    """Package runtime_env once per (holder, client): re-zipping the tree on
+    every .remote() call would re-walk and re-hash it per submission."""
+    renv = opts.get("runtime_env")
+    if not renv:
+        return None
+    key = id(client)
+    cache = getattr(holder, "_renv_cache", None)
+    if cache is not None and cache[0] == key:
+        return cache[1]
+    from ray_tpu.core.runtime_env import package_runtime_env
+
+    packaged = package_runtime_env(client, renv)
+    holder._renv_cache = (key, packaged)
+    return packaged
+
+
 def _build_resources(opts: dict) -> dict:
     res = {"CPU": float(opts.get("num_cpus", 1.0) or 0.0)}
     if opts.get("num_tpu_chips"):
@@ -187,7 +204,9 @@ class RemoteFunction:
         opts = dict(self._options)
         pg = opts.get("placement_group")
         num_returns = opts.get("num_returns", 1)
-        task_opts = {"resources": _build_resources(opts),
+        task_opts = {"runtime_env": _package_renv_cached(
+                         self, _global_client(), opts),
+                     "resources": _build_resources(opts),
                      "max_retries": opts.get("max_retries", 3),
                      "max_calls": opts.get("max_calls"),
                      "num_returns": num_returns,
@@ -297,7 +316,8 @@ class ActorClass:
             self._client = client
         opts = dict(self._options)
         pg = opts.get("placement_group")
-        actor_opts = {"resources": _build_resources({**opts, "num_cpus": opts.get("num_cpus", 0.0)}),
+        actor_opts = {"runtime_env": _package_renv_cached(self, client, opts),
+                      "resources": _build_resources({**opts, "num_cpus": opts.get("num_cpus", 0.0)}),
                       "placement_group": pg.id.binary() if pg is not None else None,
                       "placement_group_bundle_index": opts.get(
                           "placement_group_bundle_index"),

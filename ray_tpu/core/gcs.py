@@ -356,6 +356,8 @@ class Head:
             return True
 
         async def kv_put(ns, key, value, overwrite=True):
+            if ns == "_runtime_env":
+                self._bound_runtime_env_cache(len(value))
             k = (ns, key)
             if not overwrite and k in self.kv:
                 return False
@@ -1024,6 +1026,21 @@ class Head:
         return out
 
     # ---------------------------------------------------------------- state
+    def _bound_runtime_env_cache(self, incoming: int) -> None:
+        """Evict oldest runtime_env packages beyond the byte cap (no URI
+        refcounting — workers keep extracted copies, so only a cold worker
+        after eviction would refetch-and-fail, matching a bounded cache)."""
+        cap = int(os.environ.get("RAY_TPU_RUNTIME_ENV_CACHE_BYTES",
+                                 str(2 << 30)))
+        entries = [(k, v) for k, v in self.kv.items()
+                   if k[0] == "_runtime_env"]
+        total = sum(len(v) for _, v in entries) + incoming
+        for k, v in entries:  # dict order = insertion order = oldest first
+            if total <= cap:
+                break
+            del self.kv[k]
+            total -= len(v)
+
     def _list_state(self, kind: str):
         if kind == "actors":
             return [{"actor_id": a.hex(), "state": i.state,

@@ -1,0 +1,156 @@
+"""runtime_env: per-task/actor env vars, working_dir, py_modules.
+
+Parity (core subset) with `python/ray/_private/runtime_env/` + the per-node
+agent (`runtime_env_agent.py:165 GetOrCreateRuntimeEnv`): the driver
+packages local directories into the cluster KV (content-addressed zips);
+executing workers download + extract once per process and apply env vars /
+sys.path / cwd around the user code. Supported keys: `env_vars` (dict),
+`working_dir` (local dir path or previously-packaged URI), `py_modules`
+(list of dir paths). conda/pip/container isolation is not reproducible
+without network access and is intentionally out of scope (gated with a
+clear error).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_EXTRACT_CACHE: Dict[str, str] = {}   # uri -> extracted dir (per process)
+_UNSUPPORTED = ("conda", "pip", "uv", "container", "image_uri", "java_jars")
+_SUPPORTED = ("env_vars", "working_dir", "py_modules")
+
+
+def _zip_dir(path: str, prefix: str = "") -> bytes:
+    """prefix: entry-name prefix inside the zip — py_modules zips keep the
+    module dir name so `import <basename>` works after extraction (Ray's
+    documented py_modules semantics)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in
+                       ("__pycache__", ".git", ".venv", "node_modules")]
+            for f in files:
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, path)
+                zf.write(full, os.path.join(prefix, rel) if prefix else rel)
+    return buf.getvalue()
+
+
+def package_runtime_env(client, renv: Optional[dict]) -> Optional[dict]:
+    """Driver side: normalize + upload dirs → content-addressed URIs."""
+    if not renv:
+        return None
+    for key in _UNSUPPORTED:
+        if renv.get(key):
+            raise ValueError(
+                f"runtime_env[{key!r}] is not supported in this offline "
+                "build; ship dependencies via py_modules/working_dir")
+    unknown = set(renv) - set(_SUPPORTED) - set(_UNSUPPORTED)
+    if unknown:
+        # a typo'd key silently vanishing means the task runs without the
+        # intended environment — fail loudly instead
+        raise ValueError(f"unknown runtime_env key(s): {sorted(unknown)}; "
+                         f"supported: {list(_SUPPORTED)}")
+    out: Dict[str, Any] = {}
+    if renv.get("env_vars"):
+        out["env_vars"] = {str(k): str(v) for k, v in renv["env_vars"].items()}
+
+    def upload(path: str, prefix: str = "") -> str:
+        if path.startswith("rtenv://"):
+            return path
+        if not os.path.isdir(path):
+            raise ValueError(f"runtime_env dir {path!r} does not exist")
+        data = _zip_dir(path, prefix)
+        digest = hashlib.sha256(data).hexdigest()[:24]
+        uri = f"rtenv://{digest}"
+        # probe before shipping: re-uploading a multi-MB zip per call when
+        # the head already has the digest is pure waste
+        if not client.head_request("kv_keys", ns="_runtime_env",
+                                   prefix=uri.encode()):
+            client.head_request("kv_put", ns="_runtime_env",
+                                key=uri.encode(), value=data, overwrite=False)
+        return uri
+
+    if renv.get("working_dir"):
+        out["working_dir"] = upload(renv["working_dir"])
+    if renv.get("py_modules"):
+        # each entry is a MODULE directory; keep its name inside the zip so
+        # `import <basename>` works on the worker
+        out["py_modules"] = [
+            upload(p, prefix=os.path.basename(os.path.normpath(p)))
+            for p in renv["py_modules"]]
+    return out or None
+
+
+def _fetch_extract(client, uri: str) -> str:
+    """Worker side: download a packaged URI and extract (cached per proc)."""
+    if uri in _EXTRACT_CACHE:
+        return _EXTRACT_CACHE[uri]
+    dest = os.path.join("/tmp/ray_tpu", client.session, "runtime_env",
+                        uri.replace("rtenv://", ""))
+    if not os.path.isdir(dest) or not os.listdir(dest):
+        data = client.head_request("kv_get", ns="_runtime_env",
+                                   key=uri.encode())
+        if data is None:
+            raise RuntimeError(f"runtime_env package {uri} missing from KV")
+        tmp = dest + f".tmp{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            # another worker won the race; use theirs
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    _EXTRACT_CACHE[uri] = dest
+    return dest
+
+
+class AppliedEnv:
+    """Worker side: apply a normalized runtime_env; .restore() undoes the
+    env-var/cwd changes (sys.path additions persist for the process, as in
+    the reference's dedicated-worker model)."""
+
+    def __init__(self, client, renv: Optional[dict]):
+        self._saved_env: Dict[str, Optional[str]] = {}
+        self._saved_cwd: Optional[str] = None
+        if not renv:
+            return
+        try:
+            for uri in renv.get("py_modules") or []:
+                path = _fetch_extract(client, uri)
+                if path not in sys.path:
+                    sys.path.insert(0, path)
+            if renv.get("working_dir"):
+                path = _fetch_extract(client, renv["working_dir"])
+                if path not in sys.path:
+                    sys.path.insert(0, path)
+                self._saved_cwd = os.getcwd()
+                os.chdir(path)
+            for k, v in (renv.get("env_vars") or {}).items():
+                self._saved_env[k] = os.environ.get(k)
+                os.environ[k] = v
+        except BaseException:
+            # partial construction must not leak cwd/env onto the pooled
+            # worker (e.g. a cancel async-exc landing mid-apply)
+            self.restore()
+            raise
+
+    def restore(self) -> None:
+        for k, old in self._saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        if self._saved_cwd is not None:
+            try:
+                os.chdir(self._saved_cwd)
+            except OSError:
+                pass

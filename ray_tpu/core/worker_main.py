@@ -156,7 +156,12 @@ class WorkerRuntime:
         task_key = spec["task_id"].binary()
         self._task_threads[task_key] = threading.get_ident()
         streaming = opts.get("num_returns") == "streaming"
+        applied = None
         try:
+            if opts.get("runtime_env"):
+                from ray_tpu.core.runtime_env import AppliedEnv
+
+                applied = AppliedEnv(self.client, opts["runtime_env"])
             fn = self.client.fn_manager.load(spec["fn_key"])
             args, kwargs = self._resolve_args(spec["args"])
             result = fn(*args, **kwargs)
@@ -180,6 +185,8 @@ class WorkerRuntime:
                 except Exception:
                     pass
         finally:
+            if applied is not None:
+                applied.restore()
             self._task_threads.pop(task_key, None)
             retire = False
             max_calls = opts.get("max_calls")
@@ -231,6 +238,12 @@ class WorkerRuntime:
         self.client.current_actor_id = self.actor_id
 
         def _init():
+            if opts.get("runtime_env"):
+                from ray_tpu.core.runtime_env import AppliedEnv
+
+                # actors keep their env for life (dedicated-worker model);
+                # never restored — the worker exits with the actor
+                AppliedEnv(self.client, opts["runtime_env"])
             cls = self.client.fn_manager.load(spec["cls_key"])
             args, kwargs = self._resolve_args(spec["args"])
             self.actor_instance = cls(*args, **kwargs)

@@ -1,0 +1,115 @@
+"""Chaos tests: RPC fault injection + worker-kill monkeys under load.
+
+Mirrors the reference's chaos strategy (SURVEY §4.1): config-flag RPC
+failure injection (`rpc_chaos.h`, RAY_testing_rpc_failure) and
+ResourceKiller-style actors killing workers while a workload runs
+(`python/ray/_private/test_utils.py:1283`).
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import protocol
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4, num_tpu_chips=0, max_workers=10)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_rpc_chaos_injection_and_reset(cluster):
+    protocol.configure_chaos("kv_put:1.0")
+    try:
+        client = ray_tpu.core.api._global_client()
+        with pytest.raises(protocol.ConnectionLost, match="chaos"):
+            client.head_request("kv_put", ns="t", key=b"k", value=b"v",
+                                overwrite=True)
+    finally:
+        protocol.configure_chaos("")
+    assert client.head_request("kv_put", ns="t", key=b"k", value=b"v",
+                               overwrite=True) is not None
+
+
+def test_rpc_chaos_env_spec():
+    protocol.configure_chaos("a:0.5,b:1.0")
+    assert protocol._chaos == {"a": 0.5, "b": 1.0}
+    protocol.configure_chaos("")
+    assert protocol._chaos == {}
+
+
+@ray_tpu.remote(max_retries=5)
+def _slow_square(x):
+    time.sleep(0.2)
+    return x * x
+
+
+def test_worker_kill_monkey_under_load(cluster):
+    """Kill random busy workers while 24 tasks run; retries land them all."""
+    from ray_tpu.util import state
+
+    stop = threading.Event()
+    kills = []
+
+    def monkey():
+        rng = random.Random(0)
+        while not stop.is_set():
+            workers = [w for w in state.list_workers()
+                       if not w["is_driver"] and w["task"]]
+            if workers:
+                victim = rng.choice(workers)
+                try:
+                    os.kill(victim["pid"], 9)
+                    kills.append(victim["pid"])
+                except OSError:
+                    pass
+            time.sleep(0.4)
+
+    t = threading.Thread(target=monkey, daemon=True)
+    t.start()
+    try:
+        refs = [_slow_square.remote(i) for i in range(24)]
+        out = ray_tpu.get(refs, timeout=180)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert out == [i * i for i in range(24)]
+    assert kills, "monkey never killed anything — test proved nothing"
+
+
+def test_actor_restart_under_repeated_kill(cluster):
+    @ray_tpu.remote(max_restarts=3)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    for round_ in range(2):
+        pid = ray_tpu.get(c.pid.remote())
+        os.kill(pid, 9)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                # state resets on restart (no persistence), process is new
+                if ray_tpu.get(c.incr.remote(), timeout=10) >= 1 and \
+                        ray_tpu.get(c.pid.remote(), timeout=10) != pid:
+                    break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            pytest.fail(f"actor did not restart after kill round {round_}")
+    ray_tpu.kill(c)
