@@ -15,6 +15,8 @@ import time
 import uuid
 from typing import Dict, Optional
 
+from ray_tpu.utils.platform import STATE_DIR
+
 
 class JobInfo:
     def __init__(self, job_id: str, entrypoint: str, metadata: Optional[dict]):
@@ -40,7 +42,7 @@ class JobManager:
         self.session = session
         self.head_port = head_port
         self.jobs: Dict[str, JobInfo] = {}
-        self.log_dir = os.path.join("/tmp/ray_tpu", session, "logs")
+        self.log_dir = os.path.join(STATE_DIR, session, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
 
     async def submit(self, entrypoint: str, *, metadata: Optional[dict] = None,

@@ -20,6 +20,8 @@ import sys
 import zipfile
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.utils.platform import STATE_DIR
+
 _EXTRACT_CACHE: Dict[str, str] = {}   # uri -> extracted dir (per process)
 _UNSUPPORTED = ("conda", "pip", "uv", "container", "image_uri", "java_jars")
 _SUPPORTED = ("env_vars", "working_dir", "py_modules")
@@ -91,7 +93,7 @@ def _fetch_extract(client, uri: str) -> str:
     """Worker side: download a packaged URI and extract (cached per proc)."""
     if uri in _EXTRACT_CACHE:
         return _EXTRACT_CACHE[uri]
-    dest = os.path.join("/tmp/ray_tpu", client.session, "runtime_env",
+    dest = os.path.join(STATE_DIR, client.session, "runtime_env",
                         uri.replace("rtenv://", ""))
     if not os.path.isdir(dest) or not os.listdir(dest):
         data = client.head_request("kv_get", ns="_runtime_env",

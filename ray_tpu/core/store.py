@@ -31,6 +31,8 @@ from typing import Dict, Optional
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.serialization import SerializedObject
 
+from ray_tpu.utils.platform import STATE_DIR
+
 INLINE_THRESHOLD = 100 * 1024  # small objects ride the control plane inline
 ARENA_HIGH_WATERMARK = 0.85    # head starts spilling above this fill ratio
 ARENA_LOW_WATERMARK = 0.75     # ...down to this
@@ -69,7 +71,7 @@ class SharedMemoryStore:
         self.session = session
         self.capacity = capacity_bytes
         self.used = 0
-        self.spill_dir = spill_dir or f"/tmp/ray_tpu/{session}/spill"
+        self.spill_dir = spill_dir or os.path.join(STATE_DIR, session, "spill")
         self._segments: "OrderedDict[str, shared_memory.SharedMemory]" = OrderedDict()
         self._meta_by_segment: Dict[str, ObjectMeta] = {}
         self._pinned: Dict[str, int] = {}

@@ -180,17 +180,9 @@ def apply_rope(x, cos, sin):
 
 def _resolve_attn_impl(cfg, seq_len: int) -> str:
     impl = cfg.attn_impl
-    if impl != "auto":
-        return impl
-    from ray_tpu.parallel.mesh import current_mesh
+    from ray_tpu.models.lm import resolve_attn_impl
 
-    mesh = current_mesh()
-    if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        return "ring"
-    flash_ok = seq_len <= 128 or seq_len % 128 == 0
-    if jax.default_backend() == "tpu" and flash_ok:
-        return "flash"
-    return "dense"
+    return resolve_attn_impl(impl, seq_len)
 
 
 def attention(x, p, cfg) -> jax.Array:

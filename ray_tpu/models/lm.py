@@ -19,3 +19,26 @@ def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(logz - gold)
+
+
+def resolve_attn_impl(attn_impl: str, seq_len: int) -> str:
+    """Shared auto attention-implementation policy for all model families.
+
+    auto → ring when the active mesh shards the sequence axis; else flash
+    only where it measured faster than XLA's fused dense attention on TPU
+    (v5e sweep 2026-07: dense wins through seq 1024; flash needs the T²
+    score matrix to dominate) — dense otherwise.
+    """
+    if attn_impl != "auto":
+        return attn_impl
+    import jax
+
+    from ray_tpu.parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return "ring"
+    if (jax.default_backend() == "tpu" and seq_len >= 2048
+            and seq_len % 128 == 0):
+        return "flash"
+    return "dense"

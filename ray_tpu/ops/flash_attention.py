@@ -96,7 +96,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    # lse carries a trailing unit lane dim: TPU lowering requires the last
+    # two block dims be (8k, 128m) or equal to the array dims — (bq, 1)
+    # satisfies that where a 3-D (1, bq) block would not
+    lse_ref[0, 0] = m + jnp.log(l)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
@@ -120,11 +123,11 @@ def _fwd(q, k, v, causal, scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -140,8 +143,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
+    lse = lse_ref[0, 0]                                  # [Bq, 1]
+    delta = delta_ref[0, 0]
     num_kb = seq_k // block_k
     if causal:
         num_kb = jnp.minimum(num_kb, ((iq + 1) * block_q + block_k - 1) // block_k)
@@ -183,8 +186,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         qb = q_ref[0, 0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
         dob = do_ref[0, 0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(iq * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(iq * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(iq * block_q, block_q), :]   # [Bq, 1]
+        delta = delta_ref[0, 0, pl.ds(iq * block_q, block_q), :]
         s = jax.lax.dot_general(qb * scale, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
         if causal:
@@ -216,7 +219,9 @@ def _bwd(causal, scale, block_q, block_k, residuals, g):
     bk = min(block_k, Tk)
     do = g
     # delta_i = rowsum(dO_i * O_i), the softmax-jacobian diagonal term
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # (kept 4-D [B, H, Tq, 1] for the same lane-tiling reason as lse)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                                   block_q=bq, block_k=bk, seq_k=Tk)
@@ -228,8 +233,8 @@ def _bwd(causal, scale, block_q, block_k, residuals, g):
             pl.BlockSpec((1, 1, Tk, Dh), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, Tk, Dh), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -246,8 +251,8 @@ def _bwd(causal, scale, block_q, block_k, residuals, g):
             pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, Tq, Dh), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
-            pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
+            pl.BlockSpec((1, 1, Tq, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq, 1), lambda b, h, j: (b, h, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j: (b, h, j, 0)),

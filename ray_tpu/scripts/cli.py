@@ -16,7 +16,9 @@ import subprocess
 import sys
 import time
 
-ADDR_FILE = "/tmp/ray_tpu/last_address"
+from ray_tpu.utils.platform import STATE_DIR
+
+ADDR_FILE = os.path.join(STATE_DIR, "last_address")
 
 
 def _save_address(addr: str) -> None:

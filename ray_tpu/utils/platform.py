@@ -36,3 +36,10 @@ def ensure_virtual_cpu(n_devices: int) -> None:
         raise RuntimeError(
             f"could not create {n_devices} virtual CPU devices (got {got}); "
             "set XLA_FLAGS=--xla_force_host_platform_device_count=N before jax init")
+
+
+# Root for all on-disk runtime state (job logs, runtime_env extractions,
+# spill files, CLI address file). Deliberately NOT "/tmp/ray_tpu": a dir
+# named like the package becomes an importable namespace package that
+# shadows the real library for any script run from /tmp.
+STATE_DIR = "/tmp/ray_tpu_state"
