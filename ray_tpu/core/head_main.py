@@ -16,6 +16,21 @@ from ray_tpu.core.gcs import Head
 
 
 async def amain(args) -> None:
+    if args.restore:
+        # a SIGKILLed predecessor leaves its shm arena behind; object data
+        # died with its owner processes, so clear it before re-creating
+        import glob
+
+        # two segment name schemes: rtpu_arena_{session[:16]} and
+        # per-object rtpu_{session[:8]}_... — the 8-char prefix
+        # matches both
+        for seg in glob.glob(f"/dev/shm/rtpu_*{args.session[:8]}*"):
+            try:
+                import os
+
+                os.unlink(seg)
+            except OSError:
+                pass
     head = Head(session=args.session, num_cpus=args.num_cpus,
                 resources=json.loads(args.resources) if args.resources else None,
                 num_tpu_chips=args.num_tpu_chips,
@@ -23,7 +38,13 @@ async def amain(args) -> None:
                 max_workers=args.max_workers,
                 labels=json.loads(args.labels) if args.labels else None)
     port = await head.start(port=args.port)
+    restored = head.restore_snapshot() if args.restore else False
+    if args.enable_snapshots:
+        asyncio.ensure_future(head._snapshot_loop())
+    # the head-port line must come first: init() parses it from stdout
     print(f"RAY_TPU_HEAD_PORT={port}", flush=True)
+    if args.restore:
+        print(f"RAY_TPU_RESTORED={int(restored)}", flush=True)
     ports = {"port": port}
     if not args.no_dashboard:
         try:
@@ -61,6 +82,10 @@ def main() -> None:
     p.add_argument("--labels", type=str, default=None)
     p.add_argument("--no-dashboard", action="store_true")
     p.add_argument("--port-file", type=str, default=None)
+    p.add_argument("--enable-snapshots", action="store_true",
+                   help="persist control-plane state for head restart")
+    p.add_argument("--restore", action="store_true",
+                   help="restore session state from a prior head snapshot")
     p.add_argument("--dashboard-port", type=int, default=0)
     args = p.parse_args()
     try:

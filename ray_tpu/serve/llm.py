@@ -37,10 +37,12 @@ class ByteTokenizer:
 
 class _Request:
     def __init__(self, prompt_ids: List[int], max_tokens: int,
-                 temperature: float):
+                 temperature: float, top_k: int = 0, top_p: float = 1.0):
         self.prompt_ids = prompt_ids
         self.max_tokens = max_tokens
         self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
         self.generated: List[int] = []
         self.done = threading.Event()
         self.error: Optional[str] = None
@@ -86,19 +88,23 @@ class LLMEngine:
     # ------------------------------------------------------------- public
     def generate(self, prompt: str = "", prompt_ids: Optional[List[int]] = None,
                  max_tokens: int = 16, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
                  timeout: float = 120.0) -> Dict[str, Any]:
         ids = prompt_ids if prompt_ids is not None else self.tokenizer.encode(prompt)
         ids = ids or [self.tokenizer.eos_id]
         ids = ids[-(self.max_seq_len - 2):]  # keep room to generate
         budget = self.max_seq_len - len(ids) - 1
-        req = _Request(ids, max(0, min(max_tokens, budget)), temperature)
+        req = _Request(ids, max(0, min(max_tokens, budget)), temperature,
+                       top_k=top_k, top_p=top_p)
         self._queue.put(req)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if req.error:
             raise RuntimeError(req.error)
         return {"token_ids": req.generated,
-                "text": self.tokenizer.decode(req.generated)}
+                "text": self.tokenizer.decode(req.generated),
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(req.generated)}
 
     def shutdown(self):
         self._stop.set()
@@ -150,8 +156,24 @@ class LLMEngine:
                         continue  # still prefilling; ignore logits
                 # sample the next token from this step's logits
                 if req.temperature > 0:
-                    p = np.exp((logits[i] - logits[i].max()) / req.temperature)
+                    lg = logits[i] / req.temperature
+                    if req.top_k and req.top_k < len(lg):
+                        kth = np.partition(lg, -req.top_k)[-req.top_k]
+                        lg = np.where(lg < kth, -np.inf, lg)
+                    p = np.exp(lg - lg.max())
                     p /= p.sum()
+                    if req.top_p < 1.0:
+                        order = np.argsort(p)[::-1]
+                        # standard nucleus: smallest set whose mass reaches
+                        # top_p — keep a token if the mass BEFORE it is
+                        # still short of the threshold (inclusive of the
+                        # one that crosses it)
+                        csum = np.cumsum(p[order])
+                        keep = (csum - p[order]) < req.top_p
+                        mask = np.zeros_like(p, bool)
+                        mask[order[keep]] = True
+                        p = np.where(mask, p, 0.0)
+                        p /= p.sum()
                     nxt = int(rng.choice(len(p), p=p))
                 else:
                     nxt = int(np.argmax(logits[i]))
@@ -196,6 +218,89 @@ class LLMServer:
     def check_health(self):
         if not self.engine._thread.is_alive():
             raise RuntimeError("engine loop died")
+
+
+class OpenAIServer(LLMServer):
+    """OpenAI-compatible API surface (reference: serve.llm router
+    `llm/_internal/serve/deployments/routers/router.py` — /v1/completions,
+    /v1/chat/completions, /v1/models). Mount with route_prefix="/v1"."""
+
+    def __init__(self, model_id: str = "ray-tpu-llm", **kwargs):
+        super().__init__(**kwargs)
+        self.model_id = model_id
+
+    def __call__(self, request: Any) -> dict:
+        path = getattr(request, "path", "/v1/completions")
+        if path.endswith("/models"):
+            return {"object": "list",
+                    "data": [{"id": self.model_id, "object": "model",
+                              "owned_by": "ray_tpu"}]}
+        body = getattr(request, "json", None) or {}
+        max_tokens = int(body.get("max_tokens", 16))
+        temperature = float(body.get("temperature", 1.0))
+        top_p = float(body.get("top_p", 1.0))
+        top_k = int(body.get("top_k", 0))
+        if path.endswith("/chat/completions"):
+            msgs = body.get("messages", [])
+            prompt = "".join(f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
+                             for m in msgs) + "<|assistant|>"
+            out = self.engine.generate(prompt=prompt, max_tokens=max_tokens,
+                                       temperature=temperature, top_k=top_k,
+                                       top_p=top_p)
+            finish = ("length" if out["completion_tokens"] >= max_tokens
+                      else "stop")
+            return {
+                "id": f"chatcmpl-{int(time.time() * 1e3)}",
+                "object": "chat.completion", "model": self.model_id,
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant",
+                                         "content": out["text"]},
+                             "finish_reason": finish}],
+                "usage": {"prompt_tokens": out["prompt_tokens"],
+                          "completion_tokens": out["completion_tokens"],
+                          "total_tokens": out["prompt_tokens"]
+                          + out["completion_tokens"]},
+            }
+        # /v1/completions
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        out = self.engine.generate(prompt=prompt, max_tokens=max_tokens,
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p)
+        finish = ("length" if out["completion_tokens"] >= max_tokens
+                  else "stop")
+        return {
+            "id": f"cmpl-{int(time.time() * 1e3)}",
+            "object": "text_completion", "model": self.model_id,
+            "choices": [{"index": 0, "text": out["text"],
+                         "finish_reason": finish}],
+            "usage": {"prompt_tokens": out["prompt_tokens"],
+                      "completion_tokens": out["completion_tokens"],
+                      "total_tokens": out["prompt_tokens"]
+                      + out["completion_tokens"]},
+        }
+
+
+def build_openai_app(preset: str = "gpt2-tiny", max_batch: int = 4,
+                     max_seq_len: int = 128, num_replicas: int = 1,
+                     model_id: str = "ray-tpu-llm",
+                     model_overrides: Optional[dict] = None,
+                     num_tpu_chips: int = 0):
+    """Deployment graph for an OpenAI-compatible server (reference
+    `ray.serve.llm.build_openai_app`); run with
+    `serve.run(app, route_prefix="/v1")`."""
+    from ray_tpu.serve.api import deployment
+
+    actor_options = {"num_cpus": 1}
+    if num_tpu_chips:
+        actor_options["num_tpu_chips"] = num_tpu_chips
+    dep = deployment(OpenAIServer, name=f"openai-{model_id}",
+                     num_replicas=num_replicas,
+                     ray_actor_options=actor_options,
+                     max_ongoing_requests=max_batch * 2)
+    return dep.bind(model_id=model_id, preset=preset, max_batch=max_batch,
+                    max_seq_len=max_seq_len, model_overrides=model_overrides)
 
 
 def build_llm_deployment(preset: str = "gpt2-tiny", max_batch: int = 4,

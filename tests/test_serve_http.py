@@ -108,3 +108,31 @@ def test_llm_deployment_generates(cluster):
     out = _post(f"http://127.0.0.1:{port}/v1/completions",
                 {"prompt": "hi", "max_tokens": 4})
     assert len(out["choices"][0]["token_ids"]) == 4
+
+
+def test_openai_compatible_api(cluster):
+    from ray_tpu.serve.llm import build_openai_app
+
+    app = build_openai_app(preset="gpt2-tiny", max_batch=2, max_seq_len=64,
+                           model_id="test-model")
+    serve.run(app, route_prefix="/v1")
+    port = serve.start()
+    base = f"http://127.0.0.1:{port}/v1"
+
+    models = _get(f"{base}/models")
+    assert models["data"][0]["id"] == "test-model"
+
+    out = _post(f"{base}/completions",
+                {"model": "test-model", "prompt": "hello", "max_tokens": 4,
+                 "temperature": 0.8, "top_k": 20, "top_p": 0.9})
+    assert out["object"] == "text_completion"
+    assert out["usage"]["completion_tokens"] >= 1
+    assert isinstance(out["choices"][0]["text"], str)
+
+    chat = _post(f"{base}/chat/completions",
+                 {"model": "test-model", "max_tokens": 4,
+                  "messages": [{"role": "user", "content": "hi"}]})
+    assert chat["object"] == "chat.completion"
+    assert chat["choices"][0]["message"]["role"] == "assistant"
+    assert chat["usage"]["total_tokens"] == (
+        chat["usage"]["prompt_tokens"] + chat["usage"]["completion_tokens"])
