@@ -195,8 +195,19 @@ class Head:
         self._spawned: Dict[int, subprocess.Popen] = {}
         # ring buffer of task lifecycle events (reference: task_event_buffer
         # → gcs_task_manager; feeds the state API + `timeline()`)
-        from collections import deque
+        from collections import OrderedDict, deque
         self.task_events: deque = deque(maxlen=20000)
+        # object lineage: return oid -> producing task spec, for
+        # reconstruction of lost objects (reference: TaskManager lineage +
+        # object_recovery_manager). Bounded FIFO.
+        self.lineage: "OrderedDict[ObjectID, dict]" = OrderedDict()
+        self.lineage_cap = int(os.environ.get("RAY_TPU_LINEAGE_CAP", "10000"))
+        # byte cap mirrors the reference's RAY_max_lineage_bytes: specs keep
+        # inline args alive, so count must not be the only bound
+        self.lineage_bytes_cap = int(os.environ.get(
+            "RAY_TPU_LINEAGE_BYTES", str(256 << 20)))
+        self.lineage_bytes = 0
+        self._reconstructing: Set[ObjectID] = set()
 
     def _task_event(self, task_id, name: str, state: str, *,
                     worker=None, node_id=None, error: str = None) -> None:
@@ -241,6 +252,20 @@ class Head:
         async def submit_task(spec):
             w = conn_state["worker"]
             rec = TaskRecord(spec, w)
+            if spec["options"].get("num_returns") != "streaming":
+                entry = {"spec": spec, "produced": set(),
+                         "recon_left": spec["options"].get("max_retries", 3),
+                         "bytes": self._spec_bytes(spec)}
+                for rid in spec["return_ids"]:
+                    old = self.lineage.pop(ObjectID(rid), None)
+                    if old is not None:
+                        self.lineage_bytes -= old["bytes"]
+                    self.lineage[ObjectID(rid)] = entry
+                    self.lineage_bytes += entry["bytes"]
+                while (len(self.lineage) > self.lineage_cap
+                       or self.lineage_bytes > self.lineage_bytes_cap):
+                    _, old = self.lineage.popitem(last=False)
+                    self.lineage_bytes -= old["bytes"]
             self._enqueue(rec)
             return True
 
@@ -303,6 +328,9 @@ class Head:
             return True
 
         async def put_meta(meta):
+            w = conn_state.get("worker")
+            if meta.node_id is None and w is not None:
+                meta.node_id = w.node_id  # locate for node-loss recovery
             self._seal(meta)
             return True
 
@@ -311,6 +339,7 @@ class Head:
             meta = self.objects.get(oid)
             if meta is not None:
                 return meta
+            self._maybe_reconstruct(oid)
             fut = asyncio.get_running_loop().create_future()
             self.object_waiters.setdefault(oid, []).append(fut)
             if timeout is None:
@@ -321,7 +350,12 @@ class Head:
                 return None
 
         async def wait_objects(object_ids, num_returns, timeout):
-            ids = [ObjectID(b) for b in object_ids]
+            object_ids = [ObjectID(b) if not isinstance(b, ObjectID) else b
+                          for b in object_ids]
+            for oid in object_ids:
+                if oid not in self.objects:
+                    self._maybe_reconstruct(oid)
+            ids = list(object_ids)
             num_returns = min(num_returns, len(ids))
             deadline = None if timeout is None else time.monotonic() + timeout
 
@@ -349,8 +383,13 @@ class Head:
             return ready()
 
         async def free_objects(object_ids):
-            for b in object_ids:
-                meta = self.objects.pop(ObjectID(b), None)
+            object_ids = [ObjectID(b) for b in object_ids]
+            for oid in object_ids:
+                old = self.lineage.pop(oid, None)
+                if old is not None:
+                    self.lineage_bytes -= old["bytes"]
+            for oid in object_ids:
+                meta = self.objects.pop(oid, None)
                 if meta is not None:
                     self.store.free(meta)
             return True
@@ -608,6 +647,7 @@ class Head:
         for dep in rec.spec.get("deps", []):
             oid = ObjectID(dep)
             if oid not in self.objects:
+                self._maybe_reconstruct(oid)
                 rec.pending_deps.add(oid)
                 self.dep_index.setdefault(oid, []).append(rec)
         self.queue.append(rec)
@@ -617,11 +657,20 @@ class Head:
         self._kick()
 
     def _seal(self, meta: ObjectMeta) -> None:
+        self._reconstructing.discard(meta.object_id)
+        lin = self.lineage.get(meta.object_id)
+        if lin is not None:
+            # per RETURN id: a sealed sibling must not mark this one
+            # reconstructable while its own seal is still in flight
+            lin["produced"].add(meta.object_id)
         existing = self.objects.get(meta.object_id)
         if existing is not None:
             # objects are immutable: first seal wins (a racing retry must not
-            # replace a good value, especially not with its own error)
-            self.store.free(meta)
+            # replace a good value, especially not with its own error).
+            # Arena entries are keyed by object id — the duplicate's storage
+            # IS the winner's entry, so freeing it would destroy the data.
+            if not (meta.kind == "arena" and existing.kind == "arena"):
+                self.store.free(meta)
             return
         self.objects[meta.object_id] = meta
         if meta.kind in ("shm", "arena"):
@@ -875,11 +924,75 @@ class Head:
             pass  # job cleanup: objects are session-scoped in round 1
         self._kick()
 
+    def _maybe_reconstruct(self, oid: ObjectID) -> None:
+        """Re-run the producing task of a lost object (lineage
+        reconstruction, reference `object_recovery_manager.cc`): first seal
+        wins, so racing consumers are safe."""
+        if oid in self.objects or oid in self._reconstructing:
+            return
+        entry = self.lineage.get(oid)
+        if entry is None or oid not in entry["produced"]:
+            # not produced yet → the original task is still in flight; a
+            # spurious resubmission here would race it (duplicate writes)
+            return
+        spec = entry["spec"]
+        if entry["recon_left"] <= 0:
+            # reconstruction budget exhausted (flapping node / poisoned
+            # task): fail consumers instead of resubmitting forever
+            self._seal_lost(oid, "object lost; reconstruction attempts "
+                                 "exhausted")
+            return
+        entry["recon_left"] -= 1
+        for rid in spec["return_ids"]:
+            self._reconstructing.add(ObjectID(rid))
+        self._task_event(spec["task_id"], spec["options"].get("name", "task"),
+                         "PENDING_RECONSTRUCTION")
+        self._enqueue(TaskRecord(spec, None))
+
+    @staticmethod
+    def _spec_bytes(spec: dict) -> int:
+        args = spec.get("args")
+        n = 256
+        if isinstance(args, (bytes, bytearray, memoryview)):
+            n += len(args)
+        elif isinstance(args, (list, tuple)):
+            n += sum(len(a) for a in args
+                     if isinstance(a, (bytes, bytearray, memoryview)))
+        return n
+
+    def _seal_lost(self, oid: ObjectID, cause: str) -> None:
+        """Seal an error object so parked and future consumers raise
+        ObjectLostError instead of hanging forever."""
+        from ray_tpu.core import serialization
+        from ray_tpu.core.exceptions import ObjectLostError
+
+        err = serialization.serialize(ObjectLostError(cause))
+        meta = ObjectMeta(oid, err.frame_bytes, "inline",
+                          inline=err.to_bytes(), error=True)
+        self._seal(meta)
+
     def _on_node_disconnect(self, node: NodeInfo) -> None:
         """Node daemon lost: the reference's GcsHealthCheckManager dead-node
         path (node table update + pubsub + per-worker failure handling)."""
         node.alive = False
         self.nodes.pop(node.node_id, None)
+        # objects whose data lived on that node are gone; drop their metas
+        # and lazily reconstruct from lineage when next requested (waiters
+        # already parked get kicked now)
+        lost = [oid for oid, m in self.objects.items()
+                if m.node_id == node.node_id and m.kind in ("shm", "arena")]
+        for oid in lost:
+            del self.objects[oid]
+            entry = self.lineage.get(oid)
+            if entry is None or oid not in entry["produced"]:
+                # no lineage (ray.put / evicted entry): cannot rebuild —
+                # mark lost now so parked AND future consumers raise
+                # ObjectLostError instead of hanging forever
+                self._seal_lost(
+                    oid, f"object {oid.hex()} lost with node "
+                         f"{node.node_id.hex()} and has no lineage")
+            elif oid in self.object_waiters:
+                self._maybe_reconstruct(oid)
         self._publish("node_state", {"node_id": node.node_id.binary(),
                                      "state": "DEAD"})
         # PG bundles on that node lose their reservation; re-reserve

@@ -141,7 +141,13 @@ class SharedMemoryStore:
             try:
                 buf = arena.create_buffer(oid, size)
             except ObjectExistsError:
-                # a dead retry may have left an unsealed entry; reclaim it
+                if arena.contains(oid):
+                    # a racing duplicate execution (retry/reconstruction)
+                    # already sealed this object — puts are idempotent by
+                    # object id; NEVER delete the winner's data
+                    return ObjectMeta(obj_id, size, "arena",
+                                      segment=arena.name)
+                # a dead attempt left an unsealed entry; reclaim it
                 arena.delete(oid, force=True)
                 buf = arena.create_buffer(oid, size)
             ser.write_into(buf)

@@ -1,0 +1,115 @@
+"""Lineage reconstruction: lost objects are rebuilt by re-running their
+producing task.
+
+Mirrors the reference's object recovery
+(`src/ray/core_worker/object_recovery_manager.cc` + TaskManager lineage):
+node dies → its objects' metas drop → a consumer get() triggers task
+resubmission; first-seal-wins makes racing consumers safe.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(num_cpus=1)
+    c.add_node(num_cpus=2, resources={"pin": 2})
+    c.connect()
+    c.wait_for_nodes(2)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote(resources={"pin": 1}, num_cpus=1)
+def produce(tag):
+    # large enough to live in shm (not inlined in the meta)
+    return np.full((256, 1024), tag, dtype=np.float32)
+
+
+def test_object_reconstructed_after_node_death(cluster):
+    ref = produce.remote(7)
+    first = ray_tpu.get(ref, timeout=60)
+    assert first[0, 0] == 7
+
+    # kill the node holding the object's data; meta is dropped on the head
+    cluster.kill_node(0)
+    time.sleep(1.0)
+    # bring back capacity with the pinned resource so the producing task can
+    # re-run somewhere (the reference reconstructs onto surviving nodes)
+    cluster.add_node(num_cpus=2, resources={"pin": 2})
+    cluster.wait_for_nodes(2)
+
+    again = ray_tpu.get(ref, timeout=120)
+    assert again.shape == (256, 1024) and again[0, 0] == 7
+
+
+def test_dependent_task_triggers_reconstruction(cluster):
+    ref = produce.remote(3)
+    ray_tpu.get(ref, timeout=60)
+    cluster.kill_node(0)
+    time.sleep(1.0)
+    cluster.add_node(num_cpus=2, resources={"pin": 2})
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return float(arr.sum())
+
+    # the dependency is lost; enqueue must reconstruct it first
+    out = ray_tpu.get(consume.remote(ref), timeout=120)
+    assert out == 3.0 * 256 * 1024
+
+
+def test_freed_objects_stay_freed(cluster):
+    ref = produce.remote(1)
+    ray_tpu.get(ref, timeout=60)
+    ray_tpu.free([ref])
+    time.sleep(0.5)
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=5)
+
+
+def test_lost_put_object_raises_not_hangs(cluster):
+    """ray.put objects have no lineage; losing their node must raise
+    ObjectLostError for parked waiters, never hang (regression)."""
+    import threading
+
+    from ray_tpu.core.exceptions import ObjectLostError
+
+    @ray_tpu.remote(resources={"pin": 1}, num_cpus=1)
+    class Holder:
+        def make(self):
+            import ray_tpu as rt
+
+            return rt.put(np.zeros((256, 1024), np.float32))
+
+    h = Holder.remote()
+    ref = ray_tpu.get(h.make.remote(), timeout=60)
+
+    got = {}
+
+    def getter():
+        try:
+            got["val"] = ray_tpu.get(ref, timeout=90)
+        except Exception as e:
+            got["err"] = e
+
+    # drop the only copy's metadata by killing the node, while a consumer
+    # is already parked waiting — but first drop local caches so the driver
+    # actually re-asks the head
+    client = ray_tpu.core.api._global_client()
+    client.local_metas.pop(ref.id, None)
+    cluster.kill_node(0)
+    time.sleep(1.0)
+    t = threading.Thread(target=getter)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), "get() hung on a lost, lineage-less object"
+    assert "err" in got and isinstance(got["err"], ObjectLostError), got
