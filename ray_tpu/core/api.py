@@ -204,6 +204,8 @@ class RemoteFunction:
         opts = dict(self._options)
         pg = opts.get("placement_group")
         num_returns = opts.get("num_returns", 1)
+        from ray_tpu.util import tracing
+
         task_opts = {"runtime_env": _package_renv_cached(
                          self, _global_client(), opts),
                      "resources": _build_resources(opts),
@@ -218,9 +220,13 @@ class RemoteFunction:
                      "label_selector": opts.get("label_selector"),
                      "scheduling_strategy": opts.get("scheduling_strategy", "hybrid"),
                      "name": opts.get("name") or getattr(self._fn, "__name__", "task")}
-        refs = _global_client().submit_task(
-            fn_key, args, kwargs, task_opts,
-            num_returns=1 if num_returns == "streaming" else num_returns)
+        with tracing.submit_span(task_opts["name"]):
+            # inject INSIDE the span so the worker's execution span parents
+            # to the submission span, not to its parent
+            task_opts["trace_ctx"] = tracing.inject_context()
+            refs = _global_client().submit_task(
+                fn_key, args, kwargs, task_opts,
+                num_returns=1 if num_returns == "streaming" else num_returns)
         if num_returns == "streaming":
             from ray_tpu.core.object_ref import ObjectRefGenerator
 

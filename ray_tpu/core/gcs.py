@@ -114,6 +114,7 @@ class TaskRecord:
         self.retries_left = spec["options"].get("max_retries", 3)
         self.pending_deps: Set[ObjectID] = set()
         self.cancelled = False
+        self.dispatch_ts: Optional[float] = None
 
 
 class GeneratorState:
@@ -208,6 +209,10 @@ class Head:
             "RAY_TPU_LINEAGE_BYTES", str(256 << 20)))
         self.lineage_bytes = 0
         self._reconstructing: Set[ObjectID] = set()
+        # produced objects lost to node death, awaiting lazy reconstruction;
+        # if their lineage entry gets cap-evicted meanwhile, consumers must
+        # get ObjectLostError, not an eternal hang
+        self._lost_pending: Set[ObjectID] = set()
 
     def _task_event(self, task_id, name: str, state: str, *,
                     worker=None, node_id=None, error: str = None) -> None:
@@ -657,6 +662,14 @@ class Head:
         self._kick()
 
     def _seal(self, meta: ObjectMeta) -> None:
+        if meta.kind in ("shm", "arena") and meta.node_id is not None:
+            n = self.nodes.get(meta.node_id)
+            if n is None or not n.alive:
+                # a stale meta re-registered by a caching client (e.g. the
+                # driver passing a ref onward): its data died with the
+                # node — sealing it would resurrect a dangling pointer and
+                # mask reconstruction
+                return
         self._reconstructing.discard(meta.object_id)
         lin = self.lineage.get(meta.object_id)
         if lin is not None:
@@ -800,6 +813,7 @@ class Head:
             self._acquire(w, resources)
         w.running_task = rec.task_id
         w.current_record = rec
+        rec.dispatch_ts = time.time()
         self._task_event(rec.task_id, rec.spec["options"].get("name", "task"),
                          "RUNNING", worker=w)
         w.conn.push("exec_task", spec=rec.spec)
@@ -931,10 +945,19 @@ class Head:
         if oid in self.objects or oid in self._reconstructing:
             return
         entry = self.lineage.get(oid)
-        if entry is None or oid not in entry["produced"]:
+        if entry is None:
+            if oid in self._lost_pending:
+                # lost with lineage, but the entry was cap-evicted before a
+                # consumer asked: fail loudly instead of hanging
+                self._lost_pending.discard(oid)
+                self._seal_lost(oid, "object lost and its lineage entry was "
+                                     "evicted before reconstruction")
+            return
+        if oid not in entry["produced"]:
             # not produced yet → the original task is still in flight; a
             # spurious resubmission here would race it (duplicate writes)
             return
+        self._lost_pending.discard(oid)
         spec = entry["spec"]
         if entry["recon_left"] <= 0:
             # reconstruction budget exhausted (flapping node / poisoned
@@ -982,7 +1005,14 @@ class Head:
         lost = [oid for oid, m in self.objects.items()
                 if m.node_id == node.node_id and m.kind in ("shm", "arena")]
         for oid in lost:
-            del self.objects[oid]
+            meta = self.objects.pop(oid)
+            try:
+                # unlink the dead copy's storage now: the meta is the only
+                # handle to the arena entry / shm segment, and nothing can
+                # free it once replaced by an error or a rebuilt copy
+                self.store.free(meta)
+            except Exception:
+                pass
             entry = self.lineage.get(oid)
             if entry is None or oid not in entry["produced"]:
                 # no lineage (ray.put / evicted entry): cannot rebuild —
@@ -993,6 +1023,8 @@ class Head:
                          f"{node.node_id.hex()} and has no lineage")
             elif oid in self.object_waiters:
                 self._maybe_reconstruct(oid)
+            else:
+                self._lost_pending.add(oid)
         self._publish("node_state", {"node_id": node.node_id.binary(),
                                      "state": "DEAD"})
         # PG bundles on that node lose their reservation; re-reserve

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 
 from ray_tpu.core.gcs import Head
@@ -26,8 +27,6 @@ async def amain(args) -> None:
         # matches both
         for seg in glob.glob(f"/dev/shm/rtpu_*{args.session[:8]}*"):
             try:
-                import os
-
                 os.unlink(seg)
             except OSError:
                 pass
@@ -41,6 +40,10 @@ async def amain(args) -> None:
     restored = head.restore_snapshot() if args.restore else False
     if args.enable_snapshots:
         asyncio.ensure_future(head._snapshot_loop())
+    if os.environ.get("RAY_TPU_MEMORY_MONITOR", "1") != "0":
+        from ray_tpu.core.memory_monitor import MemoryMonitor
+
+        asyncio.ensure_future(MemoryMonitor(head).run())
     # the head-port line must come first: init() parses it from stdout
     print(f"RAY_TPU_HEAD_PORT={port}", flush=True)
     if args.restore:
@@ -61,8 +64,6 @@ async def amain(args) -> None:
         tmp = args.port_file + ".tmp"
         with open(tmp, "w") as f:
             json.dump(ports, f)
-        import os
-
         os.replace(tmp, args.port_file)
     try:
         await asyncio.Event().wait()

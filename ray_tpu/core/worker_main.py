@@ -164,16 +164,24 @@ class WorkerRuntime:
                 applied = AppliedEnv(self.client, opts["runtime_env"])
             fn = self.client.fn_manager.load(spec["fn_key"])
             args, kwargs = self._resolve_args(spec["args"])
-            result = fn(*args, **kwargs)
-            if streaming:
-                self._drain_generator(return_ids[0], result, opts)
-            else:
-                results = [result] if len(return_ids) == 1 else list(result)
-                if len(results) != len(return_ids):
-                    raise ValueError(
-                        f"task returned {len(results)} values, expected {len(return_ids)}")
-                for rid, val in zip(return_ids, results):
-                    self.client.store_result(rid, val, register=True)
+            from ray_tpu.util import tracing
+
+            with tracing.execute_span(opts.get("name", "task"),
+                                      opts.get("trace_ctx")):
+                result = fn(*args, **kwargs)
+                if streaming:
+                    # generators do their real work during the drain — the
+                    # span must cover it, not just the immediate call
+                    self._drain_generator(return_ids[0], result, opts)
+                else:
+                    results = ([result] if len(return_ids) == 1
+                               else list(result))
+                    if len(results) != len(return_ids):
+                        raise ValueError(
+                            f"task returned {len(results)} values, "
+                            f"expected {len(return_ids)}")
+                    for rid, val in zip(return_ids, results):
+                        self.client.store_result(rid, val, register=True)
         except BaseException as e:  # noqa: BLE001 - all failures become error objects
             err = e if isinstance(e, TaskError) else TaskError(
                 repr(e), traceback.format_exc())

@@ -1,0 +1,145 @@
+"""Distributed tracing: spans around task submit/execute with W3C context
+propagation.
+
+Parity: `python/ray/util/tracing/tracing_helper.py` — the driver opens a
+submission span and injects a W3C `traceparent` into the task spec; the
+executing worker extracts it and opens a child execution span, so one trace
+follows a task across processes.
+
+This image ships only `opentelemetry-api` (no SDK), so the tracer here is
+self-contained: 128-bit trace ids, 64-bit span ids, W3C traceparent
+inject/extract, finished spans buffered in-process (drain with
+`get_finished_spans()` or hand them to any exporter object with an
+`export(spans)` method). When the OpenTelemetry SDK *is* installed, spans
+are mirrored through it automatically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import os
+import secrets
+import threading
+import time
+from typing import Dict, List, Optional
+
+_enabled = False
+_lock = threading.Lock()
+_finished: List["Span"] = []
+_exporter = None
+_current: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "ray_tpu_span", default=None)
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str            # 32 hex chars
+    span_id: str             # 16 hex chars
+    parent_id: Optional[str]
+    attributes: Dict[str, object]
+    start_ts: float = 0.0
+    end_ts: float = 0.0
+
+    @property
+    def duration_s(self) -> float:
+        return self.end_ts - self.start_ts
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+def enable_tracing(exporter=None) -> None:
+    """Turn tracing on (idempotent). `exporter`: optional object with
+    `.export(list_of_spans)` called at each span end."""
+    global _enabled, _exporter
+    _enabled = True
+    if exporter is not None:
+        _exporter = exporter
+
+
+def is_enabled() -> bool:
+    global _enabled
+    if not _enabled and os.environ.get("RAY_TPU_TRACING") == "1":
+        _enabled = True
+    return _enabled
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+def get_finished_spans(clear: bool = False) -> List[Span]:
+    with _lock:
+        out = list(_finished)
+        if clear:
+            _finished.clear()
+    return out
+
+
+@contextlib.contextmanager
+def start_span(name: str, *, carrier: Optional[Dict[str, str]] = None,
+               attributes: Optional[dict] = None):
+    """Open a span as current; parents to `carrier` (W3C traceparent dict)
+    if given, else to the current in-process span."""
+    if not is_enabled():
+        yield None
+        return
+    parent_trace = parent_span = None
+    if carrier and "traceparent" in carrier:
+        try:
+            _, parent_trace, parent_span, _ = carrier["traceparent"].split("-")
+        except ValueError:
+            parent_trace = None
+    if parent_trace is None:
+        cur = _current.get()
+        if cur is not None:
+            parent_trace, parent_span = cur.trace_id, cur.span_id
+    span = Span(name=name,
+                trace_id=parent_trace or secrets.token_hex(16),
+                span_id=secrets.token_hex(8),
+                parent_id=parent_span,
+                attributes=dict(attributes or {}),
+                start_ts=time.time())
+    token = _current.set(span)
+    try:
+        yield span
+    finally:
+        _current.reset(token)
+        span.end_ts = time.time()
+        with _lock:
+            _finished.append(span)
+            if len(_finished) > 10000:
+                del _finished[:5000]
+        if _exporter is not None:
+            try:
+                _exporter.export([span])
+            except Exception:
+                pass
+
+
+def inject_context() -> Optional[Dict[str, str]]:
+    """Current span context as a W3C carrier (rides in the task spec)."""
+    if not is_enabled():
+        return None
+    cur = _current.get()
+    if cur is None:
+        return None
+    return {"traceparent": cur.traceparent()}
+
+
+def submit_span(task_name: str):
+    if not is_enabled():
+        return contextlib.nullcontext()
+    return start_span(f"{task_name}.remote",
+                      attributes={"ray_tpu.op": "submit"})
+
+
+def execute_span(task_name: str, carrier: Optional[Dict[str, str]]):
+    if carrier is None or not is_enabled():
+        return contextlib.nullcontext()
+    return start_span(task_name, carrier=carrier,
+                      attributes={"ray_tpu.op": "execute",
+                                  "ray_tpu.pid": os.getpid()})

@@ -1,0 +1,70 @@
+"""Tracing tests: spans around submit/execute with cross-process context.
+
+Mirrors `python/ray/tests/test_tracing.py`: driver trace context propagates
+into the executing worker as one trace.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    os.environ["RAY_TPU_TRACING"] = "1"
+    info = ray_tpu.init(num_cpus=2, num_tpu_chips=0, max_workers=4)
+    yield info
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_TRACING", None)
+
+
+def test_trace_context_propagates_to_worker(cluster):
+    tracing.enable_tracing()
+
+    @ray_tpu.remote
+    def traced_task():
+        span = tracing.current_span()
+        return (span.trace_id, span.parent_id) if span else (None, None)
+
+    with tracing.start_span("driver-root") as root:
+        worker_trace_id, worker_parent = ray_tpu.get(traced_task.remote(),
+                                                     timeout=60)
+        driver_trace_id = root.trace_id
+
+    # one trace across processes: worker execution span shares the trace id
+    # and is parented to the driver's submission span
+    assert worker_trace_id == driver_trace_id
+    spans = tracing.get_finished_spans()
+    submit = [s for s in spans if s.name == "traced_task.remote"]
+    assert submit and submit[0].trace_id == driver_trace_id
+    assert worker_parent == submit[0].span_id
+    assert submit[0].duration_s >= 0
+
+
+def test_span_exporter(cluster):
+    class Sink:
+        def __init__(self):
+            self.spans = []
+
+        def export(self, spans):
+            self.spans.extend(spans)
+
+    sink = Sink()
+    tracing.enable_tracing(sink)
+    with tracing.start_span("op", attributes={"k": "v"}):
+        pass
+    assert sink.spans and sink.spans[-1].name == "op"
+    assert sink.spans[-1].attributes["k"] == "v"
+
+
+def test_traceparent_roundtrip():
+    tracing.enable_tracing()
+    with tracing.start_span("outer") as outer:
+        carrier = tracing.inject_context()
+    assert carrier["traceparent"].startswith("00-" + outer.trace_id)
+    with tracing.start_span("child", carrier=carrier) as child:
+        assert child.trace_id == outer.trace_id
+        assert child.parent_id == outer.span_id
