@@ -32,9 +32,11 @@ def system_memory_fraction() -> float:
                     break
     except OSError:
         return 0.0
-    if not total:
+    if not total or avail is None:
+        # missing MemAvailable must fail SAFE (0.0): treating it as 100%
+        # usage would kill one worker per poll interval forever
         return 0.0
-    return 1.0 - (avail or 0) / total
+    return 1.0 - avail / total
 
 
 def pick_victim(workers: List[dict]) -> Optional[dict]:

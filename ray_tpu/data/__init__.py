@@ -5,10 +5,11 @@ from ray_tpu.data.block import Block
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.read_api import (from_items, from_numpy, from_pandas, range,
                                    read_binary_files, read_csv, read_json,
-                                   read_numpy, read_parquet, read_text)
+                                   read_images, read_numpy, read_parquet,
+                                   read_text)
 
 __all__ = [
     "Block", "Dataset", "GroupedData", "range", "from_items", "from_numpy",
     "from_pandas", "read_parquet", "read_csv", "read_json", "read_text",
-    "read_binary_files", "read_numpy",
+    "read_binary_files", "read_numpy", "read_images",
 ]

@@ -148,3 +148,27 @@ def read_numpy(paths) -> Dataset:
         return {"data": arr}
 
     return Dataset([functools.partial(read_one, f) for f in files])
+
+
+IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".gif", ".bmp", ".webp",
+                    ".tif", ".tiff")
+
+
+def read_images(paths, *, size=None, mode: str = "RGB") -> Dataset:
+    """Image files → {"image": HWC uint8 array, "path": str} rows
+    (reference `ray.data.read_images`). `size=(h, w)` resizes. Directory
+    reads skip non-image files (READMEs, labels.csv, ...)."""
+    files = [f for f in _expand_paths(paths)
+             if f.lower().endswith(IMAGE_EXTENSIONS)]
+    if not files:
+        raise FileNotFoundError(f"no image files matched {paths}")
+
+    def read_one(path):
+        from PIL import Image
+
+        img = Image.open(path).convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))
+        return [{"image": np.asarray(img), "path": path}]
+
+    return Dataset([functools.partial(read_one, f) for f in files])

@@ -16,6 +16,9 @@ from ray_tpu.train.spmd import (
     compile_train,
     default_optimizer,
 )
+from ray_tpu.train.torch_trainer import (TorchBackend, TorchTrainer,
+                                         maybe_init_torch_distributed,
+                                         prepare_data_loader, prepare_model)
 from ray_tpu.train.trainer import (DataParallelTrainer, JaxBackend, JaxTrainer,
                                    Result, TrainingFailedError,
                                    maybe_init_jax_distributed)
@@ -25,6 +28,8 @@ __all__ = [
     "RunConfig", "ScalingConfig", "get_context", "get_dataset_shard",
     "report", "CompiledTrain", "TrainState", "compile_gpt2_train",
     "compile_train", "default_optimizer", "DataParallelTrainer", "JaxBackend",
-    "JaxTrainer", "Result", "TrainingFailedError",
+    "JaxTrainer", "Result", "TrainingFailedError", "TorchBackend",
+    "TorchTrainer", "maybe_init_torch_distributed", "prepare_data_loader",
+    "prepare_model",
     "maybe_init_jax_distributed",
 ]

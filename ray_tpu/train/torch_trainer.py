@@ -1,0 +1,113 @@
+"""TorchTrainer: torch DDP data-parallel training over the worker group.
+
+Parity: `python/ray/train/torch/` (TorchTrainer + `config.py:67
+_TorchBackend` + `train_loop_utils.py` prepare_model/prepare_data_loader) —
+the backend provisions a gloo process group across the gang (MASTER_ADDR/
+PORT + rank env vars, exactly the reference's setup_torch_process_group),
+and `prepare_model` wraps the module in DistributedDataParallel so gradient
+allreduce rides torch.distributed. On this framework CPU workers use gloo;
+the TPU path is JaxTrainer (SPMD), which is the recommended accelerator
+trainer here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ray_tpu.train.trainer import DataParallelTrainer, _free_port
+
+
+class TorchBackend:
+    """Env for `torch.distributed.init_process_group` on each worker."""
+
+    def __init__(self, backend: str = "gloo", timeout_s: float = 120.0):
+        self.backend = backend
+        self.timeout_s = timeout_s
+
+    def worker_envs(self, group) -> List[dict]:
+        n = len(group.workers)
+        if n == 1:
+            return [{}]  # single worker: no rendezvous (matches JaxBackend)
+        port = _free_port()
+        return [{
+            "MASTER_ADDR": "127.0.0.1",   # multi-host: rank-0 host address
+            "MASTER_PORT": str(port),
+            "RAY_TPU_TORCH_BACKEND": self.backend,
+            "RAY_TPU_TORCH_TIMEOUT_S": str(self.timeout_s),
+            "RANK": str(rank),
+            "WORLD_SIZE": str(n),
+            "LOCAL_RANK": "0",
+        } for rank in range(n)]
+
+
+def maybe_init_torch_distributed() -> bool:
+    """Join the gang's process group (call inside the train loop; no-op
+    outside a TorchTrainer worker or in single-worker groups)."""
+    if "RAY_TPU_TORCH_BACKEND" not in os.environ:
+        return False
+    import datetime
+
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return True
+    dist.init_process_group(
+        backend=os.environ["RAY_TPU_TORCH_BACKEND"],
+        rank=int(os.environ["RANK"]),
+        world_size=int(os.environ["WORLD_SIZE"]),
+        timeout=datetime.timedelta(seconds=float(
+            os.environ.get("RAY_TPU_TORCH_TIMEOUT_S", "120"))))
+    return True
+
+
+def prepare_model(model):
+    """Wrap in DDP when a process group is active (reference
+    `ray.train.torch.prepare_model`; device placement is CPU here)."""
+    maybe_init_torch_distributed()
+    import torch.distributed as dist
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Re-shard a DataLoader across the gang with DistributedSampler
+    (reference `ray.train.torch.prepare_data_loader`)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not dist.is_initialized() or dist.get_world_size() == 1:
+        return data_loader
+    if data_loader.batch_size is None:
+        # batch_sampler-driven loaders can't be mechanically resharded;
+        # leave them untouched rather than silently degrading to
+        # single-sample batches
+        return data_loader
+    from torch.utils.data import RandomSampler
+
+    shuffle = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset,
+                                 num_replicas=dist.get_world_size(),
+                                 rank=dist.get_rank(),
+                                 shuffle=shuffle)  # preserve eval determinism
+    return DataLoader(data_loader.dataset,
+                      batch_size=data_loader.batch_size,
+                      sampler=sampler,
+                      num_workers=0,
+                      collate_fn=data_loader.collate_fn,
+                      drop_last=data_loader.drop_last)
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DDP torch training over gang-scheduled workers (reference
+    `ray.train.torch.TorchTrainer`)."""
+
+    def __init__(self, *args, torch_config: Optional[TorchBackend] = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.backend = torch_config or TorchBackend()

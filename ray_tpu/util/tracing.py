@@ -138,8 +138,12 @@ def submit_span(task_name: str):
 
 
 def execute_span(task_name: str, carrier: Optional[Dict[str, str]]):
-    if carrier is None or not is_enabled():
+    if carrier is None:
         return contextlib.nullcontext()
+    # the presence of a carrier means the DRIVER has tracing on (maybe via
+    # enable_tracing(), not the env var) — enable here so the trace isn't a
+    # dangling submit span with no child
+    enable_tracing()
     return start_span(task_name, carrier=carrier,
                       attributes={"ray_tpu.op": "execute",
                                   "ray_tpu.pid": os.getpid()})

@@ -246,3 +246,17 @@ def test_zip_non_tabular_raises(cluster):
 
     with _pytest.raises(Exception, match="tabular"):
         rdata.from_items([1, 2, 3]).zip(rdata.from_items([4, 5, 6])).take_all()
+
+
+def test_read_images(cluster, tmp_path):
+    from PIL import Image
+
+    for i in range(3):
+        Image.new("RGB", (8 + i, 6), color=(i * 10, 0, 0)).save(
+            tmp_path / f"img{i}.png")
+    ds = rdata.read_images(str(tmp_path), size=(16, 16))
+    rows = ds.take_all()
+    assert len(rows) == 3
+    assert all(r["image"].shape == (16, 16, 3) for r in rows)
+    reds = sorted(int(r["image"][0, 0, 0]) for r in rows)
+    assert reds == [0, 10, 20]

@@ -1,0 +1,98 @@
+"""TorchTrainer DDP tests: gloo process group over the worker gang.
+
+Mirrors the reference's torch trainer tests
+(`python/ray/train/v2/tests/test_torch_trainer.py` style): 2-worker DDP on
+CPU, gradient sync verified by weight agreement, loss decreases.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (RunConfig, ScalingConfig, TorchTrainer,
+                           prepare_model, session)
+from ray_tpu.train.config import ScalingConfig as SC
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4, num_tpu_chips=0, max_workers=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _train_loop(config):
+    import torch
+    import torch.distributed as dist
+    import torch.nn as nn
+
+    from ray_tpu.train import session as sess
+    from ray_tpu.train.torch_trainer import (maybe_init_torch_distributed,
+                                             prepare_model)
+
+    maybe_init_torch_distributed()
+    torch.manual_seed(0)
+    model = prepare_model(nn.Linear(4, 1))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    gen = torch.Generator().manual_seed(dist.get_rank())
+    losses = []
+    for step in range(config["steps"]):
+        x = torch.randn(16, 4, generator=gen)
+        y = x.sum(dim=1, keepdim=True)
+        loss = ((model(x) - y) ** 2).mean()
+        opt.zero_grad()
+        loss.backward()   # DDP allreduces grads here
+        opt.step()
+        losses.append(float(loss))
+    w = [p.detach().clone() for p in model.parameters()]
+    sess.report({"first_loss": losses[0], "last_loss": losses[-1],
+                 "w0": float(w[0].sum()), "rank": dist.get_rank(),
+                 "world": dist.get_world_size()})
+
+
+def test_torch_trainer_ddp(cluster):
+    trainer = TorchTrainer(
+        _train_loop, train_loop_config={"steps": 30},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="torch-ddp"))
+    result = trainer.fit()
+    m = result.metrics
+    assert m["world"] == 2
+    assert m["last_loss"] < m["first_loss"] * 0.5, m
+
+
+def test_torch_trainer_weights_synced(cluster):
+    """Both ranks see different data but identical weights after DDP —
+    the gradient allreduce is real."""
+    def loop(config=None):
+        import torch
+        import torch.distributed as dist
+        import torch.nn as nn
+
+        from ray_tpu.train import session as sess
+        from ray_tpu.train.torch_trainer import (
+            maybe_init_torch_distributed, prepare_model)
+
+        maybe_init_torch_distributed()
+        torch.manual_seed(0)
+        model = prepare_model(nn.Linear(3, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        gen = torch.Generator().manual_seed(100 + dist.get_rank())
+        for _ in range(10):
+            x = torch.randn(8, 3, generator=gen)
+            loss = (model(x) ** 2).mean()
+            opt.zero_grad(); loss.backward(); opt.step()
+        flat = torch.cat([p.detach().flatten()
+                          for p in model.parameters()])
+        gathered = [torch.zeros_like(flat) for _ in range(dist.get_world_size())]
+        dist.all_gather(gathered, flat)
+        synced = all(torch.allclose(gathered[0], g) for g in gathered)
+        sess.report({"wsum": float(flat.sum()), "synced": bool(synced),
+                     "rank": dist.get_rank()})
+
+    trainer = TorchTrainer(loop, scaling_config=ScalingConfig(num_workers=2),
+                           run_config=RunConfig(name="torch-sync"))
+    result = trainer.fit()
+    # each rank saw DIFFERENT data; identical weights on all ranks proves
+    # DDP's gradient allreduce actually ran
+    assert result.metrics["synced"] is True, result.metrics
