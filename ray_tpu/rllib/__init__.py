@@ -10,6 +10,7 @@ replaces the reference's torch-DDP learner group).
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.env.envs import (Box, CartPole, Discrete, Env, Pendulum,
@@ -19,7 +20,7 @@ from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 from ray_tpu.rllib.core.rl_module import ModuleSpec, RLModule, spec_from_env
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "Box", "CartPole", "Discrete", "Env", "Pendulum",
     "VectorEnv", "make_env", "register_env", "SingleAgentEnvRunner",
     "EnvRunnerGroup", "ModuleSpec", "RLModule", "spec_from_env",

@@ -1,0 +1,104 @@
+"""BC: behavior cloning from offline data.
+
+Parity: `rllib/algorithms/bc/` (+ the offline-data pipeline in
+`rllib/offline/`) — supervised imitation of logged actions. Offline input:
+a `ray_tpu.data.Dataset` (columns `obs`, `actions`) or a dict of arrays;
+the dataset path streams batches through the data library's executor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+
+
+class BCLearner(JaxLearner):
+    def __init__(self, spec, cfg: "BCConfig", mesh=None):
+        self.cfg = cfg
+        super().__init__(spec, lr=cfg.lr, grad_clip=cfg.grad_clip,
+                         seed=cfg.seed, mesh=mesh)
+
+    def loss(self, params, batch, rng) -> Tuple[jnp.ndarray, dict]:
+        dist = self.module.dist(params, batch["obs"])
+        logp = dist.log_prob(batch["actions"])
+        nll = -logp.mean()
+        return nll, {"bc_nll": nll}
+
+
+class BC(Algorithm):
+    def _build_learner(self, mesh):
+        c = self.config
+        data = c.offline_data
+        if data is None:
+            raise ValueError("BCConfig.offline(offline_data=...) is required")
+        if isinstance(data, dict):
+            self._obs = np.asarray(data["obs"], np.float32)
+            self._acts = np.asarray(data["actions"])
+        else:  # ray_tpu.data.Dataset
+            obs, acts = [], []
+            for b in data.iter_batches(batch_size=4096):
+                obs.append(np.asarray(b["obs"], np.float32))
+                acts.append(np.asarray(b["actions"]))
+            if not obs:
+                raise ValueError("offline dataset is empty")
+            self._obs = np.concatenate(obs)
+            self._acts = np.concatenate(acts)
+        if len(self._obs) == 0:
+            raise ValueError("offline dataset is empty")
+        if not self.module_spec.discrete:
+            # logged actions are in ENV space; the module (and the env
+            # runner, which multiplies by action_scale on the way out)
+            # work in module space [-1, 1]
+            self._acts = self._acts / self.module_spec.action_scale
+        self._rng = np.random.default_rng(c.seed)
+        return BCLearner(self.module_spec, c, mesh=mesh)
+
+    def training_step(self) -> dict:
+        c = self.config
+        n = len(self._obs)
+        bs = min(c.train_batch_size, n)
+        metrics = {}
+        for _ in range(c.num_updates_per_iteration):
+            idx = self._rng.integers(0, n, size=bs)
+            metrics = self.learner.update({"obs": self._obs[idx],
+                                           "actions": self._acts[idx]})
+        self._timesteps += c.num_updates_per_iteration * bs
+        return metrics
+
+
+class BCConfig(AlgorithmConfig):
+    algo_class = BC
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_updates_per_iteration = 64
+        self.offline_data = None
+
+    def offline(self, *, offline_data=None):
+        """Reference parity: `.offline_data(input_=...)`."""
+        if offline_data is not None:
+            self.offline_data = offline_data
+        return self
+
+    def __deepcopy__(self, memo):
+        # build()/as_trainable deepcopy configs; cloning gigabytes of
+        # offline arrays per trial would double peak RAM — share them
+        import copy
+
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == "offline_data":
+                new.offline_data = v
+            else:
+                setattr(new, k, copy.deepcopy(v, memo))
+        return new
