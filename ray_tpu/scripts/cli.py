@@ -208,6 +208,23 @@ def cmd_job(args) -> None:
         print("stopped" if client.stop_job(args.job_id) else "not running")
 
 
+def cmd_serve(args) -> None:
+    _connect(args)
+    from ray_tpu import serve as serve_api
+
+    if args.serve_cmd == "deploy":
+        from ray_tpu.serve.build_app import deploy_config_file
+
+        names = deploy_config_file(args.config_file)
+        port = serve_api.start()
+        print(f"deployed {', '.join(names)}; http on 127.0.0.1:{port}")
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve_api.status(), indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve_api.shutdown()
+        print("serve shut down")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray-tpu",
                                 description="TPU-native distributed runtime")
@@ -242,6 +259,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("serve")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    sd = ssub.add_parser("deploy")
+    sd.add_argument("config_file")
+    sd.add_argument("--address", default=None)
+    sd.set_defaults(fn=cmd_serve)
+    for name in ("status", "shutdown"):
+        sd = ssub.add_parser(name)
+        sd.add_argument("--address", default=None)
+        sd.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("job")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

@@ -1,0 +1,73 @@
+"""Declarative serve deploy from config (reference `serve deploy` schema).
+
+Own file/cluster: the app module must be importable cluster-wide, so it goes
+on sys.path BEFORE init (the driver's import roots ship to workers at
+registration — same-machine runtime-env lite).
+"""
+
+import json
+import sys
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_declarative_config_deploy(tmp_path):
+    mod = tmp_path / "my_serve_app.py"
+    mod.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "class Echo:\n"
+        "    def __init__(self, prefix='e'):\n"
+        "        self.prefix = prefix\n"
+        "    def __call__(self, request):\n"
+        "        return {'echo': self.prefix + str(request.get('v', ''))}\n"
+        "app = Echo.bind()\n"
+        "def builder(prefix='b'):\n"
+        "    return Echo.bind(prefix=prefix)\n")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=8)
+        from ray_tpu.serve.build_app import deploy_config, deploy_config_file
+
+        names = deploy_config({"applications": [
+            {"name": "echo-app", "route_prefix": "/echo",
+             "import_path": "my_serve_app:app",
+             "deployments": [{"name": "Echo", "num_replicas": 2}]},
+            {"name": "built-app", "route_prefix": "/built",
+             "import_path": "my_serve_app:builder",
+             "args": {"prefix": "custom-"}},
+        ]})
+        assert names == ["echo-app", "built-app"]
+        port = serve.start()
+        out = _post(f"http://127.0.0.1:{port}/echo", {"v": "x"})
+        assert out == {"echo": "ex"}
+        out = _post(f"http://127.0.0.1:{port}/built", {"v": "y"})
+        assert out == {"echo": "custom-y"}
+
+        # YAML file path (the `ray-tpu serve deploy` input format)
+        yml = tmp_path / "serve.yaml"
+        yml.write_text(
+            "applications:\n"
+            "  - name: yaml-app\n"
+            "    route_prefix: /yml\n"
+            "    import_path: my_serve_app:builder\n"
+            "    args: {prefix: 'yml-'}\n")
+        assert deploy_config_file(str(yml)) == ["yaml-app"]
+        out = _post(f"http://127.0.0.1:{port}/yml", {"v": "z"})
+        assert out == {"echo": "yml-z"}
+    finally:
+        sys.path.remove(str(tmp_path))
+        serve.shutdown()
+        ray_tpu.shutdown()

@@ -136,3 +136,12 @@ def test_openai_compatible_api(cluster):
     assert chat["choices"][0]["message"]["role"] == "assistant"
     assert chat["usage"]["total_tokens"] == (
         chat["usage"]["prompt_tokens"] + chat["usage"]["completion_tokens"])
+
+
+def test_check_open_ports(cluster):
+    from ray_tpu.util.check_open_ports import check_open_ports
+
+    report = check_open_ports()
+    # everything this framework opens binds to 127.0.0.1
+    assert report["open_to_network"] == [], report
+    assert report["loopback_only"], report
