@@ -146,6 +146,22 @@ def shutdown() -> None:
     from ray_tpu.core.api import get_actor
 
     try:
+        grpc_proxy = get_actor("serve-grpc-proxy")
+    except Exception:
+        grpc_proxy = None
+    if grpc_proxy is not None:
+        try:
+            ray_tpu.get(grpc_proxy.stop.remote(), timeout=10)
+        except Exception:
+            pass
+        finally:
+            # a detached proxy surviving here would hand later start_grpc()
+            # callers a server wired to a dead controller
+            try:
+                ray_tpu.kill(grpc_proxy)
+            except Exception:
+                pass
+    try:
         controller = get_actor(CONTROLLER_NAME)
     except (ValueError, RuntimeError):
         return

@@ -15,7 +15,7 @@ from ray_tpu import serve
 
 @pytest.fixture(scope="module")
 def cluster():
-    info = ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=8)
+    info = ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
     yield info
     serve.shutdown()
     ray_tpu.shutdown()
@@ -145,3 +145,28 @@ def test_check_open_ports(cluster):
     # everything this framework opens binds to 127.0.0.1
     assert report["open_to_network"] == [], report
     assert report["loopback_only"], report
+
+
+def test_grpc_ingress(cluster):
+    """gRPC proxy: JSON-over-gRPC generic method routed to a deployment."""
+    import grpc
+
+    from ray_tpu.serve.grpc_proxy import SERVICE, start_grpc
+
+    @serve.deployment
+    class GEcho:
+        def __call__(self, request):
+            return {"got": request.get("q"), "method": request.method}
+
+    serve.run(GEcho.bind(), route_prefix="/")
+    port = start_grpc()
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = channel.unary_unary(
+        f"/{SERVICE}/Call",
+        request_serializer=None, response_deserializer=None)
+    reply = call(json.dumps({"q": "hello"}).encode(),
+                 metadata=(("application", "GEcho"),), timeout=60)
+    out = json.loads(reply)
+    assert out == {"got": "hello", "method": "GRPC"}
+    channel.close()
