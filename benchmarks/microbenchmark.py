@@ -1,0 +1,153 @@
+"""Control-plane microbenchmarks, reference-comparable.
+
+Parity: `release/microbenchmark/run_microbenchmark.py` — emits the same
+metric names as the reference's `release/perf_metrics/microbenchmark.json`
+(SURVEY §6 / BASELINE.md) so the two control planes compare line by line:
+
+  1_1_actor_calls_sync        (ref: 2,012/s on m5.16xlarge)
+  1_1_actor_calls_async       (ref: 8,664/s)
+  n_n_actor_calls_async       (ref: 27,376/s)
+  single_client_tasks_sync    (ref: 981/s)
+  multi_client_tasks_async    (ref: 21,230/s)
+  single_client_put_gigabytes (ref: 19.9 GB/s)
+  placement_group_create/removal (ref: 765/s)
+
+Run: `python benchmarks/microbenchmark.py [--out results.json]`
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# runnable from anywhere: `python benchmarks/microbenchmark.py`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, warmup: int = 1, repeat: int = 3) -> float:
+    """Runs/sec of fn() (fn reports its own unit count via return value)."""
+    for _ in range(warmup):
+        fn()
+    rates = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        n = fn()
+        rates.append(n / (time.perf_counter() - t0))
+    return float(np.mean(rates))
+
+
+def main(out_path: str | None = None) -> dict:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=12)
+    results = {}
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return b"ok"
+
+        async def aping(self):
+            return b"ok"
+
+    @ray_tpu.remote
+    def noop():
+        return b"ok"
+
+    # ---- 1:1 sync actor calls
+    a = Sink.remote()
+    ray_tpu.get(a.ping.remote())
+
+    def sync_calls(n=500):
+        for _ in range(n):
+            ray_tpu.get(a.ping.remote())
+        return n
+
+    results["1_1_actor_calls_sync"] = timeit(sync_calls)
+
+    # ---- 1:1 async actor calls (pipelined submissions, one batch get)
+    def async_calls(n=2000):
+        ray_tpu.get([a.ping.remote() for _ in range(n)])
+        return n
+
+    results["1_1_actor_calls_async"] = timeit(async_calls)
+
+    # ---- n:n async actor calls (8 actors, pipelined)
+    actors = [Sink.options(max_concurrency=4).remote() for _ in range(8)]
+    ray_tpu.get([x.ping.remote() for x in actors])
+
+    def nn_calls(n=4000):
+        refs = [actors[i % 8].ping.remote() for i in range(n)]
+        ray_tpu.get(refs)
+        return n
+
+    results["n_n_actor_calls_async"] = timeit(nn_calls)
+
+    # ---- single-client tasks sync
+    ray_tpu.get(noop.remote())
+
+    def tasks_sync(n=200):
+        for _ in range(n):
+            ray_tpu.get(noop.remote())
+        return n
+
+    results["single_client_tasks_sync"] = timeit(tasks_sync)
+
+    # ---- single-client tasks async (pipelined)
+    def tasks_async(n=2000):
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return n
+
+    results["multi_client_tasks_async"] = timeit(tasks_async)
+
+    # ---- put throughput (1 GiB in 64 MiB objects)
+    blob = np.random.default_rng(0).bytes(64 << 20)
+
+    def put_gb(n=16):
+        refs = [ray_tpu.put(blob) for _ in range(n)]
+        ray_tpu.free(refs)
+        return n * len(blob) / 1e9
+
+    results["single_client_put_gigabytes"] = timeit(put_gb, warmup=1, repeat=2)
+
+    # ---- placement group create/remove
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    def pg_cycle(n=50):
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1}], strategy="PACK")
+            pg.ready(timeout=10)
+            remove_placement_group(pg)
+        return n
+
+    results["placement_group_create/removal"] = timeit(pg_cycle, warmup=0,
+                                                       repeat=2)
+
+    ray_tpu.shutdown()
+    report = {"metrics": {k: round(v, 1) for k, v in results.items()},
+              "unit": "ops/s (put: GB/s)",
+              "reference": {  # m5.16xlarge numbers from BASELINE.md §6
+                  "1_1_actor_calls_sync": 2012,
+                  "1_1_actor_calls_async": 8664,
+                  "n_n_actor_calls_async": 27376,
+                  "single_client_tasks_sync": 981,
+                  "multi_client_tasks_async": 21230,
+                  "single_client_put_gigabytes": 19.9,
+                  "placement_group_create/removal": 765}}
+    print(json.dumps(report, indent=2))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+    main(args.out)

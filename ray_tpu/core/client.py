@@ -10,6 +10,7 @@ background thread; the public API is synchronous (like `ray.get`).
 from __future__ import annotations
 
 import asyncio
+import functools
 import os
 import threading
 import time
@@ -345,7 +346,14 @@ class CoreClient:
         spec = {"task_id": task_id, "fn_key": fn_key, "args": payload,
                 "deps": deps, "return_ids": [o.binary() for o in return_ids],
                 "options": options}
-        self._call(self.conn.request("submit_task", spec=spec))
+        # fire-and-forget: return ids are client-generated, so no reply is
+        # needed — a blocking round trip here caps pipelined submission at
+        # ~500 tasks/s; a push lets the socket batch thousands/s (head-side
+        # submission failures seal error objects on the return ids)
+        if self.conn.closed:
+            raise protocol.ConnectionLost("head connection closed")
+        self.loop.call_soon_threadsafe(
+            functools.partial(self.conn.push, "submit_task", spec=spec))
         return [ObjectRef(o) for o in return_ids]
 
     # -------------------------------------------------------------- actors

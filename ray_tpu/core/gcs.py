@@ -25,6 +25,7 @@ import os
 import subprocess
 import sys
 import time
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core import protocol
@@ -117,6 +118,91 @@ class TaskRecord:
         self.dispatch_ts: Optional[float] = None
 
 
+class TaskQueue:
+    """Pending tasks bucketed by scheduling shape (resources + selector +
+    PG + strategy). Identical shapes get identical placement verdicts while
+    cluster state is unchanged, so the dispatcher stops scanning a bucket at
+    its first non-dispatchable record — the reference ClusterTaskManager's
+    per-class queueing, without which a deep queue makes every scheduling
+    event O(queue) and pipelined submission collapses."""
+
+    def __init__(self):
+        self._shapes: "OrderedDict[tuple, deque]" = OrderedDict()
+        self._len = 0
+
+    @staticmethod
+    def shape_of(rec: "TaskRecord") -> tuple:
+        o = rec.spec["options"]
+        sel = o.get("label_selector")
+        sel_key = (tuple(sorted(
+            (k, tuple(v) if isinstance(v, (list, tuple, set)) else str(v))
+            for k, v in sel.items())) if sel else None)
+        # same normalization as _try_dispatch: an EXPLICIT resources={} is a
+        # zero-resource task and must not share a bucket with CPU:1 defaults
+        res = o.get("resources", {"CPU": 1})
+        return (tuple(sorted(res.items())), sel_key,
+                o.get("placement_group"),
+                o.get("placement_group_bundle_index"),
+                o.get("scheduling_strategy", "hybrid"))
+
+    def append(self, rec: "TaskRecord") -> None:
+        key = self.shape_of(rec)
+        dq = self._shapes.get(key)
+        if dq is None:
+            dq = self._shapes[key] = deque()
+        dq.append(rec)
+        self._len += 1
+
+    def scan(self, dispatch) -> None:
+        """One scheduling pass: per bucket, dispatch ready records until the
+        first non-dispatchable one (same shape ⇒ same verdict until cluster
+        state changes). `dispatch(rec, remaining)` returns None on success,
+        else a block reason. Owns all length bookkeeping."""
+        for key in list(self._shapes.keys()):
+            dq = self._shapes.get(key)
+            if dq is None:
+                continue
+            kept: deque = deque()   # dep-waiting records stepped over
+            while dq:
+                rec = dq[0]
+                if rec.pending_deps:
+                    kept.append(dq.popleft())
+                    continue
+                if dispatch(rec, len(dq)) is None:
+                    dq.popleft()
+                    self._len -= 1
+                else:
+                    break
+            if kept:
+                kept.extend(dq)
+                self._shapes[key] = kept
+            elif not dq:
+                self._shapes.pop(key, None)
+
+    def remove(self, rec: "TaskRecord") -> None:
+        key = self.shape_of(rec)
+        dq = self._shapes.get(key)
+        if dq is None:
+            return
+        try:
+            dq.remove(rec)
+            self._len -= 1
+        except ValueError:
+            pass
+        if not dq:
+            del self._shapes[key]
+
+    def __iter__(self):
+        for dq in list(self._shapes.values()):
+            yield from list(dq)
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __bool__(self) -> bool:
+        return self._len > 0
+
+
 class GeneratorState:
     """Streaming-generator bookkeeping (reference: dynamic return refs +
     `_generator_backpressure_num_objects`, SURVEY §2.12b)."""
@@ -184,7 +270,7 @@ class Head:
         self.object_waiters: Dict[ObjectID, List[asyncio.Future]] = {}
         self.kv: Dict[Tuple[str, bytes], bytes] = {}
         self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
-        self.queue: List[TaskRecord] = []
+        self.queue = TaskQueue()
         self.dep_index: Dict[ObjectID, List[TaskRecord]] = {}
         self.generators: Dict[bytes, GeneratorState] = {}
         self.subscribers: Dict[str, List[protocol.Connection]] = {}
@@ -604,7 +690,7 @@ class Head:
             one (reference CancelTask; force kills the worker)."""
             for rec in list(self.queue):
                 if return_id in rec.spec["return_ids"]:
-                    self.queue.remove(rec)
+                    self.queue.remove(rec)  # shape-bucket removal
                     rec.cancelled = True
                     self._fail_task(rec, "task was cancelled", cancelled=True)
                     return "cancelled_queued"
@@ -779,7 +865,8 @@ class Head:
             w.acquired_pg = None
             w.acquired_bundle = None
 
-    def _try_dispatch(self, rec: TaskRecord) -> Optional[str]:
+    def _try_dispatch(self, rec: TaskRecord,
+                      want_workers: int = 1) -> Optional[str]:
         """Try to place+dispatch one task. Returns None on success, else a
         reason to stay queued ('resources' | 'worker') — or fails the task."""
         options = rec.spec["options"]
@@ -798,7 +885,8 @@ class Head:
                 return "resources"
             w = self._idle_worker_on(node)
             if w is None:
-                self._request_worker(node)
+                for _ in range(max(1, want_workers)):
+                    self._request_worker(node)  # self-caps at max_workers
                 return "worker"
             self._acquire(w, resources, pg, bundle)
         else:
@@ -808,7 +896,8 @@ class Head:
                 return "resources"
             w = self._idle_worker_on(node)
             if w is None:
-                self._request_worker(node)
+                for _ in range(max(1, want_workers)):
+                    self._request_worker(node)  # self-caps at max_workers
                 return "worker"
             self._acquire(w, resources)
         w.running_task = rec.task_id
@@ -820,21 +909,31 @@ class Head:
         return None
 
     def _kick(self) -> None:
-        """Dispatch as many queued tasks as possible; spawn workers if useful."""
+        """Dispatch as many queued tasks as possible; spawn workers if useful.
+
+        Re-entrancy-safe: dispatch failure paths (_fail_task → _seal) call
+        _kick again; a nested call mutating the deques mid-scan would make
+        outer frames pop records the nested pass already handled. Nested
+        calls just set a flag and the outermost frame loops."""
         if self._shutdown:
             return
-        self._retry_pending_pgs()
-        still_queued: List[TaskRecord] = []
-        for rec in self.queue:
-            if rec.pending_deps:
-                still_queued.append(rec)
-                continue
-            if self._try_dispatch(rec) is not None:
-                still_queued.append(rec)
-        self.queue = still_queued
-        for info in self.actors.values():
-            if info.state in ("PENDING", "RESTARTING") and info.worker is None:
-                self._schedule_actor(info)
+        if getattr(self, "_kick_active", False):
+            self._kick_again = True
+            return
+        self._kick_active = True
+        try:
+            while True:
+                self._kick_again = False
+                self._retry_pending_pgs()
+                self.queue.scan(self._try_dispatch)
+                for info in self.actors.values():
+                    if (info.state in ("PENDING", "RESTARTING")
+                            and info.worker is None):
+                        self._schedule_actor(info)
+                if not self._kick_again:
+                    break
+        finally:
+            self._kick_active = False
         self._spawn_for_demand()
 
     def _schedule_actor(self, info: ActorInfo) -> None:

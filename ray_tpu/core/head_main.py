@@ -72,6 +72,22 @@ async def amain(args) -> None:
 
 
 def main() -> None:
+    prof_path = os.environ.get("RAY_TPU_HEAD_PROFILE")
+    if prof_path:
+        import cProfile
+        import signal as _signal
+
+        prof = cProfile.Profile()
+        prof.enable()
+
+        def _dump(_sig, _frm):
+            # disable→dump→enable: create_stats() alone permanently stops
+            # collection, making repeated snapshots silently stale
+            prof.disable()
+            prof.dump_stats(prof_path)
+            prof.enable()
+
+        _signal.signal(_signal.SIGUSR1, _dump)
     p = argparse.ArgumentParser()
     p.add_argument("--session", required=True)
     p.add_argument("--port", type=int, default=0)

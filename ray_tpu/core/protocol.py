@@ -58,6 +58,19 @@ async def read_frame(reader: asyncio.StreamReader) -> Any:
     return pickle.loads(payload)
 
 
+def _set_nodelay(writer) -> None:
+    """Small request/reply frames + Nagle's algorithm = ~40ms stalls per
+    round trip; every control-plane socket must be TCP_NODELAY."""
+    import socket as _socket
+
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
 def write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
     payload = pickle.dumps(msg, protocol=5)
     writer.write(len(payload).to_bytes(8, "little") + payload)
@@ -177,6 +190,7 @@ class Connection:
 
 async def connect(host: str, port: int, handlers=None, name: str = "?") -> Connection:
     reader, writer = await asyncio.open_connection(host, port)
+    _set_nodelay(writer)
     conn = Connection(reader, writer, handlers, name=name)
     conn.start()
     return conn
@@ -203,7 +217,11 @@ class Server:
                 self.on_connect(conn)
             conn.start()
 
-        self._server = await asyncio.start_server(handle, host, port)
+        def handle_nodelay(r, w):
+            _set_nodelay(w)
+            return handle(r, w)
+
+        self._server = await asyncio.start_server(handle_nodelay, host, port)
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
