@@ -77,14 +77,29 @@ def main(out_path: str | None = None) -> dict:
 
     results["1_1_actor_calls_async"] = timeit(async_calls)
 
-    # ---- n:n async actor calls (8 actors, pipelined)
-    actors = [Sink.options(max_concurrency=4).remote() for _ in range(8)]
-    ray_tpu.get([x.ping.remote() for x in actors])
+    # ---- n:n async actor calls: n CALLER actors each hammering its own
+    # sink over direct worker-to-worker connections (the reference's n:n is
+    # n client processes, not one driver loop)
+    sinks = [Sink.options(max_concurrency=4).remote() for _ in range(4)]
+    ray_tpu.get([x.ping.remote() for x in sinks])
 
-    def nn_calls(n=4000):
-        refs = [actors[i % 8].ping.remote() for i in range(n)]
-        ray_tpu.get(refs)
-        return n
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, sink):
+            self.sink = sink
+
+        def hammer(self, n):
+            import ray_tpu as rt
+
+            rt.get([self.sink.ping.remote() for _ in range(n)])
+            return n
+
+    callers = [Caller.remote(s_) for s_ in sinks]
+    ray_tpu.get([c.hammer.remote(10) for c in callers])
+
+    def nn_calls(n=1500):
+        ray_tpu.get([c.hammer.remote(n) for c in callers])
+        return n * len(callers)
 
     results["n_n_actor_calls_async"] = timeit(nn_calls)
 
