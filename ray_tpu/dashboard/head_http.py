@@ -19,19 +19,30 @@ _INDEX_HTML = """<!doctype html>
 td,th{border:1px solid #ccc;padding:4px 8px;text-align:left}</style></head>
 <body><h2>ray_tpu cluster</h2><div id="out">loading…</div>
 <script>
+// user-controlled strings (entrypoints, actor names) must never reach
+// innerHTML raw — that's script injection into every dashboard viewer
+function esc(v){ const d = document.createElement('div');
+  d.textContent = String(v ?? ''); return d.innerHTML; }
 async function refresh(){
-  const c = await (await fetch('/api/cluster')).json();
-  const n = await (await fetch('/api/nodes')).json();
-  const a = await (await fetch('/api/summary')).json();
-  let h = `<p>session <b>${c.session}</b> · uptime ${c.uptime.toFixed(0)}s ·
+  const [c, n, a, act, jobs] = await Promise.all(
+    ['/api/cluster', '/api/nodes', '/api/summary', '/api/actors?limit=50',
+     '/api/jobs/'].map(u => fetch(u).then(r => r.json())));
+  let h = `<p>session <b>${esc(c.session)}</b> · uptime ${c.uptime.toFixed(0)}s ·
     ${c.num_nodes} nodes · ${c.num_workers} workers</p>`;
   h += '<h3>resources</h3><table><tr><th>resource</th><th>avail</th><th>total</th></tr>';
   for (const k of Object.keys(c.total_resources))
-    h += `<tr><td>${k}</td><td>${c.available_resources[k]??0}</td><td>${c.total_resources[k]}</td></tr>`;
-  h += '</table><h3>tasks</h3><pre>' + JSON.stringify(a.tasks, null, 1) + '</pre>';
-  h += '<h3>actors</h3><pre>' + JSON.stringify(a.actors, null, 1) + '</pre>';
+    h += `<tr><td>${esc(k)}</td><td>${c.available_resources[k]??0}</td><td>${c.total_resources[k]}</td></tr>`;
+  h += '</table><h3>tasks</h3><pre>' + esc(JSON.stringify(a.tasks, null, 1)) + '</pre>';
   h += '<h3>nodes</h3><table><tr><th>node</th><th>alive</th><th>head</th><th>resources</th></tr>';
-  for (const x of n) h += `<tr><td>${x.node_id.slice(0,12)}</td><td>${x.alive}</td><td>${x.is_head}</td><td>${JSON.stringify(x.resources)}</td></tr>`;
+  for (const x of n) h += `<tr><td>${esc(x.node_id.slice(0,12))}</td><td>${x.alive}</td><td>${x.is_head}</td><td>${esc(JSON.stringify(x.resources))}</td></tr>`;
+  h += '</table>';
+  h += '<h3>actors</h3><table><tr><th>actor</th><th>state</th><th>name</th><th>restarts left</th></tr>';
+  for (const x of act)
+    h += `<tr><td>${esc(x.actor_id.slice(0,12))}</td><td>${esc(x.state)}</td><td>${esc(x.name)}</td><td>${x.restarts_left}</td></tr>`;
+  h += '</table>';
+  h += '<h3>jobs</h3><table><tr><th>job</th><th>status</th><th>entrypoint</th></tr>';
+  for (const j of jobs.slice(0, 50))
+    h += `<tr><td>${esc(j.job_id)}</td><td>${esc(j.status)}</td><td>${esc(j.entrypoint.slice(0, 60))}</td></tr>`;
   h += '</table>';
   document.getElementById('out').innerHTML = h;
 }
