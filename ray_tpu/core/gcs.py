@@ -498,6 +498,8 @@ class Head:
             return self.kv.get((ns, key))
 
         async def kv_del(ns, key):
+            if ns == "_runtime_env":
+                self._drop_runtime_env_blob_file(key)
             return self.kv.pop((ns, key), None) is not None
 
         async def kv_keys(ns, prefix):
@@ -1292,9 +1294,14 @@ class Head:
         if getattr(self, "job_manager", None) is not None:
             jobs = {j["job_id"]: j for j in self.job_manager.list()
                     if j["status"] in ("SUCCEEDED", "FAILED", "STOPPED")}
+        # _runtime_env blobs (up to GiBs of content-addressed zips) are
+        # immutable: persist each once as its own file instead of
+        # re-pickling them into every 2 s snapshot cycle.
+        self._persist_runtime_env_blobs()
         snap = {
             "session": self.session,
-            "kv": {k: v for k, v in self.kv.items() if k[0] != "_metrics"},
+            "kv": {k: v for k, v in self.kv.items()
+                   if k[0] not in ("_metrics", "_runtime_env")},
             "detached_actors": detached,
             "named_actors": {ns_name: a.binary() for ns_name, a in
                              self.named_actors.items()},
@@ -1305,6 +1312,62 @@ class Head:
             "job_counter": self.job_counter,
         }
         self._write_snapshot(snap)
+
+    def _persist_runtime_env_blobs(self) -> None:
+        """Write each content-addressed _runtime_env blob to its own file
+        under <state>/<session>/runtime_env/ exactly once (they never
+        change), so snapshots stay small and fast."""
+        blobs = [(k, v) for k, v in self.kv.items() if k[0] == "_runtime_env"]
+        if not blobs:
+            return
+        # NB: dedicated subdir — STATE_DIR/<session>/runtime_env/ is where
+        # workers EXTRACT packages (runtime_env.py _fetch_extract); mixing
+        # the head's blob mirror into it would make restore trip over
+        # extraction directories.
+        d = os.path.join(os.path.dirname(self.snapshot_path()),
+                         "runtime_env_blobs")
+        os.makedirs(d, exist_ok=True)
+        for (_, key), value in blobs:
+            if not isinstance(key, bytes):
+                continue  # internal producers always use bytes keys; a
+                # str key is untrusted client input — never a filename
+            path = os.path.join(d, key.hex())
+            if os.path.exists(path):
+                continue
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(value if isinstance(value, bytes) else bytes(value))
+            os.replace(tmp, path)
+
+    def _restore_runtime_env_blobs(self) -> None:
+        d = os.path.join(os.path.dirname(self.snapshot_path()),
+                         "runtime_env_blobs")
+        if not os.path.isdir(d):
+            return
+        # oldest-first (mtime) so the repopulated KV keeps the
+        # insertion-order-is-age property _bound_runtime_env_cache evicts by
+        def _mtime(n):
+            try:
+                return os.path.getmtime(os.path.join(d, n))
+            except OSError:
+                return 0.0
+
+        for name in sorted(os.listdir(d), key=_mtime):
+            path = os.path.join(d, name)
+            if name.endswith(".tmp") or not os.path.isfile(path):
+                continue
+            try:
+                # keys in this namespace are always bytes (uri.encode());
+                # skip anything that isn't our own hex naming
+                key = bytes.fromhex(name)
+            except ValueError:
+                continue
+            if ("_runtime_env", key) in self.kv:
+                continue
+            with open(path, "rb") as f:
+                self.kv[("_runtime_env", key)] = f.read()
+        # the cap is normally enforced on kv_put; re-apply after bulk load
+        self._bound_runtime_env_cache(0)
 
     def _write_snapshot(self, snap: dict) -> None:
         import pickle
@@ -1327,6 +1390,7 @@ class Head:
         with open(path, "rb") as f:
             snap = pickle.load(f)
         self.kv.update(snap["kv"])
+        self._restore_runtime_env_blobs()
         self.job_counter = snap.get("job_counter", 0)
         # PGs first: restored actors may be bound to a PG bundle — without
         # the PG entry, _schedule_actor would mark them DEAD on arrival
@@ -1392,7 +1456,22 @@ class Head:
             if total <= cap:
                 break
             del self.kv[k]
+            self._drop_runtime_env_blob_file(k[1])
             total -= len(v)
+
+    def _drop_runtime_env_blob_file(self, key) -> None:
+        """Keep the on-disk blob mirror in lockstep with KV eviction —
+        otherwise restore resurrects evicted packages and disk grows
+        unboundedly across the session."""
+        if not isinstance(key, bytes):
+            return  # hex() naming only ever mirrors bytes keys; a str key
+            # must not become a path component (traversal risk)
+        path = os.path.join(os.path.dirname(self.snapshot_path()),
+                            "runtime_env_blobs", key.hex())
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
     def _list_state(self, kind: str):
         if kind == "actors":

@@ -73,8 +73,14 @@ class MemoryMonitor:
         usage = self.usage_fn()
         if usage < self.threshold:
             return None
+        # The usage sample is this (head) node's /proc/meminfo: only workers
+        # co-resident on the sampled node are valid victims — killing a
+        # remote worker frees nothing here and starves real OOM detection
+        # on worker nodes (reference runs the monitor per-raylet).
         views = []
         for w in self.head.workers.values():
+            if w.node_id != self.head.node_id:
+                continue
             rec = getattr(w, "current_record", None)
             views.append({
                 "worker_id": w.worker_id,

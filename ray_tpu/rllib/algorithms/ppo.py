@@ -36,6 +36,11 @@ def compute_gae(rewards, values, dones, last_values, gamma, lam):
     return advs, advs + values
 
 
+# Module-level jit so the traced/compiled GAE is cached across training
+# steps instead of re-wrapped (and re-traced) inside every training_step.
+_jitted_gae = jax.jit(compute_gae, static_argnums=(4, 5))
+
+
 class PPOLearner(JaxLearner):
     def __init__(self, spec, cfg: "PPOConfig", mesh=None):
         self.cfg = cfg
@@ -86,7 +91,7 @@ class PPO(Algorithm):
         # done — an exact rewrite of the truncation-aware GAE recursion
         boot = cat["truncateds"] & ~cat["terminateds"]
         rewards = cat["rewards"] + c.gamma * cat["final_values"] * boot
-        advs, targets = jax.jit(compute_gae, static_argnums=(4, 5))(
+        advs, targets = _jitted_gae(
             rewards, cat["values"], cat["dones"].astype(np.float32),
             last_v, c.gamma, c.lambda_)
         T, N = cat["rewards"].shape

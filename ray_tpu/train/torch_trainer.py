@@ -15,7 +15,7 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
-from ray_tpu.train.trainer import DataParallelTrainer, _free_port
+from ray_tpu.train.trainer import DataParallelTrainer
 
 
 class TorchBackend:
@@ -29,9 +29,17 @@ class TorchBackend:
         n = len(group.workers)
         if n == 1:
             return [{}]  # single worker: no rendezvous (matches JaxBackend)
-        port = _free_port()
+        # Rank 0's reachable host and a port probed free on rank 0's node —
+        # a hardcoded 127.0.0.1 would make non-rank-0 hosts rendezvous with
+        # themselves and hang in init_process_group until the timeout, and
+        # a controller-probed port may be taken on rank 0's machine.
+        # timeout matches the 120 s gang-placement barrier in start().
+        import ray_tpu
+
+        master_addr, port = ray_tpu.get(
+            group.workers[0].rendezvous_info.remote(), timeout=120)
         return [{
-            "MASTER_ADDR": "127.0.0.1",   # multi-host: rank-0 host address
+            "MASTER_ADDR": master_addr,
             "MASTER_PORT": str(port),
             "RAY_TPU_TORCH_BACKEND": self.backend,
             "RAY_TPU_TORCH_TIMEOUT_S": str(self.timeout_s),

@@ -11,7 +11,6 @@ coordinator env vars for `jax.distributed.initialize`.
 from __future__ import annotations
 
 import dataclasses
-import socket
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
@@ -52,8 +51,12 @@ class JaxBackend:
                    else n > 1)
         if not enabled:
             return [{} for _ in range(n)]
-        port = _free_port()
-        coordinator = f"127.0.0.1:{port}"  # multi-host: head host address
+        # Coordinator = rank 0's reachable address with a port probed free
+        # on rank 0's own host (a loopback/controller-probed pair would
+        # make non-rank-0 hosts of a multi-host gang connect to themselves).
+        host, port = ray_tpu.get(
+            group.workers[0].rendezvous_info.remote(), timeout=120)
+        coordinator = f"{host}:{port}"
         return [{
             "RAY_TPU_JAX_COORDINATOR": coordinator,
             "RAY_TPU_JAX_NUM_PROCESSES": str(n),
@@ -75,14 +78,6 @@ def maybe_init_jax_distributed() -> None:
         coordinator_address=coord,
         num_processes=int(os.environ["RAY_TPU_JAX_NUM_PROCESSES"]),
         process_id=int(os.environ["RAY_TPU_JAX_PROCESS_ID"]))
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 class DataParallelTrainer:

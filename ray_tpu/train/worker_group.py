@@ -76,6 +76,37 @@ class TrainWorker:
     def node_id(self):
         return ray_tpu.get_runtime_context().node_id.hex()
 
+    def node_ip(self):
+        """IP other gang members can reach this worker's host on (used by
+        backends that rendezvous on rank 0, e.g. torch MASTER_ADDR)."""
+        import os
+        import socket
+
+        # Route toward the head when it is remote; head-spawned workers
+        # have no RAY_TPU_HEAD_HOST (loopback), so fall back to the primary
+        # outbound interface (UDP connect sends no packets).
+        for target in (os.environ.get("RAY_TPU_HEAD_HOST"), "8.8.8.8"):
+            if not target or target.startswith("127."):
+                continue
+            try:
+                with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                    s.connect((target, 1))
+                    return s.getsockname()[0]
+            except OSError:
+                continue
+        return "127.0.0.1"
+
+    def rendezvous_info(self):
+        """(reachable_ip, free_port) probed on THIS host — rendezvous ports
+        must be chosen where they will actually be bound (rank 0's node),
+        not on the controller."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        return self.node_ip(), port
+
     def shutdown_worker(self):
         return True
 

@@ -130,8 +130,14 @@ class Pool:
         return self._actors[i]
 
     def _track(self, refs):
-        self._inflight = [r for r in self._inflight
-                          if ray_tpu.wait([r], timeout=0)[1]]
+        # A single batched wait() per submission — per-ref wait calls were
+        # O(inflight) control-plane round trips (quadratic over a large
+        # map()), while deferring pruning would pin completed results.
+        if self._inflight:
+            _, pending = ray_tpu.wait(self._inflight,
+                                      num_returns=len(self._inflight),
+                                      timeout=0)
+            self._inflight = list(pending)
         self._inflight.extend(refs)
         return refs
 
