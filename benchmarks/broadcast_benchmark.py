@@ -1,0 +1,81 @@
+"""Object-broadcast benchmark over the cross-node data plane.
+
+Reference counterpart: `release/perf_metrics/scalability/object_store.json`
+("1 GiB broadcast to 50 nodes: 17.3 s" — one producer, every node pulls the
+object through the object manager). Here: one driver put of SIZE bytes,
+N isolated nodes each pull it through their node data server (store
+isolation forces real chunked transfer even on one machine).
+
+Run: `python benchmarks/broadcast_benchmark.py [--nodes 4] [--mb 1024]`
+Emits one JSON line: {"metric": "broadcast_gib_per_node_s", ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--mb", type=int, default=1024)
+    p.add_argument("--out", type=str, default=None)
+    args = p.parse_args()
+
+    os.environ["RAY_TPU_STORE_ISOLATION"] = "1"
+    # the pulled copy must fit the per-process pull cache
+    os.environ.setdefault("RAY_TPU_PULL_CACHE_BYTES", str(4 << 30))
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(num_cpus=0, object_store_bytes=2 << 30)
+    for i in range(args.nodes):
+        c.add_node(num_cpus=2, resources={f"node{i}": 8})
+    c.connect()
+    c.wait_for_nodes(args.nodes + 1)
+
+    @ray_tpu.remote
+    def consume(arr):
+        # force a full read of the pulled copy
+        return int(arr[:: 1024 * 1024].sum())
+
+    data = np.ones((args.mb << 20,), dtype=np.uint8)
+    ref = ray_tpu.put(data)
+    expect = int(data[:: 1024 * 1024].sum())
+
+    t0 = time.perf_counter()
+    outs = ray_tpu.get(
+        [consume.options(resources={f"node{i}": 1}).remote(ref)
+         for i in range(args.nodes)],
+        timeout=600)
+    elapsed = time.perf_counter() - t0
+    assert all(o == expect for o in outs), outs
+
+    gib = args.mb / 1024
+    result = {
+        "metric": "broadcast_gib_to_nodes_s",
+        "value": round(elapsed, 3),
+        "unit": f"s ({gib:g} GiB x {args.nodes} nodes)",
+        "per_node_gib_s": round(gib * args.nodes / elapsed, 3),
+        "vs_baseline_50node": round(17.3 / (elapsed / args.nodes * 50), 3),
+    }
+    print(json.dumps(result), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f)
+
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+if __name__ == "__main__":
+    main()

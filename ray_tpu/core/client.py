@@ -16,11 +16,13 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ray_tpu.core import protocol, serialization
+from collections import OrderedDict
+
+from ray_tpu.core import object_transfer, protocol, serialization
 from ray_tpu.core.exceptions import (ActorDiedError, GetTimeoutError,
                                      ObjectLostError, RayTpuError)
 from ray_tpu.core.function_manager import FunctionManager
-from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.store import INLINE_THRESHOLD, ObjectMeta, SharedMemoryStore
 from ray_tpu.core.serialization import SerializedObject
@@ -59,6 +61,17 @@ class CoreClient:
         self._started = threading.Event()
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
+        self.node_id: Optional[NodeID] = None
+        # cross-node pull machinery (loop-confined): data-server conns,
+        # in-flight pull dedup, LRU-bounded cache of pulled copies
+        self._data_conns: Dict[Tuple[str, int], protocol.Connection] = {}
+        self._pull_tasks: Dict[ObjectID, asyncio.Task] = {}
+        self._pull_sem: Optional[asyncio.Semaphore] = None
+        self._pulled: "OrderedDict[ObjectID, ObjectMeta]" = OrderedDict()
+        self._pulled_lock = threading.Lock()  # loop inserts, user threads free
+        self._pulled_bytes = 0
+        self._pull_cache_cap = int(os.environ.get(
+            "RAY_TPU_PULL_CACHE_BYTES", str(1 << 30)))
         self.on_disconnect = None
         # invoked synchronously inside the start coroutine, right after the
         # head acks registration and before any pushed task handler can run
@@ -78,7 +91,8 @@ class CoreClient:
 
     async def _start_async(self, direct_handlers: dict) -> None:
         self.direct_server = protocol.Server(direct_handlers, name="direct")
-        self.direct_port = await self.direct_server.start()
+        self.direct_port = await self.direct_server.start(
+            host=os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"))
         self.conn = await protocol.connect(self.head_host, self.head_port,
                                            handlers=self._extra_handlers,
                                            name="head")
@@ -88,6 +102,14 @@ class CoreClient:
             "register_worker", worker_id=self.worker_id.binary(), pid=os.getpid(),
             port=self.direct_port, is_driver=self.is_driver,
             node_id=bytes.fromhex(node_id_hex) if node_id_hex else None)
+        self.node_id = NodeID(self.node_info["node_id"])
+        if (self.store.isolated and not self.store.namespace
+                and not os.environ.get("RAY_TPU_STORE_NAMESPACE")):
+            # isolation mode: our namespace is our node's — knowable only
+            # after registration (no objects have been stored yet)
+            self.store = SharedMemoryStore(
+                self.session, capacity_bytes=1 << 62,
+                namespace=self.node_id.hex()[:8])
         if self.on_registered is not None:
             self.on_registered(self.node_info)
         if self.is_driver:
@@ -112,6 +134,8 @@ class CoreClient:
                 await self.conn.close()
             for c in self._direct.values():
                 await c.close()
+            for c in self._data_conns.values():
+                await c.close()
             if self.direct_server:
                 await self.direct_server.stop()
 
@@ -135,6 +159,7 @@ class CoreClient:
         oid = ObjectID.generate()
         ser = serialization.serialize(value)
         meta = self.store.put_serialized(oid, ser)
+        meta.node_id = self.node_id
         self.local_metas[oid] = meta
         self._register_meta(meta)
         return ObjectRef(oid)
@@ -144,6 +169,7 @@ class CoreClient:
         oid = ObjectID.generate()
         meta = self.store.put_serialized(oid, ser)
         meta.error = error
+        meta.node_id = self.node_id
         self.local_metas[oid] = meta
         if register:
             self._register_meta(meta)
@@ -154,6 +180,9 @@ class CoreClient:
         ser = serialization.serialize(value)
         meta = self.store.put_serialized(oid, ser)
         meta.error = is_error
+        # node-stamped so a cross-node consumer of an UNregistered meta
+        # (direct actor reply) can still find our node's data server
+        meta.node_id = self.node_id
         self.local_metas[oid] = meta
         if register:
             self._register_meta(meta)
@@ -180,22 +209,142 @@ class CoreClient:
         self.local_metas[meta.object_id] = meta
         return ObjectRef(meta.object_id)
 
-    def _read_value(self, meta: ObjectMeta) -> Any:
+    def read_serialized(self, meta: ObjectMeta) -> SerializedObject:
+        """Serialized bytes of `meta`, pulling from the owner node when the
+        object isn't local (sync; called from user threads)."""
         try:
-            ser = self.store.get_serialized(meta)
+            return self.store.get_serialized(meta)
         except FileNotFoundError:
-            # our cached meta is stale: the head spilled (or moved) the object
-            # after we fetched the meta — refresh and retry once
-            fresh = self._call(self.conn.request(
-                "get_meta", object_id=meta.object_id.binary(), timeout=5))
-            if fresh is None:
-                from ray_tpu.core.exceptions import ObjectLostError
+            pass
+        # retry: a resolved cached copy can be evicted by a concurrent
+        # pull's cache trim between resolve and read — re-resolve re-pulls
+        for attempt in range(3):
+            local = self._call(self._resolve_readable(meta))
+            try:
+                return self.store.get_serialized(local)
+            except FileNotFoundError:
+                self._drop_pulled(meta.object_id)
+        raise ObjectLostError(f"object {meta.object_id} vanished during read")
 
-                raise ObjectLostError(f"object {meta.object_id} is gone")
-            self.local_metas[meta.object_id] = fresh
-            ser = self.store.get_serialized(fresh)
-        value = serialization.deserialize(ser)
-        return value
+    async def read_serialized_async(self, meta: ObjectMeta) -> SerializedObject:
+        """Event-loop-safe variant (sync one would deadlock on the loop)."""
+        try:
+            return self.store.get_serialized(meta)
+        except FileNotFoundError:
+            pass
+        for attempt in range(3):
+            local = await self._resolve_readable(meta)
+            try:
+                return self.store.get_serialized(local)
+            except FileNotFoundError:
+                self._drop_pulled(meta.object_id)
+        raise ObjectLostError(f"object {meta.object_id} vanished during read")
+
+    def _drop_pulled(self, oid: ObjectID) -> None:
+        with self._pulled_lock:
+            stale = self._pulled.pop(oid, None)
+            if stale is not None:
+                self._pulled_bytes -= stale.size
+
+    async def _resolve_readable(self, meta: ObjectMeta) -> ObjectMeta:
+        """Produce a locally-readable meta for an object we can't read:
+        stale meta (spilled/moved) or an object living on another node.
+        Runs on the loop; concurrent requests for one object share a pull."""
+        oid = meta.object_id
+        task = self._pull_tasks.get(oid)
+        if task is None:
+            task = asyncio.ensure_future(self._locate_or_pull(meta))
+            self._pull_tasks[oid] = task
+            task.add_done_callback(
+                lambda t, o=oid: self._pull_tasks.pop(o, None))
+        return await asyncio.shield(task)
+
+    async def _locate_or_pull(self, meta: ObjectMeta) -> ObjectMeta:
+        oid = meta.object_id
+        with self._pulled_lock:
+            cached = self._pulled.get(oid)
+            if cached is not None:
+                self._pulled.move_to_end(oid)
+        if cached is not None:
+            return cached
+        # fast path: the meta names its node (always true for results) —
+        # go straight to that node's data server, skipping the directory
+        if meta.node_id is not None and meta.kind in ("shm", "arena", "spilled"):
+            addr = await self.conn.request(
+                "node_data_addr", node_id=meta.node_id.binary())
+            if addr is not None:
+                try:
+                    return await self._pull_from(tuple(addr), meta)
+                except (protocol.RpcError, OSError, FileNotFoundError):
+                    pass  # node lost / object moved: consult the directory
+        # directory path: refreshed meta + current location from the head
+        rep = await self.conn.request(
+            "locate_object", object_id=oid.binary(), timeout=30)
+        if rep is None:
+            raise ObjectLostError(f"object {oid} is gone")
+        fresh, addr = rep["meta"], rep["data_addr"]
+        self.local_metas[oid] = fresh
+        try:
+            view, rel = self.store.get_raw(fresh, 0, 0)  # probe readability
+            view.release()
+            if rel is not None:
+                rel()
+            return fresh
+        except FileNotFoundError:
+            pass
+        if addr is not None:
+            try:
+                return await self._pull_from(tuple(addr), fresh)
+            except (protocol.RpcError, OSError, FileNotFoundError) as e:
+                raise ObjectLostError(
+                    f"object {oid} unreachable on {addr}: {e!r}") from e
+        raise ObjectLostError(f"object {oid} has no reachable location")
+
+    async def _pull_from(self, addr, meta: ObjectMeta) -> ObjectMeta:
+        host, port = addr
+        if host is None:
+            host = self.head_host  # head-node objects: reuse our head route
+        key = (host, port)
+        conn = self._data_conns.get(key)
+        if conn is None or conn.closed:
+            conn = await protocol.connect(host, port, name=f"data-{port}")
+            self._data_conns[key] = conn
+        if self._pull_sem is None:
+            self._pull_sem = asyncio.Semaphore(int(os.environ.get(
+                "RAY_TPU_MAX_CONCURRENT_PULLS", "4")))
+        async with self._pull_sem:  # pull admission control
+            local = await object_transfer.pull_object(conn, meta, self.store)
+        self._note_pulled(local)
+        return local
+
+    def _note_pulled(self, local: ObjectMeta) -> None:
+        """LRU cache of pulled copies, bounded by RAY_TPU_PULL_CACHE_BYTES —
+        evicted copies are unlinked (they are ours, unlike canonical
+        objects, which only their owner node frees)."""
+        evicted = []
+        with self._pulled_lock:
+            old = self._pulled.pop(local.object_id, None)
+            if old is not None:
+                self._pulled_bytes -= old.size
+            self._pulled[local.object_id] = local
+            self._pulled_bytes += local.size
+            while (self._pulled_bytes > self._pull_cache_cap
+                   and len(self._pulled) > 1):
+                _, evict = self._pulled.popitem(last=False)
+                self._pulled_bytes -= evict.size
+                evicted.append(evict)
+        for evict in evicted:
+            try:
+                self.store.free(evict)
+            except Exception:
+                pass
+
+    def _read_value(self, meta: ObjectMeta) -> Any:
+        return serialization.deserialize(self.read_serialized(meta))
+
+    async def _read_value_async(self, meta: ObjectMeta) -> Any:
+        return serialization.deserialize(
+            await self.read_serialized_async(meta))
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -238,7 +387,7 @@ class CoreClient:
                     meta = await self.conn.request(
                         "get_meta", object_id=ref.id.binary(), timeout=None)
                     self.local_metas[ref.id] = meta
-            value = self._read_value(meta)
+            value = await self._read_value_async(meta)
             if meta.error or isinstance(value, RayTpuError):
                 raise value
             out.append(value)
@@ -308,6 +457,15 @@ class CoreClient:
             self._registered.discard(r.id)
             if meta is not None:
                 self.store.release(meta)  # drop our mapping; head unlinks
+            with self._pulled_lock:
+                pulled = self._pulled.pop(r.id, None)
+                if pulled is not None:
+                    self._pulled_bytes -= pulled.size
+            if pulled is not None:
+                try:
+                    self.store.free(pulled)  # our cached copy: unlink it
+                except Exception:
+                    pass
         self._call(self.conn.request(
             "free_objects", object_ids=[r.id.binary() for r in refs]))
 

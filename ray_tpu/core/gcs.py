@@ -44,6 +44,9 @@ class NodeInfo:
         self.conn = conn              # None for the head-local node
         self.max_workers = max_workers
         self.is_head = is_head
+        # (host, port) of the node's object data server; host None = "the
+        # head's host" (clients substitute their known route to the head)
+        self.data_addr = None
         self.alive = True
         self.idle: List["WorkerInfo"] = []
         self.workers: Set[WorkerID] = set()
@@ -93,6 +96,7 @@ class WorkerInfo:
         self.proc: Optional[subprocess.Popen] = None
         self.current_record = None
         self.retiring = False  # max_calls reached; exiting after current task
+        self.host: Optional[str] = None  # peer host of the registration conn
 
 
 class ActorInfo:
@@ -261,8 +265,12 @@ class Head:
                                   conn=None, max_workers=head_max, is_head=True)
         self.nodes: Dict[NodeID, NodeInfo] = {self.node_id: self.head_node}
 
-        self.store = SharedMemoryStore(session, capacity_bytes=object_store_bytes,
-                                       create_arena=True)
+        self.store = SharedMemoryStore(
+            session, capacity_bytes=object_store_bytes, create_arena=True,
+            namespace=(self.node_id.hex()[:8]
+                       if os.environ.get("RAY_TPU_STORE_ISOLATION")
+                       and not os.environ.get("RAY_TPU_STORE_NAMESPACE")
+                       else None))
         self.workers: Dict[WorkerID, WorkerInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
@@ -313,11 +321,19 @@ class Head:
 
     # ------------------------------------------------------------------ rpc
     def _handlers(self, conn_state: dict):
+        def _peer_host():
+            try:
+                peer = conn_state["conn"].writer.get_extra_info("peername")
+                return peer[0] if peer else None
+            except Exception:
+                return None
+
         async def register_worker(worker_id, pid, port, is_driver, node_id=None):
             nid = NodeID(node_id) if node_id else self.node_id
             node = self.nodes.get(nid) or self.head_node
             w = WorkerInfo(WorkerID(worker_id), conn_state["conn"], pid, port,
                            is_driver, node.node_id)
+            w.host = _peer_host()  # reachable host for direct actor calls
             w.proc = self._spawned.pop(pid, None)
             self.workers[w.worker_id] = w
             conn_state["worker"] = w
@@ -330,10 +346,13 @@ class Head:
                     "resources": node.resources, "labels": node.labels,
                     "driver_sys_path": self.kv.get(("cluster", b"driver_sys_path"))}
 
-        async def register_node(node_id, resources, labels, max_workers):
+        async def register_node(node_id, resources, labels, max_workers,
+                                data_port=None):
             nid = NodeID(node_id)
             node = NodeInfo(nid, resources, labels, conn_state["conn"],
                             max_workers)
+            if data_port:
+                node.data_addr = (_peer_host() or "127.0.0.1", data_port)
             self.nodes[nid] = node
             conn_state["node"] = node
             self._publish("node_state", {"node_id": nid.binary(), "state": "ALIVE"})
@@ -440,6 +459,28 @@ class Head:
             except asyncio.TimeoutError:
                 return None
 
+        async def node_data_addr(node_id):
+            """Data-server address of a node (for pulls of unregistered
+            direct actor-reply objects, which carry only a node_id)."""
+            n = self.nodes.get(NodeID(node_id))
+            if n is None or not n.alive:
+                return None
+            return n.data_addr
+
+        async def locate_object(object_id, timeout=None):
+            """Object directory lookup: fresh meta + current data-server
+            address (reference ownership_object_directory semantics, with
+            the head as the directory)."""
+            meta = await get_meta(object_id, timeout=timeout)
+            if meta is None:
+                return None
+            addr = None
+            if meta.kind in ("shm", "arena", "spilled") and meta.node_id is not None:
+                n = self.nodes.get(meta.node_id)
+                if n is not None and n.alive:
+                    addr = n.data_addr
+            return {"meta": meta, "data_addr": addr}
+
         async def wait_objects(object_ids, num_returns, timeout):
             object_ids = [ObjectID(b) if not isinstance(b, ObjectID) else b
                           for b in object_ids]
@@ -482,7 +523,7 @@ class Head:
             for oid in object_ids:
                 meta = self.objects.pop(oid, None)
                 if meta is not None:
-                    self.store.free(meta)
+                    self._free_meta(meta)
             return True
 
         async def kv_put(ns, key, value, overwrite=True):
@@ -712,6 +753,11 @@ class Head:
         async def actor_ready(actor_id, address):
             info = self.actors.get(ActorID(actor_id))
             if info is not None:
+                # workers self-report loopback; substitute the host we see
+                # them on so cross-node callers can reach the actor
+                w = conn_state.get("worker")
+                if w is not None and w.host:
+                    address = (w.host, address[1])
                 self.notify_actor_ready(info, address)
             return True
 
@@ -749,6 +795,20 @@ class Head:
                          else "PENDING_NODE_ASSIGNMENT")
         self._kick()
 
+    def _free_meta(self, meta: ObjectMeta) -> None:
+        """Free an object's storage wherever it lives: locally when this
+        process can reach it, and via the owning node's daemon otherwise
+        (real multi-host, or namespace isolation)."""
+        node = self.nodes.get(meta.node_id) if meta.node_id is not None else None
+        if (node is not None and node.conn is not None and node.alive
+                and meta.kind in ("shm", "arena", "spilled")):
+            try:
+                node.conn.push("free_object", meta=meta)
+            except Exception:
+                pass
+        if self.store.readable(meta):
+            self.store.free(meta)
+
     def _seal(self, meta: ObjectMeta) -> None:
         if meta.kind in ("shm", "arena") and meta.node_id is not None:
             n = self.nodes.get(meta.node_id)
@@ -771,7 +831,7 @@ class Head:
             # Arena entries are keyed by object id — the duplicate's storage
             # IS the winner's entry, so freeing it would destroy the data.
             if not (meta.kind == "arena" and existing.kind == "arena"):
-                self.store.free(meta)
+                self._free_meta(meta)  # duplicate may live on a remote node
             return
         self.objects[meta.object_id] = meta
         if meta.kind in ("shm", "arena"):
@@ -1528,8 +1588,19 @@ class Head:
             conn.on_close = on_close
 
         # handlers installed per-connection (they close over conn_state)
+        bind = os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
         self._server = protocol.Server({}, on_connect=on_connect, name="head")
-        self.port = await self._server.start(port=port)
+        self.port = await self._server.start(host=bind, port=port)
+        # head-node object data server (worker nodes run theirs in the node
+        # daemon): serves chunked reads of this node's store for cross-node
+        # pulls (reference object_manager over gRPC)
+        from ray_tpu.core import object_transfer
+
+        self._data_server = protocol.Server(
+            object_transfer.make_data_handlers(lambda: self.store),
+            name="head-data")
+        self.data_port = await self._data_server.start(host=bind)
+        self.head_node.data_addr = (None, self.data_port)
         from ray_tpu.core.job_manager import JobManager
 
         self.job_manager = JobManager(self.session, self.port)
@@ -1562,4 +1633,6 @@ class Head:
                 self._terminate_worker(w)
         if self._server:
             await self._server.stop()
+        if getattr(self, "_data_server", None):
+            await self._data_server.stop()
         self.store.shutdown()

@@ -40,22 +40,48 @@ class NodeDaemon:
         self.conn: protocol.Connection = None
         self.procs: Dict[int, subprocess.Popen] = {}
         self.stopping = asyncio.Event()
+        # object data plane: this daemon serves its node's store to remote
+        # pullers (the raylet/object-manager role). Under isolation mode
+        # the node gets its own store namespace, making single-machine
+        # clusters exercise real remote fetches.
+        self.store = None
+        self.data_port: int = 0
+        self._data_server: protocol.Server = None
+        isolation = bool(os.environ.get("RAY_TPU_STORE_ISOLATION"))
+        self.store_ns = os.environ.get("RAY_TPU_STORE_NAMESPACE") or (
+            self.node_id.hex()[:8] if isolation else "")
+        self._create_arena = isolation
 
     async def start(self):
+        from ray_tpu.core import object_transfer
+
+        self._data_server = protocol.Server(
+            object_transfer.make_data_handlers(lambda: self.store),
+            name="node-data")
+        self.data_port = await self._data_server.start(
+            host=os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"))
         self.conn = await protocol.connect(
             self.head_host, self.head_port,
             handlers={
                 "spawn_worker": self._spawn_worker,
                 "kill_worker": self._kill_worker,
                 "shutdown_node": self._shutdown_node,
+                "free_object": self._free_object,
             },
             name="node")
         self.conn.on_close = lambda c: self.stopping.set()
         reply = await self.conn.request(
             "register_node", node_id=self.node_id.binary(),
             resources=self.resources, labels=self.labels,
-            max_workers=self.max_workers)
+            max_workers=self.max_workers, data_port=self.data_port)
         self.session = reply["session"]
+        from ray_tpu.core.store import SharedMemoryStore
+
+        self.store = SharedMemoryStore(
+            self.session,
+            capacity_bytes=int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES",
+                                              str(2 << 30))),
+            create_arena=self._create_arena, namespace=self.store_ns)
 
     async def _spawn_worker(self):
         from ray_tpu.core.resources import strip_device_env
@@ -65,6 +91,8 @@ class NodeDaemon:
         env["RAY_TPU_HEAD_HOST"] = self.head_host
         env["RAY_TPU_SESSION"] = self.session
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        if self.store_ns:
+            env["RAY_TPU_STORE_NAMESPACE"] = self.store_ns
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env, stdout=None, stderr=None)
@@ -82,6 +110,15 @@ class NodeDaemon:
             pass
         return True
 
+    async def _free_object(self, meta):
+        """Head-forwarded free of an object living on this node."""
+        if self.store is not None:
+            try:
+                self.store.free(meta)
+            except Exception:
+                pass
+        return True
+
     async def _shutdown_node(self):
         self.stopping.set()
         return True
@@ -93,6 +130,12 @@ class NodeDaemon:
                 proc.kill()
             except ProcessLookupError:
                 pass
+        if self._data_server is not None:
+            await self._data_server.stop()
+        if self.store is not None:
+            # node death takes its objects with it (reference: plasma dies
+            # with the raylet); unlink what this store still maps
+            self.store.shutdown()
 
 
 async def amain(args):

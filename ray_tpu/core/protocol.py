@@ -16,7 +16,7 @@ import pickle
 import random
 from typing import Any, Awaitable, Callable, Dict, Optional
 
-HEADER = 8  # little-endian u64 frame length
+HEADER = 12  # u64 pickle-payload length + u32 out-of-band buffer count
 
 # --- fault injection (env: RAY_TPU_TESTING_RPC_FAILURE="method:prob") -------
 _chaos: Dict[str, float] = {}
@@ -52,10 +52,16 @@ class RemoteError(RpcError):
 async def read_frame(reader: asyncio.StreamReader) -> Any:
     try:
         header = await reader.readexactly(HEADER)
-        payload = await reader.readexactly(int.from_bytes(header, "little"))
+        payload = await reader.readexactly(
+            int.from_bytes(header[:8], "little"))
+        n_bufs = int.from_bytes(header[8:12], "little")
+        buffers = []
+        for _ in range(n_bufs):
+            ln = int.from_bytes(await reader.readexactly(8), "little")
+            buffers.append(await reader.readexactly(ln))
     except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError) as e:
         raise ConnectionLost(str(e)) from e
-    return pickle.loads(payload)
+    return pickle.loads(payload, buffers=buffers)
 
 
 def _set_nodelay(writer) -> None:
@@ -72,8 +78,19 @@ def _set_nodelay(writer) -> None:
 
 
 def write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
-    payload = pickle.dumps(msg, protocol=5)
-    writer.write(len(payload).to_bytes(8, "little") + payload)
+    """Frame = header + pickle payload + out-of-band buffers.
+
+    `pickle.PickleBuffer`-wrapped values in `msg` travel as separate
+    buffers, skipping pickle's in-band copy on both sides — the bulk-data
+    path (object chunk transfer) rides this zero-copy."""
+    buffers: list = []
+    payload = pickle.dumps(msg, protocol=5, buffer_callback=buffers.append)
+    writer.write(len(payload).to_bytes(8, "little")
+                 + len(buffers).to_bytes(4, "little") + payload)
+    for b in buffers:
+        raw = b.raw()
+        writer.write(raw.nbytes.to_bytes(8, "little"))
+        writer.write(raw if raw.contiguous else bytes(raw))
 
 
 class Connection:

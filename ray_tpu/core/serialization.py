@@ -22,6 +22,19 @@ import sys
 from typing import Any, List
 
 
+def np_copy_into(dst_view: memoryview, offset: int, data) -> int:
+    """memcpy `data` into `dst_view` at `offset`; returns bytes written.
+
+    Plain memoryview slice assignment into an mmap-backed buffer takes
+    CPython's byte-wise fallback (~30 MB/s); numpy slice assignment is a
+    real memcpy (~25x faster). Every bulk copy into shm must ride this."""
+    import numpy as np
+
+    src = np.frombuffer(data, dtype=np.uint8)
+    np.frombuffer(dst_view, dtype=np.uint8)[offset:offset + src.nbytes] = src
+    return src.nbytes
+
+
 class SerializedObject:
     """Pickle meta + list of out-of-band buffers (zero-copy where possible)."""
 
@@ -51,9 +64,7 @@ class SerializedObject:
 
         def put(data):
             nonlocal off
-            n = len(data)
-            out[off:off + n] = data
-            off += n
+            off += np_copy_into(out, off, data)
 
         put(len(self.buffers).to_bytes(8, "little"))
         put(len(self.meta).to_bytes(8, "little"))
@@ -63,8 +74,7 @@ class SerializedObject:
             mv = memoryview(b)
             if not mv.contiguous:
                 mv = memoryview(bytes(mv))
-            out[off:off + mv.nbytes] = mv.cast("B")
-            off += mv.nbytes
+            put(mv.cast("B"))
         return off
 
     @property

@@ -51,6 +51,51 @@ class ObjectMeta:
     error: bool = False             # payload is a serialized exception
 
 
+class PendingObject:
+    """An allocated-but-unsealed local object being filled by a remote pull
+    (plasma Create/Seal semantics, `src/ray/object_manager/plasma/store.h`)."""
+
+    def __init__(self, store: "SharedMemoryStore", obj_id: ObjectID, size: int,
+                 buf: Optional[bytearray] = None, shm=None,
+                 segment: Optional[str] = None):
+        self.store = store
+        self.object_id = obj_id
+        self.size = size
+        self._buf = buf
+        self._shm = shm
+        self._segment = segment
+        self.view = (memoryview(buf) if buf is not None
+                     else memoryview(shm.buf)[:size])
+
+    def write(self, offset: int, data) -> None:
+        from ray_tpu.core.serialization import np_copy_into
+
+        np_copy_into(self.view, offset, data)
+
+    def seal(self) -> ObjectMeta:
+        self.view.release()
+        if self._buf is not None:
+            return ObjectMeta(self.object_id, self.size, "inline",
+                              inline=bytes(self._buf))
+        meta = ObjectMeta(self.object_id, self.size, "shm",
+                          segment=self._segment)
+        self.store._meta_by_segment[self._segment] = meta
+        return meta
+
+    def abort(self) -> None:
+        self.view.release()
+        if self._shm is None:
+            return
+        with self.store._lock:
+            self.store._segments.pop(self._segment, None)
+            self.store.used -= self.size
+        try:
+            self._shm.close()
+            self._shm.unlink()
+        except (FileNotFoundError, BufferError):
+            pass
+
+
 def _unregister_tracker(shm: shared_memory.SharedMemory) -> None:
     """We manage segment lifetime explicitly; stop resource_tracker from
     unlinking segments when an attaching process exits."""
@@ -67,11 +112,25 @@ class SharedMemoryStore:
     processes attach read-only by segment name."""
 
     def __init__(self, session: str, capacity_bytes: int = 2 << 30,
-                 spill_dir: Optional[str] = None, create_arena: bool = False):
+                 spill_dir: Optional[str] = None, create_arena: bool = False,
+                 namespace: Optional[str] = None):
         self.session = session
         self.capacity = capacity_bytes
         self.used = 0
-        self.spill_dir = spill_dir or os.path.join(STATE_DIR, session, "spill")
+        # Store namespace: scopes segment/arena names to one logical node.
+        # With RAY_TPU_STORE_ISOLATION set, stores REFUSE to read objects
+        # from other namespaces even though shm is machine-global — the
+        # forced-remote-fetch test mode that makes single-machine clusters
+        # behave like real multi-host slices (object data must then travel
+        # through the node data servers, reference object_manager.cc).
+        self.namespace = (namespace if namespace is not None
+                          else os.environ.get("RAY_TPU_STORE_NAMESPACE", ""))
+        self.isolated = bool(os.environ.get("RAY_TPU_STORE_ISOLATION"))
+        tag = f"{self.namespace}_" if self.namespace else ""
+        self._seg_prefix = f"rtpu_{tag}{session[:8]}_"
+        self.spill_dir = spill_dir or os.path.join(
+            STATE_DIR, session,
+            f"spill_{self.namespace}" if self.namespace else "spill")
         self._segments: "OrderedDict[str, shared_memory.SharedMemory]" = OrderedDict()
         self._meta_by_segment: Dict[str, ObjectMeta] = {}
         self._pinned: Dict[str, int] = {}
@@ -91,7 +150,22 @@ class SharedMemoryStore:
                 self._arena = False
 
     def _arena_name(self) -> str:
-        return f"rtpu_arena_{self.session[:16]}"
+        tag = f"{self.namespace}_" if self.namespace else ""
+        return f"rtpu_arena_{tag}{self.session[:16]}"
+
+    def readable(self, meta: ObjectMeta) -> bool:
+        """Whether this store may read the object locally. Always true
+        outside isolation mode (shm is machine-global); under isolation,
+        only objects in our own namespace are local."""
+        if not self.isolated or meta.kind == "inline":
+            return True
+        if meta.kind == "shm":
+            return bool(meta.segment) and meta.segment.startswith(self._seg_prefix)
+        if meta.kind == "arena":
+            return meta.segment == self._arena_name()
+        if meta.kind == "spilled":
+            return bool(meta.spill_path) and meta.spill_path.startswith(self.spill_dir)
+        return True
 
     def _get_arena(self):
         if self._arena is not None:
@@ -117,7 +191,7 @@ class SharedMemoryStore:
             return meta
         # random suffix: a retried task must not collide with a segment left
         # behind by a dead attempt for the same return object id
-        name = f"rtpu_{self.session[:8]}_{obj_id.hex()[:12]}_{os.urandom(3).hex()}"
+        name = f"{self._seg_prefix}{obj_id.hex()[:12]}_{os.urandom(3).hex()}"
         with self._lock:
             self._ensure_capacity(size)
             shm = shared_memory.SharedMemory(create=True, size=size, name=name)
@@ -164,6 +238,8 @@ class SharedMemoryStore:
     def adopt(self, meta: ObjectMeta) -> None:
         """Track an object created by another process on this node
         (accounting, LRU ordering, spill eligibility)."""
+        if not self.readable(meta):
+            return  # another node's object (isolation mode): not ours to track
         if meta.kind == "arena":
             if self.owns_arena:
                 self._arena_metas[meta.object_id.binary()] = meta
@@ -189,6 +265,10 @@ class SharedMemoryStore:
     def get_serialized(self, meta: ObjectMeta) -> SerializedObject:
         if meta.kind == "inline":
             return SerializedObject.from_view(memoryview(meta.inline))
+        if not self.readable(meta):
+            # foreign namespace: surfaced identically to a missing segment
+            # so callers fall into the remote-pull path
+            raise FileNotFoundError(meta.segment or meta.spill_path)
         if meta.kind == "spilled":
             with open(meta.spill_path, "rb") as f:
                 return SerializedObject.from_view(memoryview(f.read()))
@@ -217,6 +297,62 @@ class SharedMemoryStore:
         # NOTE: the returned buffers alias shm memory; callers must copy or
         # finish deserializing before the object is freed.
         return SerializedObject.from_view(memoryview(shm.buf))
+
+    def get_raw(self, meta: ObjectMeta, offset: int = 0,
+                length: Optional[int] = None):
+        """Raw frame bytes [offset, offset+length) of a local object, for
+        the node data server's chunked reads.
+
+        Returns (memoryview of the window, release_cb|None). The caller
+        must invoke release_cb (if set) when done — arena reads pin the
+        object against eviction while the view is alive."""
+        end = meta.size if length is None else min(offset + length, meta.size)
+        if meta.kind == "inline":
+            return memoryview(meta.inline)[offset:end], None
+        if not self.readable(meta):
+            raise FileNotFoundError(meta.segment or meta.spill_path)
+        if meta.kind == "spilled":
+            # window read — a whole-file read per 4 MiB chunk would make
+            # pulls of spilled objects O(size^2) in disk I/O
+            with open(meta.spill_path, "rb") as f:
+                f.seek(offset)
+                return memoryview(f.read(end - offset)), None
+        if meta.kind == "arena":
+            arena = self._get_arena()
+            if arena is None:
+                raise FileNotFoundError(meta.segment)
+            oid = meta.object_id.binary()
+            try:
+                view = arena.get(oid, pin=True)
+            except KeyError:
+                raise FileNotFoundError(meta.segment) from None
+            return memoryview(view)[offset:end], lambda: arena.release(oid)
+        with self._lock:
+            shm = self._segments.get(meta.segment)
+        if shm is None:
+            shm = shared_memory.SharedMemory(name=meta.segment)
+            _unregister_tracker(shm)
+            with self._lock:
+                self._segments.setdefault(meta.segment, shm)
+        return memoryview(shm.buf)[offset:end], None
+
+    def allocate_raw(self, obj_id: ObjectID, size: int) -> "PendingObject":
+        """Writable destination for an incoming remote object (pull target).
+
+        Deliberately bypasses the arena: pulled copies are process-managed
+        caches the puller must be able to unlink itself, and foreign-created
+        arena entries would be invisible to the arena owner's spill
+        accounting."""
+        if size <= INLINE_THRESHOLD:
+            return PendingObject(self, obj_id, size, buf=bytearray(size))
+        name = f"{self._seg_prefix}{obj_id.hex()[:12]}_p{os.urandom(3).hex()}"
+        with self._lock:
+            self._ensure_capacity(size)
+            shm = shared_memory.SharedMemory(create=True, size=size, name=name)
+            _unregister_tracker(shm)
+            self._segments[name] = shm
+            self.used += size
+        return PendingObject(self, obj_id, size, shm=shm, segment=name)
 
     # -- lifetime ----------------------------------------------------------
     def pin(self, meta: ObjectMeta) -> None:

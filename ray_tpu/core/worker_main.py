@@ -107,7 +107,7 @@ class WorkerRuntime:
         else:
             meta = payload["meta"]
             self.client.local_metas[meta.object_id] = meta
-            ser = self.client.store.get_serialized(meta)
+            ser = self.client.read_serialized(meta)  # pulls if cross-node
         args, kwargs = serialization.deserialize(ser)
         args = [self.client.get([a])[0] if isinstance(a, ObjectRef) else a
                 for a in args]
@@ -123,7 +123,7 @@ class WorkerRuntime:
         else:
             meta = payload["meta"]
             self.client.local_metas[meta.object_id] = meta
-            ser = self.client.store.get_serialized(meta)
+            ser = await self.client.read_serialized_async(meta)
         args, kwargs = serialization.deserialize(ser)
         out_args = []
         for a in args:
