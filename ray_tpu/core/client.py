@@ -18,7 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from collections import OrderedDict
 
-from ray_tpu.core import object_transfer, protocol, serialization
+from ray_tpu.core import object_transfer, protocol, refcount, serialization
 from ray_tpu.core.exceptions import (ActorDiedError, GetTimeoutError,
                                      ObjectLostError, RayTpuError)
 from ray_tpu.core.function_manager import FunctionManager
@@ -87,6 +87,8 @@ class CoreClient:
         fut = asyncio.run_coroutine_threadsafe(
             self._start_async(direct_handlers or {}), self.loop)
         fut.result(timeout=30)
+        self.ref_tracker = refcount.RefTracker(self)
+        refcount.activate(self.ref_tracker)
         self._started.set()
 
     async def _start_async(self, direct_handlers: dict) -> None:
@@ -129,6 +131,8 @@ class CoreClient:
             self.on_disconnect()
 
     def shutdown(self) -> None:
+        refcount.activate(None)
+
         async def _close():
             if self.conn:
                 await self.conn.close()
@@ -160,6 +164,7 @@ class CoreClient:
         ser = serialization.serialize(value)
         meta = self.store.put_serialized(oid, ser)
         meta.node_id = self.node_id
+        meta.contained = [o.binary() for o in ser.contained] or None
         self.local_metas[oid] = meta
         self._register_meta(meta)
         return ObjectRef(oid)
@@ -170,6 +175,7 @@ class CoreClient:
         meta = self.store.put_serialized(oid, ser)
         meta.error = error
         meta.node_id = self.node_id
+        meta.contained = [o.binary() for o in ser.contained] or None
         self.local_metas[oid] = meta
         if register:
             self._register_meta(meta)
@@ -183,6 +189,7 @@ class CoreClient:
         # node-stamped so a cross-node consumer of an UNregistered meta
         # (direct actor reply) can still find our node's data server
         meta.node_id = self.node_id
+        meta.contained = [o.binary() for o in ser.contained] or None
         self.local_metas[oid] = meta
         if register:
             self._register_meta(meta)
@@ -240,11 +247,13 @@ class CoreClient:
                 self._drop_pulled(meta.object_id)
         raise ObjectLostError(f"object {meta.object_id} vanished during read")
 
-    def _drop_pulled(self, oid: ObjectID) -> None:
+    def _drop_pulled(self, oid: ObjectID):
+        """Forget a pulled copy; returns its meta (caller frees storage)."""
         with self._pulled_lock:
             stale = self._pulled.pop(oid, None)
             if stale is not None:
                 self._pulled_bytes -= stale.size
+        return stale
 
     async def _resolve_readable(self, meta: ObjectMeta) -> ObjectMeta:
         """Produce a locally-readable meta for an object we can't read:
@@ -457,10 +466,7 @@ class CoreClient:
             self._registered.discard(r.id)
             if meta is not None:
                 self.store.release(meta)  # drop our mapping; head unlinks
-            with self._pulled_lock:
-                pulled = self._pulled.pop(r.id, None)
-                if pulled is not None:
-                    self._pulled_bytes -= pulled.size
+            pulled = self._drop_pulled(r.id)
             if pulled is not None:
                 try:
                     self.store.free(pulled)  # our cached copy: unlink it
@@ -484,13 +490,22 @@ class CoreClient:
     # --------------------------------------------------------------- tasks
     def build_args_payload(self, args: tuple, kwargs: dict):
         """Top-level ObjectRef args become deps (resolved at execution, like
-        the reference); everything ships serialized."""
+        the reference); refs NESTED anywhere in the arguments are collected
+        during pickling and pinned as deps too; everything ships
+        serialized."""
         deps = []
+        seen = set()
         for a in list(args) + list(kwargs.values()):
             if isinstance(a, ObjectRef):
                 self.ensure_registered(a)
                 deps.append(a.id.binary())
+                seen.add(a.id)
         ser = serialization.serialize((args, kwargs))
+        for oid in ser.contained:
+            if oid not in seen:
+                seen.add(oid)
+                self.ensure_registered(ObjectRef(oid))
+                deps.append(oid.binary())
         if ser.total_bytes <= ARGS_INLINE_LIMIT:
             return {"inline": ser.to_bytes()}, deps
         meta = self.put_serialized(ser)
@@ -499,6 +514,11 @@ class CoreClient:
     def submit_task(self, fn_key: bytes, args: tuple, kwargs: dict,
                     options: dict, num_returns: int = 1) -> List[ObjectRef]:
         payload, deps = self.build_args_payload(args, kwargs)
+        if "meta" in payload:
+            # the args payload object is itself pinned as a dep: the head
+            # releases it at task completion, so big-args payloads stop
+            # leaking and can't be evicted while the task is queued
+            deps = deps + [payload["meta"].object_id.binary()]
         task_id = TaskID.generate()
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
         spec = {"task_id": task_id, "fn_key": fn_key, "args": payload,
@@ -574,13 +594,22 @@ class CoreClient:
         the ref join it via `_pending_calls`."""
         payload, deps = self.build_args_payload(args, kwargs)
         return_id = ObjectID.generate()
+        # actor calls bypass the head, so the head can't pin their args:
+        # hold ObjectRefs (our own local refcounts) for the deps and the
+        # payload object until the reply lands
+        pins = [ObjectRef(ObjectID(b)) for b in deps]
+        if "meta" in payload:
+            pins.append(ObjectRef(payload["meta"].object_id))
         cfut = asyncio.run_coroutine_threadsafe(
             self._call_actor_async(actor_id, method, payload, deps,
                                    return_id.binary(), group=group), self.loop)
         with self._pending_lock:
             self._pending_calls[return_id] = cfut
 
-        def _on_done(f):
+        def _on_done(f, _pins=pins):
+            _pins.clear()  # release arg pins NOW — the future object (and
+            # this callback's defaults) may outlive the call in
+            # _pending_calls, so dropping the binding wouldn't free them
             try:
                 meta = f.result()["meta"]
             except BaseException:

@@ -120,6 +120,7 @@ class TaskRecord:
         self.pending_deps: Set[ObjectID] = set()
         self.cancelled = False
         self.dispatch_ts: Optional[float] = None
+        self.pinned: List[ObjectID] = []  # deps pinned while in flight
 
 
 class TaskQueue:
@@ -213,6 +214,7 @@ class GeneratorState:
 
     def __init__(self, backpressure: int = 0):
         self.items: List[bytes] = []      # yielded object ids, in order
+        self.delivered: Set[int] = set()  # indices handed to the consumer
         self.done = False
         self.backpressure = backpressure
         self.consumed = 0                 # highest index the consumer fetched
@@ -303,6 +305,22 @@ class Head:
             "RAY_TPU_LINEAGE_BYTES", str(256 << 20)))
         self.lineage_bytes = 0
         self._reconstructing: Set[ObjectID] = set()
+        # ------- distributed object lifetime (reference_count.h parity) ---
+        # An object stays alive while ANY of: a process holds a live
+        # ObjectRef (obj_holders), an in-flight task/actor-call references
+        # it (obj_pins, incl. containment in a live object and queued
+        # generator items), or a reconstructable lineage entry needs it as
+        # an input (lineage_dep_pins). When all empty, it is evicted after
+        # a short grace window that absorbs in-flight handoffs.
+        self.refcount_enabled = os.environ.get("RAY_TPU_REFCOUNT", "1") != "0"
+        self.obj_holders: Dict[ObjectID, Set[WorkerID]] = {}
+        self.obj_pins: Dict[ObjectID, int] = {}
+        self.worker_holds: Dict[WorkerID, Set[ObjectID]] = {}
+        self.lineage_dep_pins: Dict[ObjectID, int] = {}
+        self._evict_due: Dict[ObjectID, float] = {}
+        self.evict_grace_s = float(os.environ.get(
+            "RAY_TPU_EVICT_GRACE_S", "2.0"))
+        self.objects_evicted = 0
         # produced objects lost to node death, awaiting lazy reconstruction;
         # if their lineage entry gets cap-evicted meanwhile, consumers must
         # get ObjectLostError, not an eternal hang
@@ -366,16 +384,15 @@ class Head:
                 entry = {"spec": spec, "produced": set(),
                          "recon_left": spec["options"].get("max_retries", 3),
                          "bytes": self._spec_bytes(spec)}
+                self._lineage_add_entry(entry)
                 for rid in spec["return_ids"]:
-                    old = self.lineage.pop(ObjectID(rid), None)
-                    if old is not None:
-                        self.lineage_bytes -= old["bytes"]
+                    self._lineage_pop(ObjectID(rid))
                     self.lineage[ObjectID(rid)] = entry
                     self.lineage_bytes += entry["bytes"]
                 while (len(self.lineage) > self.lineage_cap
                        or self.lineage_bytes > self.lineage_bytes_cap):
-                    _, old = self.lineage.popitem(last=False)
-                    self.lineage_bytes -= old["bytes"]
+                    oldest = next(iter(self.lineage))
+                    self._lineage_pop(oldest)
             self._enqueue(rec)
             return True
 
@@ -459,6 +476,39 @@ class Head:
             except asyncio.TimeoutError:
                 return None
 
+        async def ref_update(ops):
+            """Batched, ORDERED ObjectRef count transitions from one
+            process (reference ReferenceCounter ownership updates)."""
+            w = conn_state.get("worker")
+            if w is None:
+                return True
+            held = self.worker_holds.setdefault(w.worker_id, set())
+            for is_inc, b in ops:
+                oid = ObjectID(b)
+                if is_inc:
+                    held.add(oid)
+                    self.obj_holders.setdefault(oid, set()).add(w.worker_id)
+                    self._evict_due.pop(oid, None)
+                else:
+                    held.discard(oid)
+                    hs = self.obj_holders.get(oid)
+                    if hs is not None:
+                        hs.discard(w.worker_id)
+                        if not hs:
+                            self.obj_holders.pop(oid, None)
+                            self._maybe_evict(oid)
+            return True
+
+        async def object_spilled(meta):
+            """A node daemon spilled an object it tracks: retarget the
+            canonical directory entry so new readers hit the spill file."""
+            canonical = self.objects.get(meta.object_id)
+            if canonical is not None and canonical.kind in ("shm", "arena"):
+                canonical.kind = meta.kind
+                canonical.spill_path = meta.spill_path
+                canonical.segment = meta.segment
+            return True
+
         async def node_data_addr(node_id):
             """Data-server address of a node (for pulls of unregistered
             direct actor-reply objects, which carry only a node_id)."""
@@ -515,15 +565,8 @@ class Head:
             return ready()
 
         async def free_objects(object_ids):
-            object_ids = [ObjectID(b) for b in object_ids]
-            for oid in object_ids:
-                old = self.lineage.pop(oid, None)
-                if old is not None:
-                    self.lineage_bytes -= old["bytes"]
-            for oid in object_ids:
-                meta = self.objects.pop(oid, None)
-                if meta is not None:
-                    self._free_meta(meta)
+            for oid in [ObjectID(b) for b in object_ids]:
+                self._drop_object(oid)
             return True
 
         async def kv_put(ns, key, value, overwrite=True):
@@ -693,6 +736,9 @@ class Head:
         async def generator_yield(gen_id, meta, backpressure=0):
             gs = _gen(gen_id, backpressure)
             self._seal(meta)
+            # queued items are pinned until the consumer takes delivery
+            # (nobody holds a ref to them yet)
+            self._pin(meta.object_id)
             gs.items.append(meta.object_id.binary())
             gs.wake(gs.consumer_waiters)
             # backpressure: hold the producer's reply until consumed catches up
@@ -716,7 +762,13 @@ class Head:
             gs.wake(gs.producer_waiters)
             while True:
                 if index < len(gs.items):
-                    return {"ref": gs.items[index]}
+                    item = gs.items[index]
+                    if index not in gs.delivered:
+                        gs.delivered.add(index)
+                        # consumer takes over interest (its ref_update inc
+                        # lands within the eviction grace window)
+                        self._unpin(ObjectID(item))
+                    return {"ref": item}
                 # a failed generator task seals gen_id itself with the error;
                 # the consumer receives it once, after draining real items
                 err_meta = self.objects.get(ObjectID(gen_id))
@@ -727,6 +779,20 @@ class Head:
                 fut = asyncio.get_running_loop().create_future()
                 gs.consumer_waiters.append(fut)
                 await fut
+
+        async def generator_release(gen_id):
+            """Consumer dropped its ObjectRefGenerator: unpin undelivered
+            items and forget the stream (abandoned generators must not pin
+            their queued items forever)."""
+            gs = self.generators.pop(gen_id, None)
+            if gs is not None:
+                for idx, item in enumerate(gs.items):
+                    if idx not in gs.delivered:
+                        self._unpin(ObjectID(item))
+                gs.done = True
+                gs.wake(gs.consumer_waiters)
+                gs.wake(gs.producer_waiters)
+            return True
 
         async def cancel_task(return_id, force=False):
             """ray.cancel: drop a queued task, or interrupt/kill a running
@@ -783,6 +849,10 @@ class Head:
 
     # ---------------------------------------------------------------- sched
     def _enqueue(self, rec: TaskRecord) -> None:
+        self._unpin_task(rec)  # no-op for fresh records; retries re-pin
+        rec.pinned = [ObjectID(dep) for dep in rec.spec.get("deps", [])]
+        for oid in rec.pinned:
+            self._pin(oid)  # inputs stay alive until the task finishes
         for dep in rec.spec.get("deps", []):
             oid = ObjectID(dep)
             if oid not in self.objects:
@@ -794,6 +864,93 @@ class Head:
                          "PENDING_ARGS_AVAIL" if rec.pending_deps
                          else "PENDING_NODE_ASSIGNMENT")
         self._kick()
+
+    # ------------------------------------------------- object lifetime
+    def _pin(self, oid: ObjectID) -> None:
+        self.obj_pins[oid] = self.obj_pins.get(oid, 0) + 1
+        self._evict_due.pop(oid, None)
+
+    def _unpin(self, oid: ObjectID) -> None:
+        c = self.obj_pins.get(oid, 0) - 1
+        if c <= 0:
+            self.obj_pins.pop(oid, None)
+            self._maybe_evict(oid)
+        else:
+            self.obj_pins[oid] = c
+
+    def _unpin_task(self, rec: "TaskRecord") -> None:
+        for oid in getattr(rec, "pinned", None) or []:
+            self._unpin(oid)
+        rec.pinned = []
+
+    def _maybe_evict(self, oid: ObjectID) -> None:
+        if not self.refcount_enabled:
+            return
+        if (self.obj_holders.get(oid) or self.obj_pins.get(oid)
+                or self.lineage_dep_pins.get(oid)):
+            return
+        if oid in self.objects or oid in self.lineage:
+            self._evict_due[oid] = time.monotonic() + self.evict_grace_s
+
+    async def _evict_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(min(max(self.evict_grace_s / 2, 0.05), 1.0))
+            if not self._evict_due:
+                continue
+            now = time.monotonic()
+            due = [oid for oid, t in self._evict_due.items() if t <= now]
+            for oid in due:
+                self._evict_due.pop(oid, None)
+                if (self.obj_holders.get(oid) or self.obj_pins.get(oid)
+                        or self.lineage_dep_pins.get(oid)):
+                    continue
+                try:
+                    self._drop_object(oid)
+                    self.objects_evicted += 1
+                except Exception as e:
+                    # one failing free (e.g. BufferError on an exported shm
+                    # mapping) must not kill the eviction loop for the
+                    # session — that silently reverts refcounting to a leak
+                    print(f"[ray_tpu] evict {oid.hex()} failed: {e!r}",
+                          file=sys.stderr, flush=True)
+
+    def _drop_object(self, oid: ObjectID) -> None:
+        """Remove an object entirely: storage, directory entry, lineage,
+        and the pins it held on nested refs."""
+        meta = self.objects.pop(oid, None)
+        self.obj_holders.pop(oid, None)
+        self._evict_due.pop(oid, None)
+        self._lineage_pop(oid)
+        if meta is not None:
+            self._free_meta(meta)
+            for b in (meta.contained or []):
+                self._unpin(ObjectID(b))
+
+    def _lineage_add_entry(self, entry: dict) -> None:
+        """Pin a reconstructable task's inputs: reconstruction needs them
+        (reference: lineage pinning in ReferenceCounter)."""
+        entry["live_rids"] = len(entry["spec"]["return_ids"])
+        for dep in entry["spec"].get("deps", []):
+            oid = ObjectID(dep)
+            self.lineage_dep_pins[oid] = self.lineage_dep_pins.get(oid, 0) + 1
+            self._evict_due.pop(oid, None)
+
+    def _lineage_pop(self, oid: ObjectID):
+        old = self.lineage.pop(oid, None)
+        if old is None:
+            return None
+        self.lineage_bytes -= old["bytes"]
+        old["live_rids"] = old.get("live_rids", 1) - 1
+        if old["live_rids"] <= 0:
+            for dep in old["spec"].get("deps", []):
+                doid = ObjectID(dep)
+                c = self.lineage_dep_pins.get(doid, 0) - 1
+                if c <= 0:
+                    self.lineage_dep_pins.pop(doid, None)
+                    self._maybe_evict(doid)
+                else:
+                    self.lineage_dep_pins[doid] = c
+        return old
 
     def _free_meta(self, meta: ObjectMeta) -> None:
         """Free an object's storage wherever it lives: locally when this
@@ -834,8 +991,18 @@ class Head:
                 self._free_meta(meta)  # duplicate may live on a remote node
             return
         self.objects[meta.object_id] = meta
+        for b in (meta.contained or []):
+            self._pin(ObjectID(b))  # nested refs live while container does
+        self._maybe_evict(meta.object_id)  # fire-and-forget results: nobody
+        # may hold a ref by the time the result arrives
         if meta.kind in ("shm", "arena"):
-            self.store.adopt(meta)  # accounting + LRU/spill tracking
+            # accounting + LRU/spill tracking; when the head can't see the
+            # object (isolation / real multi-host) the owning node daemon
+            # tracks it instead, so capacity enforcement still happens
+            if not self.store.adopt(meta):
+                n = self.nodes.get(meta.node_id) if meta.node_id else None
+                if n is not None and n.conn is not None and n.alive:
+                    n.conn.push("adopt_object", meta=meta)
         if meta.error and meta.object_id.binary() in self.generators:
             # a failed generator task: consumers drain produced items, then
             # receive the error ref (generator_next checks this meta)
@@ -1063,6 +1230,14 @@ class Head:
         self._spawned[proc.pid] = proc
 
     def _on_worker_disconnect(self, w: WorkerInfo) -> None:
+        # a dead process holds nothing: release its ref interest
+        for oid in self.worker_holds.pop(w.worker_id, set()):
+            hs = self.obj_holders.get(oid)
+            if hs is not None:
+                hs.discard(w.worker_id)
+                if not hs:
+                    self.obj_holders.pop(oid, None)
+                    self._maybe_evict(oid)
         self.workers.pop(w.worker_id, None)
         node = self.nodes.get(w.node_id)
         if node is not None:
@@ -1167,6 +1342,9 @@ class Head:
                 if m.node_id == node.node_id and m.kind in ("shm", "arena")]
         for oid in lost:
             meta = self.objects.pop(oid)
+            self._evict_due.pop(oid, None)
+            for b in (meta.contained or []):
+                self._unpin(ObjectID(b))
             try:
                 # unlink the dead copy's storage now: the meta is the only
                 # handle to the arena entry / shm segment, and nothing can
@@ -1234,6 +1412,7 @@ class Head:
 
     def _fail_task(self, rec: TaskRecord, cause: str,
                    cancelled: bool = False) -> None:
+        self._unpin_task(rec)
         from ray_tpu.core import serialization
         from ray_tpu.core.exceptions import (TaskCancelledError,
                                              WorkerCrashedError)
@@ -1601,12 +1780,15 @@ class Head:
             name="head-data")
         self.data_port = await self._data_server.start(host=bind)
         self.head_node.data_addr = (None, self.data_port)
+        asyncio.ensure_future(self._evict_loop())
         from ray_tpu.core.job_manager import JobManager
 
         self.job_manager = JobManager(self.session, self.port)
         return self.port
 
     def notify_task_done(self, w: WorkerInfo) -> None:
+        if w.current_record is not None:
+            self._unpin_task(w.current_record)
         w.running_task = None
         w.current_record = None
         self._release(w)

@@ -67,6 +67,7 @@ class NodeDaemon:
                 "kill_worker": self._kill_worker,
                 "shutdown_node": self._shutdown_node,
                 "free_object": self._free_object,
+                "adopt_object": self._adopt_object,
             },
             name="node")
         self.conn.on_close = lambda c: self.stopping.set()
@@ -82,6 +83,10 @@ class NodeDaemon:
             capacity_bytes=int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES",
                                               str(2 << 30))),
             create_arena=self._create_arena, namespace=self.store_ns)
+        # spills retarget our local meta copy; the head owns the canonical
+        # entry and must learn the new location
+        self.store.on_spill = lambda m: self.conn.push("object_spilled",
+                                                       meta=m)
 
     async def _spawn_worker(self):
         from ray_tpu.core.resources import strip_device_env
@@ -108,6 +113,16 @@ class NodeDaemon:
                 os.kill(pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
+        return True
+
+    async def _adopt_object(self, meta):
+        """Track an object the head can't see (isolation/multi-host):
+        capacity accounting + watermark spilling live with this node."""
+        if self.store is not None:
+            try:
+                self.store.adopt(meta)
+            except Exception:
+                pass
         return True
 
     async def _free_object(self, meta):

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ray_tpu.core import refcount
 from ray_tpu.core.ids import ObjectID
 
 
@@ -13,6 +14,16 @@ class ObjectRef:
     def __init__(self, object_id: ObjectID):
         assert isinstance(object_id, ObjectID)
         self.id = object_id
+        # every live instance counts toward this process's interest in the
+        # object (reference ReferenceCounter local refs); deserializing a
+        # nested ref runs through here too
+        refcount.note_created(object_id)
+
+    def __del__(self):
+        try:
+            refcount.note_deleted(self.id)
+        except Exception:
+            pass  # interpreter teardown
 
     def binary(self) -> bytes:
         return self.id.binary()
@@ -50,6 +61,32 @@ class ObjectRefGenerator:
         self._gen_id = gen_id
         self._index = 0
         self._exhausted = False
+        self._released = False
+
+    def _release(self) -> None:
+        """Tell the head we are done with this stream: undelivered items
+        are unpinned head-side (abandoning a generator must not pin its
+        queue forever)."""
+        if self._released:
+            return
+        self._released = True
+        try:
+            from ray_tpu.core.api import _global_client
+
+            import functools
+
+            client = _global_client()
+            client.loop.call_soon_threadsafe(functools.partial(
+                client.conn.push, "generator_release",
+                gen_id=self._gen_id.binary()))
+        except Exception:
+            pass  # no client / shutdown: head cleans up with the session
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:
+            pass
 
     def __iter__(self):
         return self
@@ -57,6 +94,7 @@ class ObjectRefGenerator:
     def _advance(self, rep) -> ObjectRef:
         if rep.get("done") or self._exhausted:
             self._exhausted = True
+            self._release()
             raise StopIteration
         if rep.get("error"):
             # the producer failed: yield its error ref once, then stop

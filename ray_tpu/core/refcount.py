@@ -1,0 +1,120 @@
+"""Client-side reference tracking for automatic object lifetime.
+
+Capability parity with the reference's distributed ReferenceCounter
+(`src/ray/core_worker/reference_count.h:73`), re-shaped for this runtime's
+head-centric design: each process counts live `ObjectRef` instances per
+object; the 0→1 / 1→0 transitions are batched and pushed to the head,
+which keeps the global interest set (holders ∪ in-flight task deps ∪
+containment edges ∪ lineage pins) and evicts objects when it empties —
+so `free()` becomes optional instead of mandatory.
+
+Delivery ordering: a process always sends inc before the matching dec,
+and both ride the same head connection (FIFO), so the head never sees a
+phantom release. Cross-process handoff races (producer drops its ref
+while the consumer's inc is still in flight) are absorbed by the head's
+eviction grace period.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.core.ids import ObjectID
+
+FLUSH_S = float(os.environ.get("RAY_TPU_REFCOUNT_FLUSH_S", "0.1"))
+
+_active: Optional["RefTracker"] = None
+
+
+def note_created(oid: ObjectID) -> None:
+    t = _active
+    if t is not None:
+        t.inc(oid)
+
+
+def note_deleted(oid: ObjectID) -> None:
+    t = _active
+    if t is not None:
+        t.dec(oid)
+
+
+def activate(tracker: Optional["RefTracker"]) -> None:
+    global _active
+    _active = tracker
+
+
+class RefTracker:
+    """Per-process live-ObjectRef counts; flushes transitions to the head."""
+
+    def __init__(self, client):
+        self.client = client
+        self.counts: Dict[ObjectID, int] = {}
+        self.lock = threading.Lock()
+        # ordered op log: (is_inc, oid_bytes) — inc/dec interleaving for
+        # one object within a batch must reach the head in order, or a
+        # drop-then-reacquire inside one flush window reads as a net drop
+        self._ops: List[tuple] = []
+        self._flush_scheduled = False
+        self.enabled = os.environ.get("RAY_TPU_REFCOUNT", "1") != "0"
+
+    def inc(self, oid: ObjectID) -> None:
+        if not self.enabled:
+            return
+        with self.lock:
+            c = self.counts.get(oid, 0) + 1
+            self.counts[oid] = c
+            if c == 1:
+                self._ops.append((True, oid.binary()))
+                self._schedule()
+
+    def dec(self, oid: ObjectID) -> None:
+        if not self.enabled:
+            return
+        with self.lock:
+            c = self.counts.get(oid, 0) - 1
+            if c > 0:
+                self.counts[oid] = c
+                return
+            self.counts.pop(oid, None)
+            self._ops.append((False, oid.binary()))
+            self._schedule()
+
+    def _schedule(self) -> None:
+        # lock held. Batch transitions for FLUSH_S so ref churn costs one
+        # push, not one RPC per ref (reference: batched WaitForRefRemoved).
+        if self._flush_scheduled:
+            return
+        self._flush_scheduled = True
+        try:
+            self.client.loop.call_soon_threadsafe(
+                lambda: self.client.loop.call_later(FLUSH_S, self._flush))
+        except RuntimeError:
+            self._flush_scheduled = False  # loop closed (shutdown)
+
+    def _flush(self) -> None:
+        with self.lock:
+            ops = self._ops
+            self._ops = []
+            self._flush_scheduled = False
+        if not ops:
+            return
+        conn = self.client.conn
+        sent = False
+        if conn is not None and not conn.closed:
+            try:
+                conn.push("ref_update", ops=ops)
+                sent = True
+            except Exception:
+                pass
+        if not sent:
+            # requeue in order: dropping a batch would lose an inc (eviction
+            # of a live object) or a dec (permanent leak)
+            with self.lock:
+                self._ops = ops + self._ops
+                self._schedule()
+
+    def flush_now(self) -> None:
+        """Synchronous flush (tests / shutdown)."""
+        self._flush()

@@ -19,7 +19,7 @@ from __future__ import annotations
 import io
 import pickle
 import sys
-from typing import Any, List
+from typing import Any, List, Optional
 
 
 def np_copy_into(dst_view: memoryview, offset: int, data) -> int:
@@ -38,11 +38,15 @@ def np_copy_into(dst_view: memoryview, offset: int, data) -> int:
 class SerializedObject:
     """Pickle meta + list of out-of-band buffers (zero-copy where possible)."""
 
-    __slots__ = ("meta", "buffers")
+    __slots__ = ("meta", "buffers", "contained")
 
-    def __init__(self, meta: bytes, buffers: List[memoryview]):
+    def __init__(self, meta: bytes, buffers: List[memoryview],
+                 contained: Optional[List] = None):
         self.meta = meta
         self.buffers = buffers
+        # ObjectIDs of ObjectRefs pickled inside this payload — the
+        # reference-counting layer pins them while the container lives
+        self.contained = contained or []
 
     @property
     def total_bytes(self) -> int:
@@ -105,12 +109,22 @@ class _Pickler(cloudpickle.Pickler):
     instead, not byte serialization)."""
 
     def reducer_override(self, obj):
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if type(obj) is ObjectRef:
+            # record nested refs so the refcounting layer can pin them for
+            # the container's lifetime (reference: borrowed refs serialized
+            # into task args / returned values)
+            self.contained_refs.append(obj.id)
+            return NotImplemented
         jax = sys.modules.get("jax")
         if jax is not None and isinstance(obj, jax.Array):
             import numpy as np
 
             return np.asarray(obj).__reduce_ex__(5)
         return super().reducer_override(obj)
+
+    contained_refs: List = None  # set per instance in serialize()
 
 
 # top-level bytes/bytearray get a marker meta + out-of-band buffer: pickle5's
@@ -134,8 +148,10 @@ def serialize(value: Any) -> SerializedObject:
 
     sink = io.BytesIO()
     p = _Pickler(sink, protocol=5, buffer_callback=callback)
+    p.contained_refs = []
     p.dump(value)
-    return SerializedObject(sink.getvalue(), buffers)
+    return SerializedObject(sink.getvalue(), buffers,
+                            contained=p.contained_refs)
 
 
 def deserialize(obj: SerializedObject) -> Any:

@@ -49,6 +49,7 @@ class ObjectMeta:
     node_id: Optional[object] = None
     owner: Optional[object] = None  # WorkerID of owner
     error: bool = False             # payload is a serialized exception
+    contained: Optional[list] = None  # ObjectIDs of refs nested inside
 
 
 class PendingObject:
@@ -135,6 +136,9 @@ class SharedMemoryStore:
         self._meta_by_segment: Dict[str, ObjectMeta] = {}
         self._pinned: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # invoked with the retargeted meta after a spill — lets a node
+        # daemon tell the head to update the canonical directory entry
+        self.on_spill = None
         # native arena backend (plasma equivalent); the head creates, others
         # lazily attach. None until first use; False = unavailable.
         self.owns_arena = create_arena
@@ -235,31 +239,34 @@ class SharedMemoryStore:
             self._maybe_spill_arena()
         return ObjectMeta(obj_id, size, "arena", segment=arena.name)
 
-    def adopt(self, meta: ObjectMeta) -> None:
+    def adopt(self, meta: ObjectMeta) -> bool:
         """Track an object created by another process on this node
-        (accounting, LRU ordering, spill eligibility)."""
+        (accounting, LRU ordering, spill eligibility). Returns False when
+        this store cannot see the object — the caller then forwards
+        adoption to the node that can (isolation / real multi-host)."""
         if not self.readable(meta):
-            return  # another node's object (isolation mode): not ours to track
+            return False  # another node's object: not ours to track
         if meta.kind == "arena":
             if self.owns_arena:
                 self._arena_metas[meta.object_id.binary()] = meta
                 self._maybe_spill_arena()
-            return
+            return True
         if meta.kind != "shm" or meta.segment is None:
-            return
+            return True
         with self._lock:
             if meta.segment in self._segments:
                 self._meta_by_segment[meta.segment] = meta
-                return
+                return True
             self._ensure_capacity(meta.size)
             try:
                 shm = shared_memory.SharedMemory(name=meta.segment)
             except FileNotFoundError:
-                return
+                return False
             _unregister_tracker(shm)
             self._segments[meta.segment] = shm
             self._meta_by_segment[meta.segment] = meta
             self.used += meta.size
+        return True
 
     # -- reads -------------------------------------------------------------
     def get_serialized(self, meta: ObjectMeta) -> SerializedObject:
@@ -455,6 +462,8 @@ class SharedMemoryStore:
             meta.kind = "spilled"
             meta.spill_path = path
             meta.segment = None
+            if self.on_spill is not None:
+                self.on_spill(meta)
 
     def _ensure_capacity(self, incoming: int) -> None:
         """Spill LRU unpinned segments until `incoming` fits. Lock held."""
@@ -472,17 +481,19 @@ class SharedMemoryStore:
             with open(path, "wb") as f:
                 f.write(shm.buf)
             self.used -= (meta.size if meta else shm.size)
-            shm.close()
             try:
+                shm.close()
                 shm.unlink()
-            except FileNotFoundError:
-                pass
+            except (FileNotFoundError, BufferError):
+                pass  # exported views keep the mapping alive; data persists
             if meta is not None:
                 # readers that already attached keep a valid mapping; new
                 # readers see the updated meta and read the spill file
                 meta.kind = "spilled"
                 meta.spill_path = path
                 meta.segment = None
+                if self.on_spill is not None:
+                    self.on_spill(meta)
 
     def shutdown(self) -> None:
         with self._lock:
