@@ -118,7 +118,27 @@ def main(out_path: str | None = None) -> dict:
         ray_tpu.get([noop.remote() for _ in range(n)])
         return n
 
-    results["multi_client_tasks_async"] = timeit(tasks_async)
+    results["single_client_tasks_async"] = timeit(tasks_async)
+
+    # ---- multi-client tasks async: the reference runs N separate driver
+    # processes; ours are N remote caller actors each pipelining its own
+    # task stream (submission pickling parallelized across processes)
+    @ray_tpu.remote
+    class TaskCaller:
+        def hammer(self, n):
+            import ray_tpu as rt
+
+            rt.get([noop.remote() for _ in range(n)])
+            return n
+
+    tcallers = [TaskCaller.remote() for _ in range(4)]
+    ray_tpu.get([c.hammer.remote(5) for c in tcallers])
+
+    def multi_tasks(n=800):
+        ray_tpu.get([c.hammer.remote(n) for c in tcallers])
+        return n * len(tcallers)
+
+    results["multi_client_tasks_async"] = timeit(multi_tasks)
 
     # ---- put throughput (1 GiB in 64 MiB objects)
     blob = np.random.default_rng(0).bytes(64 << 20)
@@ -129,6 +149,74 @@ def main(out_path: str | None = None) -> dict:
         return n * len(blob) / 1e9
 
     results["single_client_put_gigabytes"] = timeit(put_gb, warmup=1, repeat=2)
+
+    # ---- multi-client put throughput (4 remote putters)
+    @ray_tpu.remote
+    class Putter:
+        def __init__(self):
+            import numpy as _np
+
+            self.blob = _np.random.default_rng(1).bytes(64 << 20)
+
+        def put_n(self, n):
+            import ray_tpu as rt
+
+            refs = [rt.put(self.blob) for _ in range(n)]
+            rt.free(refs)
+            return n * len(self.blob) / 1e9
+
+    putters = [Putter.remote() for _ in range(4)]
+    ray_tpu.get([p.put_n.remote(1) for p in putters])
+
+    def multi_put_gb(n=6):
+        gbs = ray_tpu.get([p.put_n.remote(n) for p in putters], timeout=300)
+        return sum(gbs)
+
+    results["multi_client_put_gigabytes"] = timeit(multi_put_gb, warmup=1,
+                                                   repeat=2)
+
+    # ---- plasma-store put/get call rates (small non-inline objects)
+    small = np.random.default_rng(2).bytes(256 * 1024)  # > inline threshold
+
+    def put_calls(n=300):
+        refs = [ray_tpu.put(small) for _ in range(n)]
+        ray_tpu.free(refs)
+        return n
+
+    results["single_client_put_calls_Plasma_Store"] = timeit(put_calls)
+
+    store_ref = ray_tpu.put(small)
+
+    def get_calls(n=1000):
+        for _ in range(n):
+            ray_tpu.get(store_ref)
+        return n
+
+    results["single_client_get_calls_Plasma_Store"] = timeit(get_calls)
+    ray_tpu.free([store_ref])
+
+    # ---- wait on 1k refs
+    refs_1k = [ray_tpu.put(b"x" * 1024) for _ in range(1000)]
+
+    def wait_1k(n=10):
+        for _ in range(n):
+            ready, _ = ray_tpu.wait(refs_1k, num_returns=1000, timeout=60)
+            assert len(ready) == 1000
+        return n
+
+    results["wait_1k_refs"] = timeit(wait_1k, warmup=1, repeat=2)
+
+    # ---- get an object containing 10k refs (nested-ref churn: pickling,
+    # containment pinning, deserialization re-creating 10k ObjectRefs)
+    inner_refs = [ray_tpu.put(b"y") for _ in range(10_000)]
+    t0 = time.perf_counter()
+    big_ref = ray_tpu.put(inner_refs)
+    got = ray_tpu.get(big_ref)
+    assert len(got) == 10_000
+    results["get_object_containing_10k_refs_s"] = time.perf_counter() - t0
+    ray_tpu.free([big_ref])
+    ray_tpu.free(refs_1k)
+    del inner_refs, got
 
     # ---- placement group create/remove
     from ray_tpu.util import placement_group, remove_placement_group
@@ -144,8 +232,8 @@ def main(out_path: str | None = None) -> dict:
                                                        repeat=2)
 
     ray_tpu.shutdown()
-    report = {"metrics": {k: round(v, 1) for k, v in results.items()},
-              "unit": "ops/s (put: GB/s)",
+    report = {"metrics": {k: round(v, 2) for k, v in results.items()},
+              "unit": "ops/s (put: GB/s; *_s: seconds)",
               "reference": {  # m5.16xlarge numbers from BASELINE.md §6
                   "1_1_actor_calls_sync": 2012,
                   "1_1_actor_calls_async": 8664,
@@ -153,6 +241,8 @@ def main(out_path: str | None = None) -> dict:
                   "single_client_tasks_sync": 981,
                   "multi_client_tasks_async": 21230,
                   "single_client_put_gigabytes": 19.9,
+                  "multi_client_put_gigabytes": 38.1,
+                  "single_client_get_calls_Plasma_Store": 10620,
                   "placement_group_create/removal": 765}}
     print(json.dumps(report, indent=2))
     if out_path:

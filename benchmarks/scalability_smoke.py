@@ -1,0 +1,95 @@
+"""Single-node scalability-envelope smokes, reference-comparable.
+
+Parity: `release/benchmarks/` single-node rows in BASELINE.md §6 —
+  10k args to one task            (ref: 18.8 s)
+  3k returns from one task        (ref: 6.1 s)
+  100k queued tasks sustained     (ref: 1M queued; scaled to CI budget)
+  get on a large object           (ref: 100 GiB in 32 s; scaled to 2 GiB)
+
+Run: `python benchmarks/scalability_smoke.py [--out results.json]`
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(out_path: str | None = None) -> dict:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=12)
+    results = {}
+
+    # ---- 10k args to one task
+    @ray_tpu.remote
+    def count_args(*args):
+        return len(args)
+
+    refs = [ray_tpu.put(i) for i in range(10_000)]
+    t0 = time.perf_counter()
+    assert ray_tpu.get(count_args.remote(*refs), timeout=600) == 10_000
+    results["10000_args_time_s"] = time.perf_counter() - t0
+    ray_tpu.free(refs)
+    del refs
+
+    # ---- 3k returns from one task
+    @ray_tpu.remote(num_returns=3000)
+    def many_returns():
+        return list(range(3000))
+
+    t0 = time.perf_counter()
+    out = ray_tpu.get(list(many_returns.remote()), timeout=600)
+    assert out[-1] == 2999
+    results["3000_returns_time_s"] = time.perf_counter() - t0
+
+    # ---- queued-task backlog: submit 100k no-deps tasks, drain
+    @ray_tpu.remote
+    def tiny():
+        return 1
+
+    n_queued = 100_000
+    t0 = time.perf_counter()
+    refs = [tiny.remote() for _ in range(n_queued)]
+    submit_s = time.perf_counter() - t0
+    got = ray_tpu.get(refs, timeout=3600)
+    results["100k_queued_tasks_submit_s"] = submit_s
+    results["100k_queued_tasks_total_s"] = time.perf_counter() - t0
+    assert len(got) == n_queued
+    del refs, got
+
+    # ---- large-object put+get round trip (2 GiB)
+    big = np.ones((2 << 30,), dtype=np.uint8)
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(big)
+    arr = ray_tpu.get(ref)
+    assert arr.shape == big.shape
+    results["large_object_2gib_time_s"] = time.perf_counter() - t0
+    del arr
+    ray_tpu.free([ref])
+
+    ray_tpu.shutdown()
+    report = {"metrics": {k: round(v, 2) for k, v in results.items()},
+              "unit": "seconds",
+              "reference": {"10000_args_time_s": 18.8,
+                            "3000_returns_time_s": 6.1,
+                            "large_object_time_s": "32.0 (100 GiB)"}}
+    print(json.dumps(report, indent=2))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+    main(args.out)

@@ -13,6 +13,7 @@ _CORE_API = (
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "method", "get_runtime_context", "nodes", "get_actor",
     "available_resources", "cluster_resources", "ObjectRef", "actor", "free",
+    "put_device",
 )
 
 

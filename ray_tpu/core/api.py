@@ -364,6 +364,15 @@ def remote(*args, **options):
     return wrap
 
 
+def put_device(value) -> ObjectRef:
+    """Store a device-resident value (e.g. a jax.Array) in THIS process's
+    device object store — zero-copy for same-process consumers, host-staged
+    transfer for remote ones (reference RDT `tensor_transport` design,
+    `gpu_object_manager.py:22-56`)."""
+    _auto_init()
+    return _global_client().put_device(value)
+
+
 def method(**options):
     def deco(fn):
         fn._ray_tpu_method_options = options

@@ -43,7 +43,16 @@ class CoreClient:
         self.local_metas: Dict[ObjectID, ObjectMeta] = {}
         self._registered: set = set()     # object ids known to head
         self.fn_manager = FunctionManager(self)
-        self._extra_handlers = handlers or {}
+        from ray_tpu.core.device_store import DeviceObjectStore
+
+        self.device_store = DeviceObjectStore()
+        self._extra_handlers = dict(handlers or {})
+        # head→process push when the directory drops one of our device
+        # objects (refcount reached zero)
+        self._extra_handlers.setdefault("free_device_object",
+                                        self._on_free_device_object)
+        self._extra_handlers.setdefault("evicted_object",
+                                        self._on_evicted_object)
         self._direct: Dict[Tuple[str, int], protocol.Connection] = {}
         self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
         self.loop = asyncio.new_event_loop()
@@ -82,7 +91,47 @@ class CoreClient:
         asyncio.set_event_loop(self.loop)
         self.loop.run_forever()
 
+    async def _on_free_device_object(self, object_id):
+        self.device_store.pop(ObjectID(object_id))
+        return True
+
+    async def _on_evicted_object(self, meta):
+        """Head evicted an object we own: drop our mapping, accounting and
+        caches (auto-eviction must clean the producer like manual free())."""
+        oid = meta.object_id
+        self.local_metas.pop(oid, None)
+        self._registered.discard(oid)
+        pulled = self._drop_pulled(oid)
+        for m in (pulled, meta):
+            if m is None:
+                continue
+            try:
+                self.store.free(m)
+            except Exception:
+                pass
+        return True
+
+    async def _on_fetch_device_object(self, object_id):
+        """Another process wants a host snapshot of a device object we
+        own (reference: RDT out-of-band tensor fetch)."""
+        oid = ObjectID(object_id)
+        try:
+            value = self.device_store.get(oid)
+        except KeyError:
+            raise FileNotFoundError(f"device object {oid} not here") from None
+        from ray_tpu.core.device_store import is_device_value
+
+        was_jax = is_device_value(value)
+        ser = serialization.serialize(value)  # jax→host numpy inside
+        import pickle as _pickle
+
+        return {"data": _pickle.PickleBuffer(ser.to_bytes()),
+                "was_jax": was_jax}
+
     def start(self, direct_handlers: Optional[dict] = None) -> None:
+        direct_handlers = dict(direct_handlers or {})
+        direct_handlers.setdefault("fetch_device_object",
+                                   self._on_fetch_device_object)
         self._loop_thread.start()
         fut = asyncio.run_coroutine_threadsafe(
             self._start_async(direct_handlers or {}), self.loop)
@@ -164,10 +213,84 @@ class CoreClient:
         ser = serialization.serialize(value)
         meta = self.store.put_serialized(oid, ser)
         meta.node_id = self.node_id
+        meta.owner = self.worker_id
         meta.contained = [o.binary() for o in ser.contained] or None
         self.local_metas[oid] = meta
         self._register_meta(meta)
         return ObjectRef(oid)
+
+    def put_device(self, value: Any) -> ObjectRef:
+        """Store a device-resident value (jax.Array or pytree) in THIS
+        process's device store; only the meta travels. Same-process get()
+        returns the living object zero-copy; cross-process get() fetches a
+        host snapshot from us (reference RDT GPUObjectStore design)."""
+        from ray_tpu.core import device_store as ds
+
+        oid = ObjectID.generate()
+        size = self.device_store.put(oid, value)
+        meta = ObjectMeta(oid, size, "device")
+        meta.node_id = self.node_id
+        meta.owner = self.worker_id
+        meta.inline = None
+        # record on the meta whether top-level is a jax.Array so consumers
+        # re-materialize on their device without asking us again
+        meta.segment = "jax" if ds.is_device_value(value) else None
+        self.local_metas[oid] = meta
+        self._register_meta(meta)
+        return ObjectRef(oid)
+
+    def store_device_result(self, oid: ObjectID, value: Any) -> ObjectMeta:
+        """Actor-method result kept on device (tensor_transport option).
+
+        Registered with the head (unlike plain actor replies): the head's
+        refcount-driven free is what releases the value from our device
+        store — without it, every device result would pin HBM for the
+        actor's lifetime."""
+        from ray_tpu.core import device_store as ds
+
+        size = self.device_store.put(oid, value)
+        meta = ObjectMeta(oid, size, "device")
+        meta.node_id = self.node_id
+        meta.owner = self.worker_id
+        meta.segment = "jax" if ds.is_device_value(value) else None
+        self.local_metas[oid] = meta
+        # non-blocking registration: this runs on the loop for async actor
+        # methods, where a blocking request would deadlock; the consumer
+        # gets the meta from the reply, the head entry only drives lifetime
+        self._registered.add(oid)
+        self.loop.call_soon_threadsafe(
+            functools.partial(self.conn.push, "put_meta", meta=meta))
+        return meta
+
+    @staticmethod
+    def _decode_device_reply(rep) -> Any:
+        from ray_tpu.core.device_store import rematerialize
+
+        value = serialization.loads(bytes(rep["data"]))
+        return rematerialize(value, rep.get("was_jax", False))
+
+    def _get_device_value(self, meta: ObjectMeta) -> Any:
+        """Resolve a kind=='device' meta: living value when we own it,
+        host-staged fetch from the owner otherwise."""
+        oid = meta.object_id
+        if self.device_store.contains(oid):
+            return self.device_store.get(oid)
+        return self._decode_device_reply(
+            self._call(self._fetch_device_async(meta)))
+
+    async def _fetch_device_async(self, meta: ObjectMeta):
+        addr = await self.conn.request("worker_address",
+                                       worker_id=meta.owner.binary())
+        if addr is None:
+            raise ObjectLostError(
+                f"device object {meta.object_id} lost: owner process gone")
+        host, port = addr
+        conn = self._data_conns.get((host, port))
+        if conn is None or conn.closed:
+            conn = await protocol.connect(host, port, name=f"dev-{port}")
+            self._data_conns[(host, port)] = conn
+        return await conn.request("fetch_device_object",
+                                  object_id=meta.object_id.binary())
 
     def put_serialized(self, ser: SerializedObject, error: bool = False,
                        register: bool = True) -> ObjectMeta:
@@ -175,6 +298,7 @@ class CoreClient:
         meta = self.store.put_serialized(oid, ser)
         meta.error = error
         meta.node_id = self.node_id
+        meta.owner = self.worker_id
         meta.contained = [o.binary() for o in ser.contained] or None
         self.local_metas[oid] = meta
         if register:
@@ -189,10 +313,19 @@ class CoreClient:
         # node-stamped so a cross-node consumer of an UNregistered meta
         # (direct actor reply) can still find our node's data server
         meta.node_id = self.node_id
+        meta.owner = self.worker_id
         meta.contained = [o.binary() for o in ser.contained] or None
         self.local_metas[oid] = meta
         if register:
             self._register_meta(meta)
+        elif meta.contained:
+            # a direct actor reply embedding refs MUST reach the head: the
+            # containment pin is what keeps the inner objects alive once
+            # the producer drops its own refs. Non-blocking push — this
+            # path runs on the loop for async actor methods.
+            self._registered.add(oid)
+            self.loop.call_soon_threadsafe(
+                functools.partial(self.conn.push, "put_meta", meta=meta))
         return meta
 
     def _register_meta(self, meta: ObjectMeta) -> None:
@@ -349,9 +482,17 @@ class CoreClient:
                 pass
 
     def _read_value(self, meta: ObjectMeta) -> Any:
+        if meta.kind == "device":
+            return self._get_device_value(meta)
         return serialization.deserialize(self.read_serialized(meta))
 
     async def _read_value_async(self, meta: ObjectMeta) -> Any:
+        if meta.kind == "device":
+            oid = meta.object_id
+            if self.device_store.contains(oid):
+                return self.device_store.get(oid)
+            return self._decode_device_reply(
+                await self._fetch_device_async(meta))
         return serialization.deserialize(
             await self.read_serialized_async(meta))
 

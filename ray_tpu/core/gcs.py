@@ -216,6 +216,7 @@ class GeneratorState:
         self.items: List[bytes] = []      # yielded object ids, in order
         self.delivered: Set[int] = set()  # indices handed to the consumer
         self.done = False
+        self.released = False             # consumer dropped the generator
         self.backpressure = backpressure
         self.consumed = 0                 # highest index the consumer fetched
         self.consumer_waiters: List[asyncio.Future] = []
@@ -509,6 +510,14 @@ class Head:
                 canonical.segment = meta.segment
             return True
 
+        async def worker_address(worker_id):
+            """Direct-server address of a live worker (device-object
+            fetches go straight to the owning process)."""
+            w = self.workers.get(WorkerID(worker_id))
+            if w is None:
+                return None
+            return (w.host or "127.0.0.1", w.port)
+
         async def node_data_addr(node_id):
             """Data-server address of a node (for pulls of unregistered
             direct actor-reply objects, which carry only a node_id)."""
@@ -736,6 +745,10 @@ class Head:
         async def generator_yield(gen_id, meta, backpressure=0):
             gs = _gen(gen_id, backpressure)
             self._seal(meta)
+            if gs.released:
+                # consumer is gone: nothing will ever fetch this item —
+                # don't pin or queue it (it evicts once unreferenced)
+                return True
             # queued items are pinned until the consumer takes delivery
             # (nobody holds a ref to them yet)
             self._pin(meta.object_id)
@@ -754,6 +767,8 @@ class Head:
             gs.done = True
             gs.wake(gs.consumer_waiters)
             gs.wake(gs.producer_waiters)
+            if gs.released:
+                self.generators.pop(gen_id, None)
             return True
 
         async def generator_next(gen_id, index):
@@ -782,16 +797,20 @@ class Head:
 
         async def generator_release(gen_id):
             """Consumer dropped its ObjectRefGenerator: unpin undelivered
-            items and forget the stream (abandoned generators must not pin
-            their queued items forever)."""
-            gs = self.generators.pop(gen_id, None)
-            if gs is not None:
-                for idx, item in enumerate(gs.items):
-                    if idx not in gs.delivered:
-                        self._unpin(ObjectID(item))
-                gs.done = True
-                gs.wake(gs.consumer_waiters)
-                gs.wake(gs.producer_waiters)
+            items and mark the stream released — NOT popped, or a still-
+            producing task's later yields would recreate a fresh state
+            whose pins nothing ever drops."""
+            gs = self.generators.get(gen_id)
+            if gs is None:
+                return True
+            for idx, item in enumerate(gs.items):
+                if idx not in gs.delivered:
+                    self._unpin(ObjectID(item))
+            gs.released = True
+            gs.wake(gs.consumer_waiters)
+            gs.wake(gs.producer_waiters)
+            if gs.done:
+                self.generators.pop(gen_id, None)
             return True
 
         async def cancel_task(return_id, force=False):
@@ -956,11 +975,29 @@ class Head:
         """Free an object's storage wherever it lives: locally when this
         process can reach it, and via the owning node's daemon otherwise
         (real multi-host, or namespace isolation)."""
+        if meta.kind == "device":
+            w = self.workers.get(meta.owner) if meta.owner is not None else None
+            if w is not None and w.conn is not None and not w.conn.closed:
+                try:
+                    w.conn.push("free_device_object",
+                                object_id=meta.object_id.binary())
+                except Exception:
+                    pass
+            return
         node = self.nodes.get(meta.node_id) if meta.node_id is not None else None
         if (node is not None and node.conn is not None and node.alive
                 and meta.kind in ("shm", "arena", "spilled")):
             try:
                 node.conn.push("free_object", meta=meta)
+            except Exception:
+                pass
+        # the owning process must also drop its mapping/accounting — a
+        # producer that never sees the eviction keeps the (unlinked) pages
+        # mapped and its store's `used` counter inflated forever
+        w = self.workers.get(meta.owner) if meta.owner is not None else None
+        if w is not None and w.conn is not None and not w.conn.closed:
+            try:
+                w.conn.push("evicted_object", meta=meta)
             except Exception:
                 pass
         if self.store.readable(meta):
@@ -985,10 +1022,24 @@ class Head:
         if existing is not None:
             # objects are immutable: first seal wins (a racing retry must not
             # replace a good value, especially not with its own error).
-            # Arena entries are keyed by object id — the duplicate's storage
-            # IS the winner's entry, so freeing it would destroy the data.
-            if not (meta.kind == "arena" and existing.kind == "arena"):
-                self._free_meta(meta)  # duplicate may live on a remote node
+            # Only free the loser's storage when it is DISTINCT from the
+            # winner's — a re-registration of the same meta (a client
+            # passing an adopted actor-reply ref onward) or an arena/device
+            # entry keyed by object id refers to the winner's own storage,
+            # and freeing it would destroy the live object.
+            same_storage = (
+                meta.kind == "inline"
+                or (meta.kind == "arena" and existing.kind == "arena")
+                or (meta.kind == "device" and existing.kind == "device")
+                or (meta.kind == "shm" and existing.kind == "shm"
+                    and meta.segment == existing.segment)
+                or (meta.kind == "spilled" and existing.kind == "spilled"
+                    and meta.spill_path == existing.spill_path)
+                # re-registration of a stale pre-spill meta: the canonical
+                # entry moved to disk but the segment name is its old home
+                or (existing.kind == "spilled" and meta.kind == "shm"))
+            if not same_storage:
+                self._free_meta(meta)  # a genuinely distinct duplicate copy
             return
         self.objects[meta.object_id] = meta
         for b in (meta.contained or []):
@@ -1339,7 +1390,8 @@ class Head:
         # and lazily reconstruct from lineage when next requested (waiters
         # already parked get kicked now)
         lost = [oid for oid, m in self.objects.items()
-                if m.node_id == node.node_id and m.kind in ("shm", "arena")]
+                if m.node_id == node.node_id
+                and m.kind in ("shm", "arena", "device")]
         for oid in lost:
             meta = self.objects.pop(oid)
             self._evict_due.pop(oid, None)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import threading
+from collections import deque
 from typing import Dict, List, Optional
 
 from ray_tpu.core.ids import ObjectID
@@ -46,44 +47,40 @@ def activate(tracker: Optional["RefTracker"]) -> None:
 
 
 class RefTracker:
-    """Per-process live-ObjectRef counts; flushes transitions to the head."""
+    """Per-process live-ObjectRef counts; flushes transitions to the head.
+
+    Lock-free event intake: `inc`/`dec` only append to a deque —
+    `ObjectRef.__del__` can fire from a GC triggered at ANY allocation
+    point (including inside this module), so taking a lock there would
+    self-deadlock the thread that owns it. Counting and transition
+    detection happen in `_flush`, which drains the deque in append order
+    under a lock no __del__ path ever touches."""
 
     def __init__(self, client):
         self.client = client
         self.counts: Dict[ObjectID, int] = {}
-        self.lock = threading.Lock()
-        # ordered op log: (is_inc, oid_bytes) — inc/dec interleaving for
-        # one object within a batch must reach the head in order, or a
-        # drop-then-reacquire inside one flush window reads as a net drop
-        self._ops: List[tuple] = []
+        self._events: "deque" = deque()  # (is_inc, ObjectID), append-only
+        self._flush_lock = threading.Lock()
+        self._ops: List[tuple] = []      # unsent ordered transitions
         self._flush_scheduled = False
         self.enabled = os.environ.get("RAY_TPU_REFCOUNT", "1") != "0"
 
     def inc(self, oid: ObjectID) -> None:
         if not self.enabled:
             return
-        with self.lock:
-            c = self.counts.get(oid, 0) + 1
-            self.counts[oid] = c
-            if c == 1:
-                self._ops.append((True, oid.binary()))
-                self._schedule()
+        self._events.append((True, oid))
+        self._schedule()
 
     def dec(self, oid: ObjectID) -> None:
         if not self.enabled:
             return
-        with self.lock:
-            c = self.counts.get(oid, 0) - 1
-            if c > 0:
-                self.counts[oid] = c
-                return
-            self.counts.pop(oid, None)
-            self._ops.append((False, oid.binary()))
-            self._schedule()
+        self._events.append((False, oid))
+        self._schedule()
 
     def _schedule(self) -> None:
-        # lock held. Batch transitions for FLUSH_S so ref churn costs one
-        # push, not one RPC per ref (reference: batched WaitForRefRemoved).
+        # benign race on the flag: worst case an extra no-op flush.
+        # Batch for FLUSH_S so ref churn costs one push, not one RPC per
+        # ref (reference: batched WaitForRefRemoved).
         if self._flush_scheduled:
             return
         self._flush_scheduled = True
@@ -93,27 +90,44 @@ class RefTracker:
         except RuntimeError:
             self._flush_scheduled = False  # loop closed (shutdown)
 
-    def _flush(self) -> None:
-        with self.lock:
-            ops = self._ops
-            self._ops = []
-            self._flush_scheduled = False
-        if not ops:
-            return
-        conn = self.client.conn
-        sent = False
-        if conn is not None and not conn.closed:
+    def _drain(self) -> None:
+        """Fold queued events into counts; emit 0<->1 transitions in event
+        order. _flush_lock held."""
+        while True:
             try:
-                conn.push("ref_update", ops=ops)
-                sent = True
+                is_inc, oid = self._events.popleft()
+            except IndexError:
+                return
+            if is_inc:
+                c = self.counts.get(oid, 0) + 1
+                self.counts[oid] = c
+                if c == 1:
+                    self._ops.append((True, oid.binary()))
+            else:
+                c = self.counts.get(oid, 0) - 1
+                if c > 0:
+                    self.counts[oid] = c
+                else:
+                    self.counts.pop(oid, None)
+                    self._ops.append((False, oid.binary()))
+
+    def _flush(self) -> None:
+        # drain + send under one lock: a concurrent flush slipping a newer
+        # batch onto the wire while a failed older batch awaits requeue
+        # would reorder inc/dec at the head
+        with self._flush_lock:
+            self._flush_scheduled = False
+            self._drain()
+            if not self._ops:
+                return
+            conn = self.client.conn
+            if conn is None or conn.closed:
+                return  # ops kept; retried on the next transition's flush
+            try:
+                conn.push("ref_update", ops=self._ops)
+                self._ops = []
             except Exception:
-                pass
-        if not sent:
-            # requeue in order: dropping a batch would lose an inc (eviction
-            # of a live object) or a dec (permanent leak)
-            with self.lock:
-                self._ops = ops + self._ops
-                self._schedule()
+                pass  # kept for retry, order preserved
 
     def flush_now(self) -> None:
         """Synchronous flush (tests / shutdown)."""

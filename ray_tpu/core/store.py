@@ -483,9 +483,14 @@ class SharedMemoryStore:
             self.used -= (meta.size if meta else shm.size)
             try:
                 shm.close()
-                shm.unlink()
-            except (FileNotFoundError, BufferError):
+            except BufferError:
                 pass  # exported views keep the mapping alive; data persists
+            try:
+                # independent of close(): a BufferError above must not leak
+                # the /dev/shm file for the machine's lifetime
+                shm.unlink()
+            except FileNotFoundError:
+                pass
             if meta is not None:
                 # readers that already attached keep a valid mapping; new
                 # readers see the updated meta and read the spill file

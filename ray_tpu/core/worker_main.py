@@ -53,6 +53,7 @@ class WorkerRuntime:
         self.actor_executors: Dict[str, ThreadPoolExecutor] = {}
         self.actor_semaphores: Dict[str, asyncio.Semaphore] = {}
         self.actor_method_groups: Dict[str, str] = {}
+        self.actor_method_transport: Dict[str, str] = {}
         self.actor_instance = None
         self.actor_id = None
         self.shutdown_event = threading.Event()
@@ -242,6 +243,9 @@ class WorkerRuntime:
         self.actor_method_groups = {
             m: meta.get("concurrency_group") for m, meta in
             spec.get("methods", {}).items() if meta.get("concurrency_group")}
+        self.actor_method_transport = {
+            m: meta.get("tensor_transport") for m, meta in
+            spec.get("methods", {}).items() if meta.get("tensor_transport")}
         self.actor_id = ActorID(spec["actor_id"])
         self.client.current_actor_id = self.actor_id
 
@@ -286,7 +290,11 @@ class WorkerRuntime:
                 try:
                     a, kw = await self._resolve_args_async(args)
                     result = await fn(*a, **kw)
-                    meta = self.client.store_result(rid, result, register=False)
+                    if self.actor_method_transport.get(method) == "device":
+                        meta = self.client.store_device_result(rid, result)
+                    else:
+                        meta = self.client.store_result(rid, result,
+                                                        register=False)
                 except BaseException as e:  # noqa: BLE001
                     err = e if isinstance(e, TaskError) else TaskError(
                         repr(e), traceback.format_exc())
@@ -308,6 +316,10 @@ class WorkerRuntime:
                     f = getattr(self.actor_instance, method)
                 a, kw = self._resolve_args(args)
                 result = f(*a, **kw)
+                if self.actor_method_transport.get(method) == "device":
+                    # result stays on-device in this process; only the
+                    # meta rides the reply (RDT tensor_transport)
+                    return self.client.store_device_result(rid, result)
                 return self.client.store_result(rid, result, register=False)
             except BaseException as e:  # noqa: BLE001
                 err = e if isinstance(e, TaskError) else TaskError(

@@ -143,6 +143,23 @@ def test_ref_in_actor_state_pins(cluster):
     assert _wait_gone(inner_oid, timeout=15)
 
 
+def test_nested_ref_in_actor_reply_pinned(cluster):
+    """Refs embedded in a direct actor REPLY must survive the producer
+    dropping its own refs (containment registers the reply with the head)."""
+
+    @ray_tpu.remote
+    class Maker:
+        def make(self):
+            inner = ray_tpu.put(np.full((ARR,), 6, dtype=np.uint8))
+            return {"x": inner}  # actor drops its local ref on return
+
+    m = Maker.remote()
+    box = ray_tpu.get(m.make.remote(), timeout=30)
+    time.sleep(1.5)  # several grace windows after the producer's drop
+    assert int(ray_tpu.get(box["x"], timeout=30).sum()) == 6 * ARR
+    ray_tpu.kill(m)
+
+
 def test_manual_free_still_immediate(cluster):
     ref = ray_tpu.put(np.ones((ARR,), dtype=np.uint8))
     oid = ref.hex()
