@@ -29,6 +29,10 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def phase(name: str) -> None:
+    print(f"[microbenchmark] {name}", file=sys.stderr, flush=True)
+
+
 def timeit(fn, warmup: int = 1, repeat: int = 3) -> float:
     """Runs/sec of fn() (fn reports its own unit count via return value)."""
     for _ in range(warmup):
@@ -44,7 +48,7 @@ def timeit(fn, warmup: int = 1, repeat: int = 3) -> float:
 def main(out_path: str | None = None) -> dict:
     import ray_tpu
 
-    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=12)
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
     results = {}
 
     @ray_tpu.remote
@@ -68,6 +72,7 @@ def main(out_path: str | None = None) -> dict:
             ray_tpu.get(a.ping.remote())
         return n
 
+    phase("1_1_actor_calls_sync")
     results["1_1_actor_calls_sync"] = timeit(sync_calls)
 
     # ---- 1:1 async actor calls (pipelined submissions, one batch get)
@@ -75,6 +80,7 @@ def main(out_path: str | None = None) -> dict:
         ray_tpu.get([a.ping.remote() for _ in range(n)])
         return n
 
+    phase("1_1_actor_calls_async")
     results["1_1_actor_calls_async"] = timeit(async_calls)
 
     # ---- n:n async actor calls: n CALLER actors each hammering its own
@@ -101,6 +107,7 @@ def main(out_path: str | None = None) -> dict:
         ray_tpu.get([c.hammer.remote(n) for c in callers])
         return n * len(callers)
 
+    phase("n_n_actor_calls_async")
     results["n_n_actor_calls_async"] = timeit(nn_calls)
 
     # ---- single-client tasks sync
@@ -111,6 +118,11 @@ def main(out_path: str | None = None) -> dict:
             ray_tpu.get(noop.remote())
         return n
 
+    # release the n:n phase's 8 actor workers before later phases need them
+    for h in callers + sinks:
+        ray_tpu.kill(h)
+
+    phase("single_client_tasks_sync")
     results["single_client_tasks_sync"] = timeit(tasks_sync)
 
     # ---- single-client tasks async (pipelined)
@@ -118,6 +130,7 @@ def main(out_path: str | None = None) -> dict:
         ray_tpu.get([noop.remote() for _ in range(n)])
         return n
 
+    phase("single_client_tasks_async")
     results["single_client_tasks_async"] = timeit(tasks_async)
 
     # ---- multi-client tasks async: the reference runs N separate driver
@@ -138,6 +151,7 @@ def main(out_path: str | None = None) -> dict:
         ray_tpu.get([c.hammer.remote(n) for c in tcallers])
         return n * len(tcallers)
 
+    phase("multi_client_tasks_async")
     results["multi_client_tasks_async"] = timeit(multi_tasks)
 
     # ---- put throughput (1 GiB in 64 MiB objects)
@@ -148,6 +162,10 @@ def main(out_path: str | None = None) -> dict:
         ray_tpu.free(refs)
         return n * len(blob) / 1e9
 
+    for h in tcallers:
+        ray_tpu.kill(h)
+
+    phase("single_client_put_gigabytes")
     results["single_client_put_gigabytes"] = timeit(put_gb, warmup=1, repeat=2)
 
     # ---- multi-client put throughput (4 remote putters)
@@ -172,8 +190,12 @@ def main(out_path: str | None = None) -> dict:
         gbs = ray_tpu.get([p.put_n.remote(n) for p in putters], timeout=300)
         return sum(gbs)
 
+    phase("multi_client_put_gigabytes")
     results["multi_client_put_gigabytes"] = timeit(multi_put_gb, warmup=1,
                                                    repeat=2)
+
+    for h in putters:
+        ray_tpu.kill(h)
 
     # ---- plasma-store put/get call rates (small non-inline objects)
     small = np.random.default_rng(2).bytes(256 * 1024)  # > inline threshold
@@ -183,6 +205,7 @@ def main(out_path: str | None = None) -> dict:
         ray_tpu.free(refs)
         return n
 
+    phase("single_client_put_calls_Plasma_Store")
     results["single_client_put_calls_Plasma_Store"] = timeit(put_calls)
 
     store_ref = ray_tpu.put(small)
@@ -192,6 +215,7 @@ def main(out_path: str | None = None) -> dict:
             ray_tpu.get(store_ref)
         return n
 
+    phase("single_client_get_calls_Plasma_Store")
     results["single_client_get_calls_Plasma_Store"] = timeit(get_calls)
     ray_tpu.free([store_ref])
 
@@ -204,6 +228,7 @@ def main(out_path: str | None = None) -> dict:
             assert len(ready) == 1000
         return n
 
+    phase("wait_1k_refs")
     results["wait_1k_refs"] = timeit(wait_1k, warmup=1, repeat=2)
 
     # ---- get an object containing 10k refs (nested-ref churn: pickling,
@@ -213,6 +238,7 @@ def main(out_path: str | None = None) -> dict:
     big_ref = ray_tpu.put(inner_refs)
     got = ray_tpu.get(big_ref)
     assert len(got) == 10_000
+    phase("get_object_containing_10k_refs_s")
     results["get_object_containing_10k_refs_s"] = time.perf_counter() - t0
     ray_tpu.free([big_ref])
     ray_tpu.free(refs_1k)
@@ -228,6 +254,7 @@ def main(out_path: str | None = None) -> dict:
             remove_placement_group(pg)
         return n
 
+    phase("placement_group_create/removal")
     results["placement_group_create/removal"] = timeit(pg_cycle, warmup=0,
                                                        repeat=2)
 

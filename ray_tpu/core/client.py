@@ -89,6 +89,7 @@ class CoreClient:
     # ----------------------------------------------------------- lifecycle
     def _run_loop(self):
         asyncio.set_event_loop(self.loop)
+        protocol.enable_eager_tasks(self.loop)
         self.loop.run_forever()
 
     async def _on_free_device_object(self, object_id):
@@ -258,8 +259,7 @@ class CoreClient:
         # methods, where a blocking request would deadlock; the consumer
         # gets the meta from the reply, the head entry only drives lifetime
         self._registered.add(oid)
-        self.loop.call_soon_threadsafe(
-            functools.partial(self.conn.push, "put_meta", meta=meta))
+        self.head_push("put_meta", meta=meta)
         return meta
 
     @staticmethod
@@ -324,15 +324,24 @@ class CoreClient:
             # the producer drops its own refs. Non-blocking push — this
             # path runs on the loop for async actor methods.
             self._registered.add(oid)
-            self.loop.call_soon_threadsafe(
-                functools.partial(self.conn.push, "put_meta", meta=meta))
+            self.head_push("put_meta", meta=meta)
         return meta
+
+    def head_push(self, method: str, **kwargs) -> None:
+        """Fire-and-forget message to the head, thread-safe. FIFO with
+        every other message this client sends (incl. submit pushes), so
+        registration-before-submit ordering is preserved without paying a
+        blocking round trip."""
+        self.loop.call_soon_threadsafe(
+            functools.partial(self.conn.push, method, **kwargs))
 
     def _register_meta(self, meta: ObjectMeta) -> None:
         if meta.object_id in self._registered:
             return
         self._registered.add(meta.object_id)
-        self._call(self.conn.request("put_meta", meta=meta))
+        # push, not request: consumers that race ahead block in the head's
+        # get_meta until this lands (same-connection FIFO per process)
+        self.head_push("put_meta", meta=meta)
 
     def ensure_registered(self, ref: ObjectRef) -> None:
         if ref.id not in self.local_metas:

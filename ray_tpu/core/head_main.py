@@ -17,6 +17,9 @@ from ray_tpu.core.gcs import Head
 
 
 async def amain(args) -> None:
+    from ray_tpu.core.protocol import enable_eager_tasks
+
+    enable_eager_tasks(asyncio.get_running_loop())
     if args.restore:
         # a SIGKILLed predecessor leaves its shm arena behind; object data
         # died with its owner processes, so clear it before re-creating

@@ -154,6 +154,7 @@ class NodeDaemon:
 
 
 async def amain(args):
+    protocol.enable_eager_tasks(asyncio.get_running_loop())
     host, port_s = args.address.rsplit(":", 1)
     daemon = NodeDaemon(
         host, int(port_s), num_cpus=args.num_cpus,

@@ -205,8 +205,11 @@ class WorkerRuntime:
                 retire = self._fn_calls[fn_key] >= max_calls
             try:
                 if retire:
-                    self.client.head_request("worker_retiring")
-                self.client.head_request("task_done", task_id=spec["task_id"].binary())
+                    self.client.head_push("worker_retiring")
+                # push: the completion signal needs no reply, and a blocking
+                # round trip here caps pipelined task throughput
+                self.client.head_push("task_done",
+                                      task_id=spec["task_id"].binary())
             except Exception:
                 pass
             if retire:

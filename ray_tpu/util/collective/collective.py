@@ -45,8 +45,8 @@ def init_collective_group(world_size: int, rank: int, backend: str = "kv",
             raise ValueError(
                 "backend='xla' groups are in-process device gangs; build one "
                 "with ray_tpu.util.collective.XlaCollectiveGroup(devices)")
-        _groups[group_name] = KVCollectiveGroup(
-            _client(), group_name, world_size, rank)
+        _groups[group_name] = _make_group(backend, group_name, world_size,
+                                          rank)
 
 
 def create_collective_group(actors: list, world_size: int, ranks: List[int],
@@ -64,6 +64,14 @@ def create_collective_group(actors: list, world_size: int, ranks: List[int],
         raise RuntimeError(f"collective group {group_name!r} already exists")
 
 
+def _make_group(backend, group_name: str, world_size: int, rank: int):
+    if backend == Backend.XLA_MULTIHOST:
+        from ray_tpu.util.collective.xla_multihost import XlaMultihostGroup
+
+        return XlaMultihostGroup(_client(), group_name, world_size, rank)
+    return KVCollectiveGroup(_client(), group_name, world_size, rank)
+
+
 def _lazy_attach(group_name: str) -> KVCollectiveGroup:
     blob = _client().kv_get(_META_NS, group_name.encode())
     if blob is None:
@@ -75,8 +83,8 @@ def _lazy_attach(group_name: str) -> KVCollectiveGroup:
     if actor_id is None or actor_id.hex() not in meta["ranks"]:
         raise RuntimeError(
             f"this process is not a member of group {group_name!r}")
-    group = KVCollectiveGroup(_client(), group_name, meta["world_size"],
-                              meta["ranks"][actor_id.hex()])
+    group = _make_group(Backend(meta.get("backend", "kv")), group_name,
+                        meta["world_size"], meta["ranks"][actor_id.hex()])
     _groups[group_name] = group
     return group
 

@@ -25,6 +25,7 @@ class Backend:
     """Backend name validation (reference: types.py Backend class)."""
 
     XLA = "xla"      # in-process jax mesh collectives (ICI/DCN data plane)
+    XLA_MULTIHOST = "xla-multihost"  # cross-process jax.distributed gang
     KV = "kv"        # cross-process via head KV + shm object store (CPU/CI)
     NCCL = "nccl"    # unavailable on TPU — rejected with guidance
     GLOO = "gloo"    # alias for KV (drop-in for reference code)
@@ -34,6 +35,8 @@ class Backend:
         backend = str(name).lower()
         if backend in ("xla", "ici", "tpu"):
             return Backend.XLA
+        if backend in ("xla-multihost", "xla_multihost", "xmh", "multihost"):
+            return Backend.XLA_MULTIHOST
         if backend in ("kv", "gloo", "torch_gloo", "cpu"):
             return Backend.KV
         if backend == "nccl":

@@ -1,0 +1,261 @@
+"""Cross-process device collective group: `jax.distributed` sub-cluster.
+
+Parity target: the reference's NCCL collective group
+(`python/ray/util/collective/collective_group/nccl_collective_group.py:128`)
+— N actor PROCESSES form a gang whose collectives run on the device plane.
+TPU-native shape: rendezvous through the head KV (the reference stores the
+NCCL uniqueId in a named actor), then `jax.distributed.initialize` welds
+the member processes into one JAX cluster; a global 1-device-per-process
+mesh is built and collectives execute as `shard_map` programs over it, so
+the data plane is XLA's ICI/DCN collectives — not host relays.
+
+CI story (SURVEY §4.2 pattern 3): on CPU the same code runs with the gloo
+CPU-collectives implementation and `--xla_force_host_platform_device_count=1`
+per process — the fake-backend pattern the reference uses for NCCL tests.
+
+p2p send/recv are host-staged through the KV store for now: XLA exposes
+ppermute (a full collective) but no pairwise primitive; a device-plane p2p
+rides the same mesh once ICI send/recv lands.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.types import ReduceOp
+
+_COORD_NS = "collective_xmh"
+_POLL_S = 0.05
+
+
+def _reduce_op(op: ReduceOp):
+    from jax import lax
+
+    def pprod(a, ax):
+        # XLA has no pprod primitive: all-gather the factors and multiply
+        g = lax.all_gather(a, ax)          # [world, ...]
+        return g.prod(axis=0)
+
+    return {ReduceOp.SUM: lambda a, ax: lax.psum(a, ax),
+            ReduceOp.MAX: lambda a, ax: lax.pmax(a, ax),
+            ReduceOp.MIN: lambda a, ax: lax.pmin(a, ax),
+            ReduceOp.PRODUCT: pprod}[op]
+
+
+class XlaMultihostGroup:
+    """One member process of a cross-process device collective gang."""
+
+    backend_name = "xla-multihost"
+
+    def __init__(self, client, group_name: str, world_size: int, rank: int,
+                 timeout_s: float = 60.0):
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range for world {world_size}")
+        self._client = client
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._kv_fallback = None  # lazily built for host-staged p2p
+        self._init_jax_cluster(timeout_s)
+
+    # ------------------------------------------------------------ rendezvous
+    def _coord_key(self) -> bytes:
+        return f"{self.group_name}:coordinator".encode()
+
+    def _init_jax_cluster(self, timeout_s: float) -> None:
+        import jax
+
+        # env check ONLY — jax.default_backend() would initialize XLA,
+        # which must not happen before jax.distributed.initialize
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # the reference's mock-NCCL pattern: same code path, CPU gloo
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        if self.rank == 0:
+            import socket
+
+            with socket.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+            host = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+            addr = f"{host}:{port}"
+            self._client.kv_put(
+                _COORD_NS, self._coord_key(),
+                pickle.dumps({"addr": addr, "ts": time.time()}),
+                overwrite=True)
+        else:
+            deadline = time.monotonic() + timeout_s
+            while True:
+                blob = self._client.kv_get(_COORD_NS, self._coord_key())
+                if blob:
+                    entry = pickle.loads(blob)
+                    # reject leftovers of a crashed same-named group: a
+                    # live rendezvous key is at most timeout_s old (rank 0
+                    # deletes it once everyone has joined)
+                    if time.time() - entry["ts"] <= timeout_s:
+                        addr = entry["addr"]
+                        break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"group {self.group_name}: no coordinator within "
+                        f"{timeout_s}s")
+                time.sleep(_POLL_S)
+        self._ensure_jax_distributed(addr)
+        if self.rank == 0:
+            # initialize() returns once every process has joined — the
+            # rendezvous key has served its purpose
+            try:
+                self._client.kv_del(_COORD_NS, self._coord_key())
+            except Exception:
+                pass
+        import jax
+        from jax.sharding import Mesh
+
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        if len(per_proc) != self.world_size:
+            raise RuntimeError(
+                f"jax cluster has {len(per_proc)} processes, expected "
+                f"{self.world_size}")
+        devs = [per_proc[i] for i in range(self.world_size)]
+        self.mesh = Mesh(np.array(devs), ("p",))
+        self._local_dev = per_proc[jax.process_index()]
+
+    def _ensure_jax_distributed(self, addr: str) -> None:
+        """Join (or reuse) this process's jax.distributed cluster.
+
+        initialize() is once-per-process; a second group in the same
+        process reuses the existing cluster when its geometry matches
+        (process count == world_size, our index == rank) and fails loudly
+        otherwise — never with jax's opaque 'already initialized' error."""
+        import jax
+        from jax._src import distributed as jdist
+
+        state = getattr(jdist, "global_state", None)
+        if state is not None and state.client is not None:
+            if (jax.process_count() != self.world_size
+                    or jax.process_index() != self.rank):
+                raise RuntimeError(
+                    f"group {self.group_name}: this process already belongs "
+                    f"to a jax.distributed cluster of "
+                    f"{jax.process_count()} processes (as index "
+                    f"{jax.process_index()}) — an xla-multihost group must "
+                    f"match it (asked world={self.world_size} "
+                    f"rank={self.rank})")
+            return
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=self.world_size,
+                                   process_id=self.rank)
+
+    # ------------------------------------------------------------- data plane
+    def _global(self, x: np.ndarray):
+        """Local array -> global [world, ...] jax.Array, one shard per
+        process, sharded over the mesh's `p` axis."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = np.ascontiguousarray(x)
+        sharding = NamedSharding(self.mesh, P("p", *([None] * x.ndim)))
+        local = jax.device_put(x[None], self._local_dev)
+        return jax.make_array_from_single_device_arrays(
+            (self.world_size,) + x.shape, sharding, [local])
+
+    def _shard_map(self, fn, g):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        return jax.shard_map(fn, mesh=self.mesh, in_specs=P("p"),
+                             out_specs=P("p"))(g)
+
+    def _local_of(self, garr) -> np.ndarray:
+        """This process's shard of a [world, ...] global array."""
+        shard = garr.addressable_shards[0]
+        return np.asarray(shard.data)[0]
+
+    # ------------------------------------------------------------ collectives
+    def _allreduce_np(self, x: np.ndarray, op: ReduceOp) -> np.ndarray:
+        red = _reduce_op(op)
+        out = self._shard_map(lambda a: red(a, "p"), self._global(x))
+        return self._local_of(out)
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM, timeout=None):
+        from ray_tpu.util.collective.kv_group import _write_back
+
+        # in-place semantics match the kv/reference backends: the caller's
+        # tensor holds the reduced value afterwards
+        return _write_back(tensor, self._allreduce_np(np.asarray(tensor), op))
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM,
+               timeout=None):
+        from ray_tpu.util.collective.kv_group import _write_back
+
+        out = self._allreduce_np(np.asarray(tensor), op)
+        if self.rank == dst_rank:
+            return _write_back(tensor, out)
+        return tensor
+
+    def broadcast(self, tensor, src_rank: int = 0, timeout=None):
+        from ray_tpu.util.collective.kv_group import _write_back
+
+        x = np.asarray(tensor)
+        contrib = x if self.rank == src_rank else np.zeros_like(x)
+        return _write_back(tensor, self._allreduce_np(contrib, ReduceOp.SUM))
+
+    def allgather(self, tensor, timeout=None) -> List[np.ndarray]:
+        from jax import lax
+
+        x = np.asarray(tensor)
+        out = self._shard_map(
+            lambda a: lax.all_gather(a[0], "p")[None], self._global(x))
+        gathered = self._local_of(out)  # [world, ...]
+        return [gathered[i] for i in range(self.world_size)]
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM, timeout=None):
+        """Input [world, ...]; returns this rank's reduced slice."""
+        arr = np.asarray(tensor)
+        if arr.shape[0] != self.world_size:
+            raise ValueError(
+                f"reducescatter input leading dim {arr.shape[0]} != world "
+                f"{self.world_size}")
+        # psum the full [world, ...] then each rank keeps its slice — XLA
+        # lowers psum+slice to reduce-scatter on device meshes
+        return self._allreduce_np(arr, op)[self.rank]
+
+    def barrier(self, timeout=None):
+        from jax.experimental import multihost_utils
+
+        # name must be IDENTICAL on every process (it is hashed and
+        # compared); a per-group counter keeps successive barriers distinct
+        self._barrier_seq = getattr(self, "_barrier_seq", 0) + 1
+        multihost_utils.sync_global_devices(
+            f"{self.group_name}:barrier:{self._barrier_seq}")
+
+    # ------------------------------------------------------------------- p2p
+    def _fallback(self):
+        if self._kv_fallback is None:
+            from ray_tpu.util.collective.kv_group import KVCollectiveGroup
+
+            self._kv_fallback = KVCollectiveGroup(
+                self._client, f"{self.group_name}:p2p", self.world_size,
+                self.rank)
+        return self._kv_fallback
+
+    def send(self, tensor, dst_rank: int, timeout=None):
+        self._fallback().send(tensor, dst_rank, timeout=timeout)
+
+    def recv(self, tensor, src_rank: int, timeout=None):
+        return self._fallback().recv(tensor, src_rank, timeout=timeout)
+
+    def destroy(self):
+        if self._kv_fallback is not None:
+            self._kv_fallback.destroy()
+        if self.rank == 0:
+            try:
+                self._client.kv_del(_COORD_NS, self._coord_key())
+            except Exception:
+                pass

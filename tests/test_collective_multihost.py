@@ -1,0 +1,88 @@
+"""Cross-process device collective group (backend="xla-multihost").
+
+Parity: `nccl_collective_group.py:128` — actor processes welded into one
+device-plane gang. CI runs the CPU-gloo incarnation (1 virtual device per
+process), the reference's mock-NCCL testing pattern (SURVEY §4.2).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+# each member process: its OWN single-device CPU jax (not the 8-device
+# test mesh this pytest process uses)
+MEMBER_ENV = {"JAX_PLATFORMS": "cpu",
+              "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=10)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self, world, rank, name):
+        import ray_tpu.util.collective as col
+
+        self.world, self.rank, self.name = world, rank, name
+        col.init_collective_group(world, rank, backend="xla-multihost",
+                                  group_name=name)
+
+    def run_matrix(self):
+        import ray_tpu.util.collective as col
+
+        w, r, name = self.world, self.rank, self.name
+        out = {}
+        out["allreduce"] = col.allreduce(np.arange(4.0) + r, group_name=name)
+        out["allreduce_max"] = col.allreduce(
+            np.full(2, float(r)), op=col.ReduceOp.MAX, group_name=name)
+        parts = col.allgather(None, np.array([float(r)]), group_name=name)
+        out["allgather"] = np.concatenate(parts)
+        out["broadcast"] = col.broadcast(
+            np.full(3, float(r)), src_rank=1, group_name=name)
+        rs_in = np.stack([np.full(2, float(r + i)) for i in range(w)])
+        out["reducescatter"] = col.reducescatter(rs_in, group_name=name)
+        col.barrier(group_name=name)
+        if r == 0:
+            col.send(np.array([42.0]), dst_rank=1, group_name=name)
+        elif r == 1:
+            out["recv"] = col.recv(np.zeros(1), src_rank=0, group_name=name)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _check_matrix(outs, world):
+    for r, o in enumerate(outs):
+        np.testing.assert_allclose(
+            o["allreduce"], np.arange(4.0) * world + sum(range(world)))
+        np.testing.assert_allclose(o["allreduce_max"],
+                                   np.full(2, float(world - 1)))
+        np.testing.assert_allclose(o["allgather"],
+                                   np.arange(float(world)))
+        np.testing.assert_allclose(o["broadcast"], np.full(3, 1.0))
+        # reducescatter: sum_r (r + i) at slice i
+        np.testing.assert_allclose(
+            o["reducescatter"],
+            np.full(2, float(sum(range(world)) + world * r)))
+    assert outs[1]["recv"].tolist() == [42.0]
+
+
+def test_two_process_group(cluster):
+    members = [Member.options(runtime_env={"env_vars": MEMBER_ENV}).remote(
+        2, r, "xmh2") for r in range(2)]
+    outs = ray_tpu.get([m.run_matrix.remote() for m in members], timeout=180)
+    _check_matrix(outs, 2)
+    for m in members:
+        ray_tpu.kill(m)
+
+
+def test_four_process_group(cluster):
+    members = [Member.options(runtime_env={"env_vars": MEMBER_ENV}).remote(
+        4, r, "xmh4") for r in range(4)]
+    outs = ray_tpu.get([m.run_matrix.remote() for m in members], timeout=240)
+    _check_matrix(outs, 4)
+    for m in members:
+        ray_tpu.kill(m)
