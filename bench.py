@@ -29,11 +29,20 @@ def main():
     n = len(devices)
     mesh = build_mesh(MeshConfig(dp=n), devices=devices)
 
+    import os
+
     seq_len = 1024
-    per_chip_batch = 32   # sweep 2026-07: best of {8,16,32} on v5e (relay
-    #                       compile helper rejects ≥64)
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "24"))
+    # sweep 2026-07 r2 (see benchmarks/MFU_ANALYSIS.md): dots-remat @ 24
+    # is the best config the relay will compile (it rejects batch >= 40;
+    # remat=False and dots_all OOM/underperform; flash loses to XLA's
+    # fused dense attention at seq 1024)
     batch = per_chip_batch * n
-    cfg = gpt2.GPT2Config.preset("gpt2-125m", max_seq_len=seq_len)
+    cfg = gpt2.GPT2Config.preset(
+        "gpt2-125m", max_seq_len=seq_len,
+        remat=os.environ.get("BENCH_REMAT", "1") != "0",
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots"),
+        attn_impl=os.environ.get("BENCH_ATTN", "auto"))
 
     train = compile_gpt2_train(cfg, mesh, optimizer=default_optimizer(total_steps=100))
     state = train.init_fn(jax.random.key(0))

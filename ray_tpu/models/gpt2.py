@@ -43,6 +43,10 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16        # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # rematerialization policy: "full" recomputes everything in the bwd
+    # pass; "dots" saves matmul outputs (jax dots_with_no_batch_dims
+    # policy) — most of remat=False's speed at a fraction of the memory
+    remat_policy: str = "full"
     # attention implementation: auto | dense | flash (pallas) | ring | ulysses
     # auto: ring when the active mesh has sp>1, flash on TPU, dense otherwise
     attn_impl: str = "auto"
@@ -227,7 +231,14 @@ def _block(x, bp, cfg: GPT2Config):
 def embed(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
     """tokens [B,T] int32 -> embeddings [B,T,D] (compute dtype)."""
     T = tokens.shape[1]
-    x = params["wte"][tokens] + params["wpe"][:T][None]
+    # lookup against an explicitly replicated table view: gathering from a
+    # ZeRO-sharded (embed->fsdp) table makes the output inherit the
+    # table's layout and forces the partitioner into an involuntary full
+    # rematerialization when re-sharding to the batch layout; an upfront
+    # all-gather of the table (the ZeRO-3 prefetch pattern) is the cheap
+    # and intended collective
+    wte = constrain(params["wte"], None, None)
+    x = wte[tokens] + params["wpe"][:T][None]
     return constrain(x.astype(cfg.dtype), "batch", "seq", "embed")
 
 
@@ -244,7 +255,13 @@ def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
 
     block_fn = partial(_block, cfg=cfg)
     if cfg.remat:
-        block_fn = jax.checkpoint(block_fn)
+        policies = {
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "dots_all": jax.checkpoint_policies.dots_saveable,
+        }
+        policy = policies.get(cfg.remat_policy)
+        block_fn = (jax.checkpoint(block_fn, policy=policy) if policy
+                    else jax.checkpoint(block_fn))
 
     def scan_body(carry, bp):
         return block_fn(carry, bp), None
