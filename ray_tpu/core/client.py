@@ -133,12 +133,20 @@ class CoreClient:
         direct_handlers = dict(direct_handlers or {})
         direct_handlers.setdefault("fetch_device_object",
                                    self._on_fetch_device_object)
+        # tracker active BEFORE the loop can dispatch anything: a task or
+        # actor __init__ processed during registration may construct
+        # ObjectRefs, and every one of them must be counted (else the head
+        # never records this process as a holder and evicts early)
+        self.ref_tracker = refcount.RefTracker(self)
+        refcount.activate(self.ref_tracker)
         self._loop_thread.start()
         fut = asyncio.run_coroutine_threadsafe(
             self._start_async(direct_handlers or {}), self.loop)
         fut.result(timeout=30)
-        self.ref_tracker = refcount.RefTracker(self)
-        refcount.activate(self.ref_tracker)
+        # refcounting on/off is the HEAD's setting, distributed at
+        # registration — per-process env vars can't diverge into a head
+        # that evicts objects a non-reporting process still holds
+        self.ref_tracker.set_enabled(self.node_info.get("refcount", True))
         self._started.set()
 
     async def _start_async(self, direct_handlers: dict) -> None:
@@ -657,13 +665,20 @@ class CoreClient:
                 self.ensure_registered(ObjectRef(oid))
                 deps.append(oid.binary())
         if ser.total_bytes <= ARGS_INLINE_LIMIT:
-            return {"inline": ser.to_bytes()}, deps
+            return {"inline": ser.to_bytes()}, deps, ser.borrow_tokens
         meta = self.put_serialized(ser)
-        return {"meta": meta}, deps
+        return {"meta": meta}, deps, ser.borrow_tokens
+
+    def release_borrows(self, tokens) -> None:
+        """Sender-side release of borrow pins for a payload that will
+        provably never be deserialized (terminally failed call). Idempotent
+        against a racing receiver commit."""
+        for oid, token in tokens or []:
+            self.ref_tracker.borrow_commit(oid, token)
 
     def submit_task(self, fn_key: bytes, args: tuple, kwargs: dict,
                     options: dict, num_returns: int = 1) -> List[ObjectRef]:
-        payload, deps = self.build_args_payload(args, kwargs)
+        payload, deps, tokens = self.build_args_payload(args, kwargs)
         if "meta" in payload:
             # the args payload object is itself pinned as a dep: the head
             # releases it at task completion, so big-args payloads stop
@@ -673,6 +688,9 @@ class CoreClient:
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
         spec = {"task_id": task_id, "fn_key": fn_key, "args": payload,
                 "deps": deps, "return_ids": [o.binary() for o in return_ids],
+                # head releases these if the task dies before any worker
+                # deserializes the args (borrow pins must not leak)
+                "borrows": [(o.binary(), t) for o, t in tokens],
                 "options": options}
         # fire-and-forget: return ids are client-generated, so no reply is
         # needed — a blocking round trip here caps pipelined submission at
@@ -687,10 +705,11 @@ class CoreClient:
     # -------------------------------------------------------------- actors
     def create_actor(self, cls_key: bytes, args: tuple, kwargs: dict,
                      options: dict, methods: dict) -> ActorID:
-        payload, deps = self.build_args_payload(args, kwargs)
+        payload, deps, tokens = self.build_args_payload(args, kwargs)
         actor_id = ActorID.generate()
         spec = {"actor_id": actor_id.binary(), "cls_key": cls_key,
                 "args": payload, "deps": deps, "options": options,
+                "borrows": [(o.binary(), t) for o, t in tokens],
                 "methods": methods}
         reply = self._call(self.conn.request("create_actor", spec=spec))
         return ActorID(reply["actor_id"])
@@ -742,7 +761,7 @@ class CoreClient:
 
         The reply (result meta) resolves in the background; `get`/`wait` on
         the ref join it via `_pending_calls`."""
-        payload, deps = self.build_args_payload(args, kwargs)
+        payload, deps, tokens = self.build_args_payload(args, kwargs)
         return_id = ObjectID.generate()
         # actor calls bypass the head, so the head can't pin their args:
         # hold ObjectRefs (our own local refcounts) for the deps and the
@@ -756,13 +775,17 @@ class CoreClient:
         with self._pending_lock:
             self._pending_calls[return_id] = cfut
 
-        def _on_done(f, _pins=pins):
+        def _on_done(f, _pins=pins, _tokens=tokens):
             _pins.clear()  # release arg pins NOW — the future object (and
             # this callback's defaults) may outlive the call in
             # _pending_calls, so dropping the binding wouldn't free them
             try:
                 meta = f.result()["meta"]
             except BaseException:
+                # terminal failure: the payload will never be deserialized
+                # anywhere — self-release its borrow pins (idempotent if an
+                # earlier retry did deliver it before the actor died)
+                self.release_borrows(_tokens)
                 return  # surfaced when the ref is consumed
             self.local_metas[meta.object_id] = meta
 

@@ -1,0 +1,92 @@
+"""Device-object data plane: shm-staged snapshots with zero-copy reads.
+
+Replaces the host-pickle round trip for cross-process `get()` of device
+objects (`tensor_transport="device"`). Parity target: the reference's
+accelerator tensor channel
+(`python/ray/experimental/channel/torch_tensor_accelerator_channel.py`) —
+metadata rides the control plane, bulk tensor bytes ride a data plane the
+consumer maps without copies.
+
+Design (TPU-native): PJRT HBM buffers are process-local, so every
+cross-process move requires exactly one D2H DMA on the owner and (for a
+device consumer) one H2D DMA on the consumer. Everything between those
+two DMAs is zero-copy:
+
+- the owner stages each `jax.Array` leaf STRAIGHT into the node's shm
+  arena (out-of-band pickle5 buffers + `write_into`, no intermediate
+  bytes, no pickle of the array data);
+- a same-node consumer maps the shm segment and reconstructs numpy views
+  onto it (true zero-copy for host consumers; a device consumer feeds the
+  view to `jax.device_put`, which DMAs shm→HBM directly);
+- a cross-node consumer pulls the snapshot through the existing chunked
+  windowed transfer (`object_transfer.pull_object`) — 4 MiB chunks, so a
+  multi-GB fetch no longer monopolizes the owner's event loop with one
+  giant frame;
+- jax leaves are tagged at serialization so the consumer rematerializes
+  them on ITS devices (`_remat_leaf`), while plain numpy stays numpy.
+
+The snapshot is cached on the owner keyed by the device object id and
+freed together with it, so repeated consumers pay one D2H total.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Optional
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.store import ObjectMeta
+
+_tls = threading.local()
+
+
+def snapshot_oid(device_oid: ObjectID) -> ObjectID:
+    """Deterministic snapshot id: retries/races on the same device object
+    stage to the same id, and any node can derive it without the owner."""
+    return ObjectID(hashlib.blake2b(
+        device_oid.binary() + b":snap", digest_size=16).digest())
+
+
+def _remat_leaf(arr):
+    """Unpickle hook for a staged jax leaf: inside a rematerialize()
+    context the host view is DMA'd onto the consumer's default device;
+    outside (plain host read) it stays a zero-copy numpy view."""
+    if getattr(_tls, "remat", False):
+        import jax
+
+        return jax.device_put(arr)
+    return arr
+
+
+class rematerialize_context:
+    def __enter__(self):
+        _tls.remat = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.remat = False
+        return False
+
+
+def stage_snapshot(client, device_oid: ObjectID, value: Any) -> ObjectMeta:
+    """Owner-side: write a host snapshot of `value` into the node shm
+    store (one D2H DMA per leaf, no pickle of array bytes). Runs in an
+    executor thread — never on the owner's event loop."""
+    from ray_tpu.core import serialization
+
+    ser = serialization.serialize(value, device_snapshot=True)
+    oid = snapshot_oid(device_oid)
+    meta = client.store.put_serialized(oid, ser)
+    meta.node_id = client.node_id
+    meta.owner = client.worker_id
+    return meta
+
+
+def load_snapshot(value_bytes) -> Any:
+    """Consumer-side: deserialize a pulled/mapped snapshot, placing jax
+    leaves on this process's devices."""
+    from ray_tpu.core import serialization
+
+    with rematerialize_context():
+        return serialization.deserialize(value_bytes)

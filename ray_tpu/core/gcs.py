@@ -318,9 +318,27 @@ class Head:
         self.obj_pins: Dict[ObjectID, int] = {}
         self.worker_holds: Dict[WorkerID, Set[ObjectID]] = {}
         self.lineage_dep_pins: Dict[ObjectID, int] = {}
+        # borrower protocol (reference reference_count.h:73): token ->
+        # (oid, sender worker); a pin opened when a ref is pickled, closed
+        # by the deserializer's commit or the sender's death. Commits that
+        # outrace their begin (receiver's flush beat the sender's) park in
+        # a bounded seen-set so the late begin is dropped, not leaked.
+        self.borrow_pins: Dict[bytes, tuple] = {}
+        self.obj_borrows: Dict[ObjectID, Set[bytes]] = {}
+        self.worker_borrows: Dict[WorkerID, Set[bytes]] = {}
+        self._committed_tokens: "OrderedDict[bytes, None]" = OrderedDict()
+        # zero-grace eviction support: an object with NO recorded interest
+        # yet (its owner's inc is still in flight) is "newborn" and never
+        # evicted — the first interest event arms normal lifetime. Dropped
+        # objects leave a bounded tombstone so a late seal (slow retry)
+        # frees its orphan copy instead of resurrecting a newborn.
+        self.obj_interest_seen: Set[ObjectID] = set()
+        self._tombstones: "OrderedDict[ObjectID, None]" = OrderedDict()
         self._evict_due: Dict[ObjectID, float] = {}
+        # borrow pins make lifetime explicit, so no grace window is needed
+        # to absorb in-flight handoffs (was 2.0 s of correctness-by-timing)
         self.evict_grace_s = float(os.environ.get(
-            "RAY_TPU_EVICT_GRACE_S", "2.0"))
+            "RAY_TPU_EVICT_GRACE_S", "0.0"))
         self.objects_evicted = 0
         # produced objects lost to node death, awaiting lazy reconstruction;
         # if their lineage entry gets cap-evicted meanwhile, consumers must
@@ -363,6 +381,9 @@ class Head:
                 self._kick()
             return {"node_id": node.node_id.binary(), "session": self.session,
                     "resources": node.resources, "labels": node.labels,
+                    # the head's refcount setting is authoritative; clients
+                    # enable/disable their trackers from this reply
+                    "refcount": self.refcount_enabled,
                     "driver_sys_path": self.kv.get(("cluster", b"driver_sys_path"))}
 
         async def register_node(node_id, resources, labels, max_workers,
@@ -381,6 +402,11 @@ class Head:
         async def submit_task(spec):
             w = conn_state["worker"]
             rec = TaskRecord(spec, w)
+            for rid in spec["return_ids"]:
+                # the submitter constructs ObjectRefs for every return id;
+                # record it as holder NOW so a fast task's sealed result
+                # can't be evicted before the submitter's inc flush lands
+                self._add_holder(ObjectID(rid), w.worker_id)
             if spec["options"].get("num_returns") != "streaming":
                 entry = {"spec": spec, "produced": set(),
                          "recon_left": spec["options"].get("max_retries", 3),
@@ -478,19 +504,22 @@ class Head:
                 return None
 
         async def ref_update(ops):
-            """Batched, ORDERED ObjectRef count transitions from one
-            process (reference ReferenceCounter ownership updates)."""
+            """Batched, ORDERED ObjectRef count transitions and borrow
+            events from one process (reference ReferenceCounter ownership
+            updates + borrower registration)."""
             w = conn_state.get("worker")
             if w is None:
                 return True
             held = self.worker_holds.setdefault(w.worker_id, set())
-            for is_inc, b in ops:
+            for op in ops:
+                kind, b = op[0], op[1]
                 oid = ObjectID(b)
-                if is_inc:
+                if kind == "i":
                     held.add(oid)
                     self.obj_holders.setdefault(oid, set()).add(w.worker_id)
+                    self.obj_interest_seen.add(oid)
                     self._evict_due.pop(oid, None)
-                else:
+                elif kind == "d":
                     held.discard(oid)
                     hs = self.obj_holders.get(oid)
                     if hs is not None:
@@ -498,6 +527,10 @@ class Head:
                         if not hs:
                             self.obj_holders.pop(oid, None)
                             self._maybe_evict(oid)
+                elif kind == "b":
+                    self._borrow_begin(oid, op[2], w.worker_id)
+                elif kind == "c":
+                    self._borrow_commit(oid, op[2])
             return True
 
         async def object_spilled(meta):
@@ -780,8 +813,12 @@ class Head:
                     item = gs.items[index]
                     if index not in gs.delivered:
                         gs.delivered.add(index)
-                        # consumer takes over interest (its ref_update inc
-                        # lands within the eviction grace window)
+                        # interest transfers to the consumer atomically with
+                        # delivery: holder first, then the yield-pin drops —
+                        # race-free at zero eviction grace
+                        wc = conn_state.get("worker")
+                        if wc is not None:
+                            self._add_holder(ObjectID(item), wc.worker_id)
                         self._unpin(ObjectID(item))
                     return {"ref": item}
                 # a failed generator task seals gen_id itself with the error;
@@ -887,6 +924,16 @@ class Head:
     # ------------------------------------------------- object lifetime
     def _pin(self, oid: ObjectID) -> None:
         self.obj_pins[oid] = self.obj_pins.get(oid, 0) + 1
+        self.obj_interest_seen.add(oid)
+        self._evict_due.pop(oid, None)
+
+    def _add_holder(self, oid: ObjectID, worker_id: WorkerID) -> None:
+        """Head-side interest transfer: record `worker_id` as a holder
+        ahead of its own (in-flight) ref_update inc, so handing it an
+        object over a head-mediated reply is race-free at zero grace."""
+        self.obj_holders.setdefault(oid, set()).add(worker_id)
+        self.worker_holds.setdefault(worker_id, set()).add(oid)
+        self.obj_interest_seen.add(oid)
         self._evict_due.pop(oid, None)
 
     def _unpin(self, oid: ObjectID) -> None:
@@ -902,14 +949,65 @@ class Head:
             self._unpin(oid)
         rec.pinned = []
 
+    def _borrow_begin(self, oid: ObjectID, token: bytes,
+                      sender: WorkerID) -> None:
+        if token in self._committed_tokens:
+            # the receiver's commit outraced this begin (distinct head
+            # connections): the handoff already completed, drop both sides
+            self._committed_tokens.pop(token, None)
+            return
+        self.borrow_pins[token] = (oid, sender)
+        self.obj_borrows.setdefault(oid, set()).add(token)
+        self.worker_borrows.setdefault(sender, set()).add(token)
+        self.obj_interest_seen.add(oid)
+        self._evict_due.pop(oid, None)
+
+    def _borrow_commit(self, oid: ObjectID, token: bytes) -> None:
+        ent = self.borrow_pins.pop(token, None)
+        if ent is None:
+            # begin not seen yet — remember so the late begin is a no-op.
+            # Bounded: an overflowed token leaks one pin until its sender
+            # dies, it never frees a live object.
+            self._committed_tokens[token] = None
+            while len(self._committed_tokens) > 200_000:
+                self._committed_tokens.popitem(last=False)
+            return
+        self._drop_borrow(token, ent)
+
+    def _drop_borrow(self, token: bytes, ent: tuple) -> None:
+        oid, sender = ent
+        toks = self.obj_borrows.get(oid)
+        if toks is not None:
+            toks.discard(token)
+            if not toks:
+                self.obj_borrows.pop(oid, None)
+                self._maybe_evict(oid)
+        sent = self.worker_borrows.get(sender)
+        if sent is not None:
+            sent.discard(token)
+            if not sent:
+                self.worker_borrows.pop(sender, None)
+
     def _maybe_evict(self, oid: ObjectID) -> None:
         if not self.refcount_enabled:
             return
         if (self.obj_holders.get(oid) or self.obj_pins.get(oid)
+                or self.obj_borrows.get(oid)
                 or self.lineage_dep_pins.get(oid)):
             return
+        if oid not in self.obj_interest_seen:
+            return  # newborn: its holder's first inc is still in flight
         if oid in self.objects or oid in self.lineage:
             self._evict_due[oid] = time.monotonic() + self.evict_grace_s
+        else:
+            # nothing registered and no interest left (e.g. a direct
+            # actor-call result ref that was dropped): forget the id —
+            # interest_seen must not grow by one entry per actor call.
+            # The tombstone makes a late-arriving seal free itself.
+            self.obj_interest_seen.discard(oid)
+            self._tombstones[oid] = None
+            while len(self._tombstones) > 100_000:
+                self._tombstones.popitem(last=False)
 
     async def _evict_loop(self) -> None:
         while not self._shutdown:
@@ -921,6 +1019,7 @@ class Head:
             for oid in due:
                 self._evict_due.pop(oid, None)
                 if (self.obj_holders.get(oid) or self.obj_pins.get(oid)
+                        or self.obj_borrows.get(oid)
                         or self.lineage_dep_pins.get(oid)):
                     continue
                 try:
@@ -938,6 +1037,16 @@ class Head:
         and the pins it held on nested refs."""
         meta = self.objects.pop(oid, None)
         self.obj_holders.pop(oid, None)
+        for token in self.obj_borrows.pop(oid, set()):
+            ent = self.borrow_pins.pop(token, None)
+            if ent is not None:
+                sent = self.worker_borrows.get(ent[1])
+                if sent is not None:
+                    sent.discard(token)
+        self.obj_interest_seen.discard(oid)
+        self._tombstones[oid] = None
+        while len(self._tombstones) > 100_000:
+            self._tombstones.popitem(last=False)
         self._evict_due.pop(oid, None)
         self._lineage_pop(oid)
         if meta is not None:
@@ -1036,14 +1145,23 @@ class Head:
                 or (meta.kind == "spilled" and existing.kind == "spilled"
                     and meta.spill_path == existing.spill_path)
                 # re-registration of a stale pre-spill meta: the canonical
-                # entry moved to disk but the segment name is its old home
-                or (existing.kind == "spilled" and meta.kind == "shm"))
+                # entry moved to disk but the segment name is its old home —
+                # only when the segments actually match; a retried task's
+                # duplicate copy has a fresh segment and must be freed
+                or (existing.kind == "spilled" and meta.kind == "shm"
+                    and meta.segment == existing.segment))
             if not same_storage:
                 self._free_meta(meta)  # a genuinely distinct duplicate copy
             return
         self.objects[meta.object_id] = meta
         for b in (meta.contained or []):
             self._pin(ObjectID(b))  # nested refs live while container does
+        if meta.object_id in self._tombstones:
+            # every interest already came and went (ref dropped before the
+            # producer finished, or a slow retry's duplicate): free now —
+            # the newborn deferral must not resurrect it as a leak
+            self.obj_interest_seen.add(meta.object_id)
+            self._evict_due[meta.object_id] = time.monotonic()
         self._maybe_evict(meta.object_id)  # fire-and-forget results: nobody
         # may hold a ref by the time the result arrives
         if meta.kind in ("shm", "arena"):
@@ -1281,7 +1399,13 @@ class Head:
         self._spawned[proc.pid] = proc
 
     def _on_worker_disconnect(self, w: WorkerInfo) -> None:
-        # a dead process holds nothing: release its ref interest
+        # a dead process holds nothing: release its ref interest and any
+        # borrow pins it opened that were never committed (payloads it
+        # serialized but nobody ever deserialized)
+        for token in list(self.worker_borrows.pop(w.worker_id, set())):
+            ent = self.borrow_pins.pop(token, None)
+            if ent is not None:
+                self._drop_borrow(token, ent)
         for oid in self.worker_holds.pop(w.worker_id, set()):
             hs = self.obj_holders.get(oid)
             if hs is not None:
@@ -1289,6 +1413,13 @@ class Head:
                 if not hs:
                     self.obj_holders.pop(oid, None)
                     self._maybe_evict(oid)
+        # newborn sweep: objects this process owned whose first inc never
+        # flushed (it died inside the flush window) would otherwise defer
+        # eviction forever — its death IS the interest event
+        for oid, meta in list(self.objects.items()):
+            if meta.owner == w.worker_id and oid not in self.obj_interest_seen:
+                self.obj_interest_seen.add(oid)
+                self._maybe_evict(oid)
         self.workers.pop(w.worker_id, None)
         node = self.nodes.get(w.node_id)
         if node is not None:
@@ -1443,8 +1574,15 @@ class Head:
         info.state = "DEAD"
         info.death_cause = cause
         info.ready_event.set()
+        # no further restart will deserialize the creation args: release
+        # the borrow pins their pickled refs opened (idempotent)
+        self._release_spec_borrows(info.spec)
         self._publish("actor_state", {"actor_id": info.actor_id.binary(),
                                       "state": "DEAD", "cause": cause})
+
+    def _release_spec_borrows(self, spec: dict) -> None:
+        for b, token in spec.get("borrows") or []:
+            self._borrow_commit(ObjectID(b), token)
 
     def _terminate_worker(self, w: WorkerInfo) -> None:
         if w.proc is not None:
@@ -1479,6 +1617,7 @@ class Head:
             meta = self.store.put_serialized(ObjectID(rid), err)
             meta.error = True
             self._seal(meta)
+        self._release_spec_borrows(rec.spec)
 
     def _publish(self, channel: str, msg: dict) -> None:
         for conn in self.subscribers.get(channel, []):

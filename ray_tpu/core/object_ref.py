@@ -8,6 +8,15 @@ from ray_tpu.core import refcount
 from ray_tpu.core.ids import ObjectID
 
 
+def _reconstruct_ref(object_id: ObjectID, token=None) -> "ObjectRef":
+    """Unpickle path: the inc queued by ObjectRef() precedes the borrow
+    commit on this process's ordered update stream, so the head records
+    our hold before releasing the sender's borrow pin."""
+    ref = ObjectRef(object_id)
+    refcount.note_deserialized(object_id, token)
+    return ref
+
+
 class ObjectRef:
     __slots__ = ("id",)
 
@@ -41,7 +50,10 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()[:12]})"
 
     def __reduce__(self):
-        return (ObjectRef, (self.id,))
+        # borrower protocol: pickling a ref opens a borrow pin at the head
+        # (ordered before any later dec from this process); the token rides
+        # the payload and whoever deserializes it commits the borrow
+        return (_reconstruct_ref, (self.id, refcount.note_serialized(self.id)))
 
     # `await ref` inside async actors / drivers with a running loop
     def __await__(self):

@@ -38,15 +38,20 @@ def np_copy_into(dst_view: memoryview, offset: int, data) -> int:
 class SerializedObject:
     """Pickle meta + list of out-of-band buffers (zero-copy where possible)."""
 
-    __slots__ = ("meta", "buffers", "contained")
+    __slots__ = ("meta", "buffers", "contained", "borrow_tokens")
 
     def __init__(self, meta: bytes, buffers: List[memoryview],
-                 contained: Optional[List] = None):
+                 contained: Optional[List] = None,
+                 borrow_tokens: Optional[List] = None):
         self.meta = meta
         self.buffers = buffers
         # ObjectIDs of ObjectRefs pickled inside this payload — the
         # reference-counting layer pins them while the container lives
         self.contained = contained or []
+        # (ObjectID, token) borrow pins opened while pickling nested refs;
+        # a sender whose payload provably never reaches a deserializer
+        # (terminally failed call) self-commits these to avoid pin leaks
+        self.borrow_tokens = borrow_tokens or []
 
     @property
     def total_bytes(self) -> int:
@@ -109,22 +114,36 @@ class _Pickler(cloudpickle.Pickler):
     instead, not byte serialization)."""
 
     def reducer_override(self, obj):
-        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.core.object_ref import ObjectRef, _reconstruct_ref
+        from ray_tpu.core import refcount
 
         if type(obj) is ObjectRef:
             # record nested refs so the refcounting layer can pin them for
             # the container's lifetime (reference: borrowed refs serialized
-            # into task args / returned values)
+            # into task args / returned values); the borrow token is kept
+            # here too so failed handoffs can be self-released
             self.contained_refs.append(obj.id)
-            return NotImplemented
+            token = refcount.note_serialized(obj.id)
+            if token is not None:
+                self.borrow_tokens.append((obj.id, token))
+            return (_reconstruct_ref, (obj.id, token))
         jax = sys.modules.get("jax")
         if jax is not None and isinstance(obj, jax.Array):
             import numpy as np
 
+            if self.device_snapshot:
+                # tag the leaf so a device consumer's deserialize puts it
+                # back on ITS device; the ndarray itself still pickles with
+                # an out-of-band buffer (no copy into the stream)
+                from ray_tpu.core.device_transport import _remat_leaf
+
+                return (_remat_leaf, (np.asarray(obj),))
             return np.asarray(obj).__reduce_ex__(5)
         return super().reducer_override(obj)
 
     contained_refs: List = None  # set per instance in serialize()
+    borrow_tokens: List = None
+    device_snapshot: bool = False
 
 
 # top-level bytes/bytearray get a marker meta + out-of-band buffer: pickle5's
@@ -135,7 +154,7 @@ _BYTES_META = b"RTPU:bytes"
 _BYTEARRAY_META = b"RTPU:bytearray"
 
 
-def serialize(value: Any) -> SerializedObject:
+def serialize(value: Any, device_snapshot: bool = False) -> SerializedObject:
     if type(value) is bytes:
         return SerializedObject(_BYTES_META, [memoryview(value)])
     if type(value) is bytearray:
@@ -149,9 +168,12 @@ def serialize(value: Any) -> SerializedObject:
     sink = io.BytesIO()
     p = _Pickler(sink, protocol=5, buffer_callback=callback)
     p.contained_refs = []
+    p.borrow_tokens = []
+    p.device_snapshot = device_snapshot
     p.dump(value)
     return SerializedObject(sink.getvalue(), buffers,
-                            contained=p.contained_refs)
+                            contained=p.contained_refs,
+                            borrow_tokens=p.borrow_tokens)
 
 
 def deserialize(obj: SerializedObject) -> Any:

@@ -461,7 +461,9 @@ class SharedMemoryStore:
                 continue
             meta.kind = "spilled"
             meta.spill_path = path
-            meta.segment = None
+            # segment name retained: readers go by kind/spill_path, and the
+            # head uses it to tell a stale pre-spill re-registration (same
+            # segment) from a retry's distinct duplicate copy (fresh name)
             if self.on_spill is not None:
                 self.on_spill(meta)
 

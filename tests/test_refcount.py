@@ -20,7 +20,9 @@ ARR = 200 * 1024  # > inline threshold: objects land in shm
 
 @pytest.fixture(scope="module")
 def cluster():
-    os.environ["RAY_TPU_EVICT_GRACE_S"] = "0.3"
+    # ZERO grace: lifetime must be fully explicit (holders + pins +
+    # borrows); any correctness-by-timing regression fails this module
+    os.environ["RAY_TPU_EVICT_GRACE_S"] = "0"
     os.environ["RAY_TPU_REFCOUNT_FLUSH_S"] = "0.05"
     try:
         ray_tpu.init(num_cpus=4, num_tpu_chips=0, max_workers=6)
@@ -165,6 +167,54 @@ def test_manual_free_still_immediate(cluster):
     oid = ref.hex()
     ray_tpu.free([ref])
     assert _wait_gone(oid, timeout=5)
+
+
+def test_borrowed_ref_parked_out_of_band(cluster):
+    """Adversarial handoff: a ref is pickled into raw bytes, parked in the
+    KV, and the sender drops every local ref. Long after any grace window
+    the bytes are deserialized and the object must still be alive —
+    the borrow pin opened at pickle time is what holds it."""
+    import pickle
+
+    from ray_tpu.core.api import _global_client
+
+    client = _global_client()
+    ref = ray_tpu.put(np.full((ARR,), 9, dtype=np.uint8))
+    oid = ref.hex()
+    blob = pickle.dumps({"parked": ref})
+    client.kv_put("test", b"parked_ref", blob)
+    del ref
+    gc.collect()
+    time.sleep(3.0)  # far beyond flush interval + any grace
+    assert oid in _object_ids(), "borrow pin must outlive the sender's refs"
+    revived = pickle.loads(client.kv_get("test", b"parked_ref"))["parked"]
+    assert int(ray_tpu.get(revived, timeout=30).sum()) == 9 * ARR
+    del revived
+    gc.collect()
+    # commit released the borrow; dropping the revived ref frees the object
+    assert _wait_gone(oid)
+
+
+def test_borrow_released_on_sender_death(cluster):
+    """A process that serialized a ref and died releases its borrow pins:
+    parked handoffs from dead senders must not leak forever."""
+
+    @ray_tpu.remote
+    class Parker:
+        def park(self):
+            r = ray_tpu.put(np.ones((ARR,), dtype=np.uint8))
+            import pickle
+
+            from ray_tpu.core.api import _global_client
+
+            _global_client().kv_put("test", b"dead_sender", pickle.dumps(r))
+            return r.hex()
+
+    p = Parker.remote()
+    oid = ray_tpu.get(p.park.remote(), timeout=30)
+    assert _wait_alive_steady(oid)  # borrow pin holds it
+    ray_tpu.kill(p)
+    assert _wait_gone(oid, timeout=15)
 
 
 def test_soak_directory_stays_bounded(cluster):
