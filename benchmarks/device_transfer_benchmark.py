@@ -1,0 +1,130 @@
+"""Device-object data-plane microbenchmark.
+
+Measures the three transports of the device object store
+(VERDICT r2 "device-transfer microbench" criterion):
+
+1. same-process get()           — buffer-identity zero copy (ns-scale)
+2. cross-process same-node get() — shm snapshot: one D2H on the owner,
+   zero-copy shm map + H2D on the consumer (no pickle of array bytes)
+3. gang p2p send/recv           — pair-mesh ppermute over the device
+   interconnect (ICI on TPU; gloo on the CPU CI incarnation)
+
+Run: python benchmarks/device_transfer_benchmark.py [--mb 64]
+Prints one JSON line per transport: {"transport", "mb", "seconds", "gbps"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=64)
+    args = ap.parse_args()
+    os.environ.setdefault("RAY_TPU_EVICT_GRACE_S", "0")
+
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpu_chips=0, max_workers=6)
+    mb = args.mb
+    n = mb * (1 << 20) // 4
+
+    # 1) same-process zero copy
+    import jax.numpy as jnp
+
+    x = jnp.arange(n, dtype="float32")
+    ref = ray_tpu.put_device(x)
+    t0 = time.perf_counter()
+    reps = 100
+    for _ in range(reps):
+        got = ray_tpu.get(ref)
+    dt = (time.perf_counter() - t0) / reps
+    assert got is x
+    print(json.dumps({"transport": "same_process_get", "mb": mb,
+                      "seconds": round(dt, 9), "gbps": None}), flush=True)
+    del ref, got
+
+    # 2) cross-process same-node snapshot fetch
+    @ray_tpu.remote
+    class Owner:
+        def put(self, n):
+            import jax.numpy as jnp
+
+            return ray_tpu.put_device(
+                jnp.arange(n, dtype="float32")).hex()
+
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_ref import ObjectRef
+
+    owner = Owner.remote()
+    hex_id = ray_tpu.get(owner.put.remote(n), timeout=120)
+    r = ObjectRef(ObjectID.from_hex(hex_id))
+    t0 = time.perf_counter()
+    val = ray_tpu.get(r, timeout=120)  # includes one owner-side D2H stage
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(3):
+        val = ray_tpu.get(r, timeout=120)  # snapshot cached on owner
+    warm = (time.perf_counter() - t0) / 3
+    assert np.asarray(val)[:3].tolist() == [0.0, 1.0, 2.0]
+    bytes_ = n * 4
+    print(json.dumps({"transport": "cross_process_cold", "mb": mb,
+                      "seconds": round(cold, 6),
+                      "gbps": round(bytes_ / cold / 1e9, 3)}), flush=True)
+    print(json.dumps({"transport": "cross_process_warm", "mb": mb,
+                      "seconds": round(warm, 6),
+                      "gbps": round(bytes_ / warm / 1e9, 3)}), flush=True)
+    del r, val
+
+    # 3) gang p2p over the device mesh (2 member processes)
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+    @ray_tpu.remote
+    class Peer:
+        def __init__(self, world, rank):
+            import ray_tpu.util.collective as col
+
+            self.rank = rank
+            col.init_collective_group(world, rank, backend="xla-multihost",
+                                      group_name="bench_p2p")
+
+        def run(self, n, iters):
+            import time as _t
+
+            import numpy as np
+
+            import ray_tpu.util.collective as col
+
+            x = np.arange(n, dtype=np.float32)
+            col.barrier(group_name="bench_p2p")
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                if self.rank == 0:
+                    col.send(x, dst_rank=1, group_name="bench_p2p")
+                else:
+                    col.recv(x, src_rank=0, group_name="bench_p2p")
+            return (_t.perf_counter() - t0) / iters
+
+    peers = [Peer.options(runtime_env={"env_vars": env}).remote(2, r)
+             for r in range(2)]
+    iters = 5
+    times = ray_tpu.get([p.run.remote(n, iters) for p in peers], timeout=300)
+    dt = max(times)
+    print(json.dumps({"transport": "gang_p2p", "mb": mb,
+                      "seconds": round(dt, 6),
+                      "gbps": round(bytes_ / dt / 1e9, 3)}), flush=True)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import os
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -75,6 +76,10 @@ class CoreClient:
         # in-flight pull dedup, LRU-bounded cache of pulled copies
         self._data_conns: Dict[Tuple[str, int], protocol.Connection] = {}
         self._pull_tasks: Dict[ObjectID, asyncio.Task] = {}
+        # owner-side staged host snapshots of device objects + in-flight
+        # staging dedup (freed with the device object)
+        self._device_snapshots: Dict[ObjectID, ObjectMeta] = {}
+        self._staging: Dict[ObjectID, asyncio.Future] = {}
         self._pull_sem: Optional[asyncio.Semaphore] = None
         self._pulled: "OrderedDict[ObjectID, ObjectMeta]" = OrderedDict()
         self._pulled_lock = threading.Lock()  # loop inserts, user threads free
@@ -93,7 +98,14 @@ class CoreClient:
         self.loop.run_forever()
 
     async def _on_free_device_object(self, object_id):
-        self.device_store.pop(ObjectID(object_id))
+        oid = ObjectID(object_id)
+        self.device_store.pop(oid)
+        snap = self._device_snapshots.pop(oid, None)
+        if snap is not None:
+            try:
+                self.store.free(snap)  # staged host copy dies with the value
+            except Exception:
+                pass
         return True
 
     async def _on_evicted_object(self, meta):
@@ -113,26 +125,140 @@ class CoreClient:
         return True
 
     async def _on_fetch_device_object(self, object_id):
-        """Another process wants a host snapshot of a device object we
-        own (reference: RDT out-of-band tensor fetch)."""
+        """Another process wants a device object we own: stage a host
+        snapshot into node shm (once, in an executor thread — a multi-GB
+        D2H must not stall this loop) and reply with its tiny meta. The
+        consumer maps the shm directly (same node) or pulls it through
+        the chunked data plane (cross node) — the bulk bytes never ride
+        this control connection (reference: accelerator tensor channel,
+        torch_tensor_accelerator_channel.py)."""
         oid = ObjectID(object_id)
         try:
             value = self.device_store.get(oid)
         except KeyError:
             raise FileNotFoundError(f"device object {oid} not here") from None
-        from ray_tpu.core.device_store import is_device_value
+        meta = self._device_snapshots.get(oid)
+        if meta is None:
+            from ray_tpu.core import device_transport
 
-        was_jax = is_device_value(value)
-        ser = serialization.serialize(value)  # jax→host numpy inside
-        import pickle as _pickle
+            task = self._staging.get(oid)
+            if task is None:  # concurrent fetchers share one D2H
+                task = asyncio.ensure_future(
+                    asyncio.get_running_loop().run_in_executor(
+                        None, device_transport.stage_snapshot,
+                        self, oid, value))
+                self._staging[oid] = task
+                task.add_done_callback(
+                    lambda t, o=oid: self._staging.pop(o, None))
+            meta = await asyncio.shield(task)
+            if not self.device_store.contains(oid):
+                # freed while we were staging: the free handler saw no
+                # snapshot entry, so WE must release it or the shm leaks
+                try:
+                    self.store.free(meta)
+                except Exception:
+                    pass
+                raise FileNotFoundError(f"device object {oid} freed")
+            self._device_snapshots[oid] = meta
+        return {"meta": meta}
 
-        return {"data": _pickle.PickleBuffer(ser.to_bytes()),
-                "was_jax": was_jax}
+    async def _on_fetch_device_ici(self, object_id, group_name, dst_rank):
+        """Gang-member fetch: a peer of one of our xla-multihost groups
+        wants this device object. Ship the pytree skeleton over this
+        control connection and every jax leaf over the gang's device mesh
+        (pair-mesh ppermute — ICI on TPU), never touching host pickle for
+        the array bytes."""
+        oid = ObjectID(object_id)
+        try:
+            value = self.device_store.get(oid)
+        except KeyError:
+            raise FileNotFoundError(f"device object {oid} not here") from None
+        from ray_tpu.util.collective import collective as col
+
+        group = col._groups.get(group_name)
+        if group is None or getattr(group, "backend_name", "") != "xla-multihost":
+            return None  # consumer falls back to the shm snapshot path
+        import jax
+
+        from ray_tpu.core import device_transport as dt
+
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        descs, skeleton_leaves, dev_leaves = [], [], []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                descs.append({"shape": tuple(leaf.shape),
+                              "dtype": str(leaf.dtype)})
+                skeleton_leaves.append(dt.IciLeaf(len(descs) - 1))
+                dev_leaves.append(leaf)
+            else:
+                skeleton_leaves.append(leaf)
+        skeleton = jax.tree_util.tree_unflatten(treedef, skeleton_leaves)
+
+        def _send_all():
+            for leaf in dev_leaves:
+                group.send_device(leaf, dst_rank)
+
+        # sends run concurrently with the consumer's recvs (each pair-mesh
+        # program blocks until both peers join); never on this loop. A
+        # failed send leaves the consumer blocked in its recv — inherent
+        # to collective p2p (NCCL parity); at minimum the failure must be
+        # loud on the owner, not a silently dropped Future.
+        fut = asyncio.get_running_loop().run_in_executor(None, _send_all)
+
+        def _log_failure(f):
+            exc = f.exception()
+            if exc is not None:
+                print(f"[ray_tpu] ICI send of {oid.hex()[:12]} to rank "
+                      f"{dst_rank} failed: {exc!r}", file=sys.stderr,
+                      flush=True)
+
+        fut.add_done_callback(_log_failure)
+        return {"skeleton": serialization.dumps(skeleton), "descs": descs}
+
+    def _try_ici_fetch(self, meta: ObjectMeta) -> Optional[Any]:
+        """Device-plane get() between gang members: when the owner and we
+        are both members of one xla-multihost group, leaves ride the ICI
+        mesh instead of a host-staged snapshot. Returns None when the
+        route does not apply (caller falls back)."""
+        if meta.owner is None:
+            return None
+        from ray_tpu.util.collective import collective as col
+        from ray_tpu.util.collective import xla_multihost as xmh
+
+        mine = {name: g for name, g in list(col._groups.items())
+                if getattr(g, "backend_name", "") == "xla-multihost"}
+        if not mine:
+            return None
+        info = xmh.lookup_membership(self, meta.owner.hex())
+        if not info or info.get("group") not in mine:
+            return None
+        group = mine[info["group"]]
+        src = info["rank"]
+        if src == group.rank:
+            return None
+        rep = self._call(self._direct_owner_request(
+            meta, "fetch_device_ici", object_id=meta.object_id.binary(),
+            group_name=info["group"], dst_rank=group.rank))
+        if rep is None:
+            return None
+        import jax
+
+        from ray_tpu.core import device_transport as dt
+
+        received = [group.recv_device(tuple(d["shape"]), d["dtype"], src)
+                    for d in rep["descs"]]
+        skeleton = serialization.loads(bytes(rep["skeleton"]))
+        return jax.tree_util.tree_map(
+            lambda x: received[x.index] if isinstance(x, dt.IciLeaf) else x,
+            skeleton,
+            is_leaf=lambda x: isinstance(x, dt.IciLeaf))
 
     def start(self, direct_handlers: Optional[dict] = None) -> None:
         direct_handlers = dict(direct_handlers or {})
         direct_handlers.setdefault("fetch_device_object",
                                    self._on_fetch_device_object)
+        direct_handlers.setdefault("fetch_device_ici",
+                                   self._on_fetch_device_ici)
         # tracker active BEFORE the loop can dispatch anything: a task or
         # actor __init__ processed during registration may construct
         # ObjectRefs, and every one of them must be counted (else the head
@@ -270,23 +396,25 @@ class CoreClient:
         self.head_push("put_meta", meta=meta)
         return meta
 
-    @staticmethod
-    def _decode_device_reply(rep) -> Any:
-        from ray_tpu.core.device_store import rematerialize
-
-        value = serialization.loads(bytes(rep["data"]))
-        return rematerialize(value, rep.get("was_jax", False))
-
     def _get_device_value(self, meta: ObjectMeta) -> Any:
-        """Resolve a kind=='device' meta: living value when we own it,
-        host-staged fetch from the owner otherwise."""
+        """Resolve a kind=='device' meta: living value when we own it;
+        between gang members, leaves ride the ICI mesh; otherwise a
+        shm-snapshot read (zero-copy map same-node, chunked pull
+        cross-node)."""
         oid = meta.object_id
         if self.device_store.contains(oid):
             return self.device_store.get(oid)
-        return self._decode_device_reply(
-            self._call(self._fetch_device_async(meta)))
+        ici = self._try_ici_fetch(meta)
+        if ici is not None:
+            return ici
+        from ray_tpu.core import device_transport
 
-    async def _fetch_device_async(self, meta: ObjectMeta):
+        snap = self._call(self._fetch_device_async(meta))["meta"]
+        return device_transport.load_snapshot(self.read_serialized(snap))
+
+    async def _direct_owner_request(self, meta: ObjectMeta, method: str,
+                                    **kwargs):
+        """RPC straight to the owning process's direct server."""
         addr = await self.conn.request("worker_address",
                                        worker_id=meta.owner.binary())
         if addr is None:
@@ -297,8 +425,13 @@ class CoreClient:
         if conn is None or conn.closed:
             conn = await protocol.connect(host, port, name=f"dev-{port}")
             self._data_conns[(host, port)] = conn
-        return await conn.request("fetch_device_object",
-                                  object_id=meta.object_id.binary())
+        return await conn.request(method, **kwargs)
+
+    async def _fetch_device_async(self, meta: ObjectMeta):
+        """Ask the owner to stage its snapshot; returns {"meta": snapshot
+        meta} — bytes travel separately over the data plane."""
+        return await self._direct_owner_request(
+            meta, "fetch_device_object", object_id=meta.object_id.binary())
 
     def put_serialized(self, ser: SerializedObject, error: bool = False,
                        register: bool = True) -> ObjectMeta:
@@ -508,8 +641,11 @@ class CoreClient:
             oid = meta.object_id
             if self.device_store.contains(oid):
                 return self.device_store.get(oid)
-            return self._decode_device_reply(
-                await self._fetch_device_async(meta))
+            from ray_tpu.core import device_transport
+
+            snap = (await self._fetch_device_async(meta))["meta"]
+            return device_transport.load_snapshot(
+                await self.read_serialized_async(snap))
         return serialization.deserialize(
             await self.read_serialized_async(meta))
 

@@ -79,11 +79,5 @@ class DeviceObjectStore:
             return len(self._objects)
 
 
-def rematerialize(value: Any, was_jax: bool) -> Any:
-    """Consumer-side: place a fetched host array back on this process's
-    default device when the original was a jax.Array."""
-    if not was_jax:
-        return value
-    import jax
-
-    return jax.device_put(value)
+# consumer-side rematerialization now lives in device_transport (leaves
+# are tagged at serialization and re-placed inside load_snapshot)

@@ -59,6 +59,20 @@ def _remat_leaf(arr):
     return arr
 
 
+class IciLeaf:
+    """Placeholder for a jax leaf in a device-object skeleton shipped over
+    the control plane while the array itself rides the gang's ICI mesh
+    (pair-mesh ppermute send/recv)."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (IciLeaf, (self.index,))
+
+
 class rematerialize_context:
     def __enter__(self):
         _tls.remat = True

@@ -9,13 +9,18 @@ the member processes into one JAX cluster; a global 1-device-per-process
 mesh is built and collectives execute as `shard_map` programs over it, so
 the data plane is XLA's ICI/DCN collectives — not host relays.
 
+p2p send/recv run the device plane too: the two peers build a 2-device
+pair mesh (their devices only) and execute one `lax.ppermute` program —
+the XLA CollectivePermute equivalent of NCCL Send/Recv
+(`collective.py:584-705`). Broadcast is a one-to-many ppermute on the full
+mesh (src transmits world-1 copies — a real broadcast, not the 2x-traffic
+zeros-allreduce). Reduce keeps the psum lowering: on a ring, reduce and
+allreduce move the same bytes, and XLA exposes no pairwise-accumulate
+primitive that would beat it.
+
 CI story (SURVEY §4.2 pattern 3): on CPU the same code runs with the gloo
 CPU-collectives implementation and `--xla_force_host_platform_device_count=1`
 per process — the fake-backend pattern the reference uses for NCCL tests.
-
-p2p send/recv are host-staged through the KV store for now: XLA exposes
-ppermute (a full collective) but no pairwise primitive; a device-plane p2p
-rides the same mesh once ICI send/recv lands.
 """
 
 from __future__ import annotations
@@ -23,13 +28,14 @@ from __future__ import annotations
 import os
 import pickle
 import time
-from typing import List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ray_tpu.util.collective.types import ReduceOp
 
 _COORD_NS = "collective_xmh"
+_MEMBER_NS = "collective_xmh_members"
 _POLL_S = 0.05
 
 
@@ -60,8 +66,14 @@ class XlaMultihostGroup:
         self.group_name = group_name
         self.world_size = world_size
         self.rank = rank
-        self._kv_fallback = None  # lazily built for host-staged p2p
+        # collective launches are per-process serialized (NCCL-style: two
+        # threads interleaving programs on one group would mismatch the
+        # SPMD program order across members)
+        import threading
+
+        self._op_lock = threading.Lock()
         self._init_jax_cluster(timeout_s)
+        self._publish_membership()
 
     # ------------------------------------------------------------ rendezvous
     def _coord_key(self) -> bytes:
@@ -76,35 +88,37 @@ class XlaMultihostGroup:
             # the reference's mock-NCCL pattern: same code path, CPU gloo
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         if self.rank == 0:
-            import socket
-
-            with socket.socket() as s:
-                s.bind(("", 0))
-                port = s.getsockname()[1]
-            host = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
-            addr = f"{host}:{port}"
-            self._client.kv_put(
-                _COORD_NS, self._coord_key(),
-                pickle.dumps({"addr": addr, "ts": time.time()}),
-                overwrite=True)
+            # a leftover key from a crashed same-named group is deleted
+            # here, BEFORE members can read it — liveness by generation,
+            # not by comparing wall clocks across hosts (clock skew made
+            # fresh keys look stale). The key itself is deleted again once
+            # everyone has joined and on destroy().
+            try:
+                self._client.kv_del(_COORD_NS, self._coord_key())
+            except Exception:
+                pass
+            addr = self._start_coordinator(timeout_s)
         else:
             deadline = time.monotonic() + timeout_s
+            addr = None
             while True:
                 blob = self._client.kv_get(_COORD_NS, self._coord_key())
                 if blob:
-                    entry = pickle.loads(blob)
-                    # reject leftovers of a crashed same-named group: a
-                    # live rendezvous key is at most timeout_s old (rank 0
-                    # deletes it once everyone has joined)
-                    if time.time() - entry["ts"] <= timeout_s:
-                        addr = entry["addr"]
+                    cand = pickle.loads(blob)["addr"]
+                    # liveness probe: a leftover key from a crashed group
+                    # (read before rank 0's delete) or an abandoned
+                    # bind-retry port refuses the connection — keep
+                    # polling until a LIVE coordinator answers, instead of
+                    # hanging initialize against a dead address
+                    if self._probe(cand):
+                        addr = cand
                         break
                 if time.monotonic() > deadline:
                     raise TimeoutError(
-                        f"group {self.group_name}: no coordinator within "
-                        f"{timeout_s}s")
+                        f"group {self.group_name}: no live coordinator "
+                        f"within {timeout_s}s")
                 time.sleep(_POLL_S)
-        self._ensure_jax_distributed(addr)
+            self._ensure_jax_distributed(addr)
         if self.rank == 0:
             # initialize() returns once every process has joined — the
             # rendezvous key has served its purpose
@@ -124,7 +138,51 @@ class XlaMultihostGroup:
                 f"{self.world_size}")
         devs = [per_proc[i] for i in range(self.world_size)]
         self.mesh = Mesh(np.array(devs), ("p",))
+        self._rank_dev = devs
         self._local_dev = per_proc[jax.process_index()]
+        self._pair_meshes: Dict[Tuple[int, int], Any] = {}
+
+    @staticmethod
+    def _probe(addr: str) -> bool:
+        import socket
+
+        host, port = addr.rsplit(":", 1)
+        try:
+            with socket.create_connection((host, int(port)), timeout=1.0):
+                return True
+        except OSError:
+            return False
+
+    def _start_coordinator(self, timeout_s: float) -> str:
+        """Rank 0: publish an address, then bind the coordinator inside
+        jax.distributed.initialize. The free-port probe is only a hint —
+        if the port is taken between probe and bind (TOCTOU), we re-pick
+        a port, re-publish, and retry instead of failing."""
+        import socket
+
+        host = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+        last = None
+        for _ in range(3):
+            with socket.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+            addr = f"{host}:{port}"
+            self._client.kv_put(
+                _COORD_NS, self._coord_key(),
+                pickle.dumps({"addr": addr, "nonce": os.urandom(8).hex()}),
+                overwrite=True)
+            try:
+                self._ensure_jax_distributed(addr)
+                return addr
+            except RuntimeError as e:
+                # bind race lost: retry with a fresh port. Anything else
+                # (geometry mismatch, member crash) propagates.
+                if "bind" not in str(e).lower():
+                    raise
+                last = e
+        raise RuntimeError(
+            f"group {self.group_name}: coordinator could not bind "
+            f"after 3 attempts: {last}")
 
     def _ensure_jax_distributed(self, addr: str) -> None:
         """Join (or reuse) this process's jax.distributed cluster.
@@ -151,6 +209,19 @@ class XlaMultihostGroup:
         jax.distributed.initialize(coordinator_address=addr,
                                    num_processes=self.world_size,
                                    process_id=self.rank)
+
+    def _publish_membership(self) -> None:
+        """worker-id -> (group, rank) in the head KV: lets the device
+        object store route a get() between gang members over the ICI
+        data plane instead of host staging."""
+        try:
+            wid = self._client.worker_id.hex()
+            self._client.kv_put(
+                _MEMBER_NS, wid.encode(),
+                pickle.dumps({"group": self.group_name, "rank": self.rank,
+                              "world": self.world_size}), overwrite=True)
+        except Exception:
+            pass  # membership routing is an optimization, never fatal
 
     # ------------------------------------------------------------- data plane
     def _global(self, x: np.ndarray):
@@ -188,31 +259,67 @@ class XlaMultihostGroup:
 
         # in-place semantics match the kv/reference backends: the caller's
         # tensor holds the reduced value afterwards
-        return _write_back(tensor, self._allreduce_np(np.asarray(tensor), op))
+        with self._op_lock:
+            out = self._allreduce_np(np.asarray(tensor), op)
+        return _write_back(tensor, out)
 
     def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM,
                timeout=None):
+        """Reduce-to-one, lowered as psum: on a ring interconnect a reduce
+        moves the same bytes as an allreduce (reduce-scatter phase is
+        identical; the gather phase converges on dst), and XLA exposes no
+        cheaper pairwise-accumulate — so this is bandwidth-optimal, not a
+        shortcut."""
         from ray_tpu.util.collective.kv_group import _write_back
 
-        out = self._allreduce_np(np.asarray(tensor), op)
+        with self._op_lock:
+            out = self._allreduce_np(np.asarray(tensor), op)
         if self.rank == dst_rank:
             return _write_back(tensor, out)
         return tensor
 
     def broadcast(self, tensor, src_rank: int = 0, timeout=None):
+        """Binomial-tree broadcast: ceil(log2(world)) ppermute rounds with
+        unique (src,dst) pairs per round. Moves (world-1)·size bytes total
+        at log depth — a real broadcast lowering, not the old 2x-traffic
+        zeros-allreduce."""
+        import jax.numpy as jnp
+        from jax import lax
+
         from ray_tpu.util.collective.kv_group import _write_back
 
         x = np.asarray(tensor)
-        contrib = x if self.rank == src_rank else np.zeros_like(x)
-        return _write_back(tensor, self._allreduce_np(contrib, ReduceOp.SUM))
+        world, src = self.world_size, src_rank
+
+        def real(v):  # virtual rank (src-rooted) -> mesh rank
+            return (v + src) % world
+
+        def fn(a):
+            idx = lax.axis_index("p")
+            v = (idx - src) % world
+            step = 1
+            while step < world:
+                pairs = [(real(i), real(i + step))
+                         for i in range(step) if i + step < world]
+                moved = lax.ppermute(a, "p", pairs)
+                is_dst = jnp.logical_and(v >= step, v < 2 * step)
+                a = jnp.where(is_dst, moved, a)
+                step *= 2
+            return a
+
+        with self._op_lock:
+            out = self._shard_map(fn, self._global(x))
+            local = self._local_of(out)
+        return _write_back(tensor, local)
 
     def allgather(self, tensor, timeout=None) -> List[np.ndarray]:
         from jax import lax
 
         x = np.asarray(tensor)
-        out = self._shard_map(
-            lambda a: lax.all_gather(a[0], "p")[None], self._global(x))
-        gathered = self._local_of(out)  # [world, ...]
+        with self._op_lock:
+            out = self._shard_map(
+                lambda a: lax.all_gather(a[0], "p")[None], self._global(x))
+            gathered = self._local_of(out)  # [world, ...]
         return [gathered[i] for i in range(self.world_size)]
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM, timeout=None):
@@ -224,38 +331,127 @@ class XlaMultihostGroup:
                 f"{self.world_size}")
         # psum the full [world, ...] then each rank keeps its slice — XLA
         # lowers psum+slice to reduce-scatter on device meshes
-        return self._allreduce_np(arr, op)[self.rank]
+        with self._op_lock:
+            return self._allreduce_np(arr, op)[self.rank]
 
     def barrier(self, timeout=None):
         from jax.experimental import multihost_utils
 
         # name must be IDENTICAL on every process (it is hashed and
         # compared); a per-group counter keeps successive barriers distinct
-        self._barrier_seq = getattr(self, "_barrier_seq", 0) + 1
-        multihost_utils.sync_global_devices(
-            f"{self.group_name}:barrier:{self._barrier_seq}")
+        with self._op_lock:
+            self._barrier_seq = getattr(self, "_barrier_seq", 0) + 1
+            multihost_utils.sync_global_devices(
+                f"{self.group_name}:barrier:{self._barrier_seq}")
 
     # ------------------------------------------------------------------- p2p
-    def _fallback(self):
-        if self._kv_fallback is None:
-            from ray_tpu.util.collective.kv_group import KVCollectiveGroup
+    def _pair_mesh(self, src: int, dst: int):
+        from jax.sharding import Mesh
 
-            self._kv_fallback = KVCollectiveGroup(
-                self._client, f"{self.group_name}:p2p", self.world_size,
-                self.rank)
-        return self._kv_fallback
+        key = (src, dst)
+        mesh = self._pair_meshes.get(key)
+        if mesh is None:
+            mesh = Mesh(np.array([self._rank_dev[src], self._rank_dev[dst]]),
+                        ("pp",))
+            self._pair_meshes[key] = mesh
+        return mesh
+
+    def _p2p_program(self, local_arr, src: int, dst: int):
+        """Both peers execute ONE ppermute program on their 2-device pair
+        mesh: src's shard moves to dst's device over the interconnect
+        (ICI/DCN on TPU, gloo on the CPU CI incarnation). `local_arr` may
+        be a jax.Array already resident on our device (no host bounce) or
+        a numpy array (one H2D). Returns the receiver-side output STILL ON
+        DEVICE so device consumers never round-trip host.
+
+        Like NCCL Send/Recv, a pair program blocks until BOTH peers enter
+        it and cannot be preempted — a dead peer hangs the call, and the
+        relative order of programs launched on one group must match on
+        every participating member (hence `_op_lock`)."""
+        import jax
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._pair_mesh(src, dst)
+        if isinstance(local_arr, jax.Array):
+            local = local_arr[None]          # stays on its (our) device
+            shape = tuple(local_arr.shape)
+        else:
+            x = np.ascontiguousarray(local_arr)
+            local = jax.device_put(x[None], self._local_dev)
+            shape = x.shape
+        sharding = NamedSharding(mesh, P("pp", *([None] * len(shape))))
+        # exactly the addressable shards of THIS process (one of the two)
+        g = jax.make_array_from_single_device_arrays(
+            (2,) + shape, sharding, [local])
+        out = jax.shard_map(
+            lambda a: lax.ppermute(a, "pp", [(0, 1)]),
+            mesh=mesh, in_specs=P("pp"), out_specs=P("pp"))(g)
+        return out.addressable_shards[0].data  # [1, ...] on local device
 
     def send(self, tensor, dst_rank: int, timeout=None):
-        self._fallback().send(tensor, dst_rank, timeout=timeout)
+        """NCCL-parity p2p: blocks until the peer enters recv; `timeout`
+        is accepted for API parity but a device-plane collective cannot be
+        preempted once launched (same as the reference's NCCL backend)."""
+        if dst_rank == self.rank:
+            raise ValueError("send to self")
+        with self._op_lock:
+            self._p2p_program(np.asarray(tensor), self.rank, dst_rank)
 
     def recv(self, tensor, src_rank: int, timeout=None):
-        return self._fallback().recv(tensor, src_rank, timeout=timeout)
+        from ray_tpu.util.collective.kv_group import _write_back
+
+        if src_rank == self.rank:
+            raise ValueError("recv from self")
+        buf = np.asarray(tensor)
+        with self._op_lock:
+            out = self._p2p_program(np.zeros_like(buf), src_rank, self.rank)
+        return _write_back(tensor, np.asarray(out)[0])
+
+    def send_device(self, leaf, dst_rank: int):
+        """Device-plane send of a jax leaf (device-object ICI fetch): the
+        leaf feeds the pair mesh directly from HBM — no D2H/H2D bounce.
+
+        Bounded lock acquire: if this process is wedged in another
+        collective (e.g. a mutual bidirectional fetch — a known ordering
+        hazard shared with NCCL p2p), fail loudly instead of deadlocking
+        the executor thread forever."""
+        if not self._op_lock.acquire(timeout=120):
+            raise TimeoutError(
+                f"group {self.group_name}: collective order lock held for "
+                ">120s — concurrent conflicting collectives on this group")
+        try:
+            self._p2p_program(leaf, self.rank, dst_rank)
+        finally:
+            self._op_lock.release()
+
+    def recv_device(self, shape, dtype, src_rank: int):
+        """Device-plane recv returning a jax.Array on our device."""
+        with self._op_lock:
+            out = self._p2p_program(np.zeros(shape, dtype=dtype),
+                                    src_rank, self.rank)
+        return out[0]
 
     def destroy(self):
-        if self._kv_fallback is not None:
-            self._kv_fallback.destroy()
+        try:
+            self._client.kv_del(_MEMBER_NS,
+                                self._client.worker_id.hex().encode())
+        except Exception:
+            pass
         if self.rank == 0:
             try:
                 self._client.kv_del(_COORD_NS, self._coord_key())
             except Exception:
                 pass
+
+
+def lookup_membership(client, worker_id_hex: str) -> Optional[dict]:
+    """Head-KV lookup: is `worker_id` a live gang member? Used by the
+    device object store to pick the ICI path between gang peers."""
+    try:
+        blob = client.kv_get(_MEMBER_NS, worker_id_hex.encode())
+    except Exception:
+        return None
+    if not blob:
+        return None
+    return pickle.loads(blob)

@@ -86,3 +86,55 @@ def test_four_process_group(cluster):
     _check_matrix(outs, 4)
     for m in members:
         ray_tpu.kill(m)
+
+
+@ray_tpu.remote
+class IciMember:
+    """Gang member exercising device-object get() over the ICI mesh."""
+
+    def __init__(self, world, rank, name):
+        import ray_tpu.util.collective as col
+
+        self.rank = rank
+        col.init_collective_group(world, rank, backend="xla-multihost",
+                                  group_name=name)
+
+    def put_value(self):
+        import jax.numpy as jnp
+
+        v = {"w": jnp.arange(64.0).reshape(8, 8) + 100 * self.rank,
+             "tag": f"rank{self.rank}"}
+        return ray_tpu.put_device(v).hex()
+
+    def get_value(self, hex_id):
+        import jax
+        import numpy as np
+
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        val = ray_tpu.get(ObjectRef(ObjectID.from_hex(hex_id)), timeout=120)
+        assert isinstance(val["w"], jax.Array), type(val["w"])
+        return {"w": np.asarray(val["w"]), "tag": val["tag"]}
+
+    def staged_snapshots(self):
+        """How many host snapshots this process staged (must stay 0 for
+        gang-internal fetches: bytes ride the device mesh, not shm)."""
+        from ray_tpu.core.api import _global_client
+
+        return len(_global_client()._device_snapshots)
+
+
+def test_device_object_fetch_over_ici(cluster):
+    """get() of a peer's device object inside a gang rides the pair-mesh
+    ppermute path: jax leaves arrive as device arrays and the owner never
+    stages a host snapshot."""
+    members = [IciMember.options(runtime_env={"env_vars": MEMBER_ENV}).remote(
+        2, r, "xmh_ici") for r in range(2)]
+    hex_id = ray_tpu.get(members[0].put_value.remote(), timeout=120)
+    out = ray_tpu.get(members[1].get_value.remote(hex_id), timeout=120)
+    np.testing.assert_allclose(out["w"], np.arange(64.0).reshape(8, 8))
+    assert out["tag"] == "rank0"
+    assert ray_tpu.get(members[0].staged_snapshots.remote(), timeout=60) == 0
+    for m in members:
+        ray_tpu.kill(m)
