@@ -259,8 +259,14 @@ def main(out_path: str | None = None) -> dict:
                                                        repeat=2)
 
     ray_tpu.shutdown()
+    import os as _os
+
     report = {"metrics": {k: round(v, 2) for k, v in results.items()},
               "unit": "ops/s (put: GB/s; *_s: seconds)",
+              # reference numbers come from a 64-vCPU m5.16xlarge; compare
+              # per-core when this host is smaller (multi-client phases
+              # cannot exceed single-client on a 1-vCPU box)
+              "host": {"cpus": _os.cpu_count()},
               "reference": {  # m5.16xlarge numbers from BASELINE.md §6
                   "1_1_actor_calls_sync": 2012,
                   "1_1_actor_calls_async": 8664,

@@ -56,7 +56,7 @@ def is_initialized() -> bool:
 
 def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
          num_tpu_chips: Optional[int] = None, resources: Optional[dict] = None,
-         object_store_bytes: int = 2 << 30, max_workers: Optional[int] = None,
+         object_store_bytes: Optional[int] = None, max_workers: Optional[int] = None,
          namespace: str = "default") -> dict:
     """Start (or join) a cluster and connect this process as the driver."""
     global _client, _head_proc
@@ -69,7 +69,9 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             session = f"s{uuid.uuid4().hex[:12]}"
             cmd = [sys.executable, "-m", "ray_tpu.core.head_main",
                    "--session", session,
-                   "--object-store-bytes", str(object_store_bytes)]
+                   "--object-store-bytes",
+                   str(object_store_bytes
+                       if object_store_bytes is not None else -1)]
             if num_cpus is not None:
                 cmd += ["--num-cpus", str(num_cpus)]
             if num_tpu_chips is not None:

@@ -31,6 +31,19 @@ from ray_tpu.core.serialization import SerializedObject
 ARGS_INLINE_LIMIT = 512 * 1024  # args bigger than this go through the store
 
 
+class _Lease:
+    """A worker granted to this client for direct task pushes."""
+
+    __slots__ = ("worker_id", "addr", "inflight", "last_used", "dead")
+
+    def __init__(self, worker_id: WorkerID, addr: Tuple[str, int]):
+        self.worker_id = worker_id
+        self.addr = addr
+        self.inflight = 0
+        self.last_used = time.monotonic()
+        self.dead = False
+
+
 class CoreClient:
     def __init__(self, head_host: str, head_port: int, session: str,
                  is_driver: bool, handlers: Optional[dict] = None):
@@ -54,6 +67,8 @@ class CoreClient:
                                         self._on_free_device_object)
         self._extra_handlers.setdefault("evicted_object",
                                         self._on_evicted_object)
+        self._extra_handlers.setdefault("lease_revoke",
+                                        self._on_lease_revoke_msg)
         self._direct: Dict[Tuple[str, int], protocol.Connection] = {}
         self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
         self.loop = asyncio.new_event_loop()
@@ -80,6 +95,15 @@ class CoreClient:
         # staging dedup (freed with the device object)
         self._device_snapshots: Dict[ObjectID, ObjectMeta] = {}
         self._staging: Dict[ObjectID, asyncio.Future] = {}
+        # worker leases for direct task pushes (reference
+        # NormalTaskSubmitter lease reuse): shape key -> _Lease
+        self._leases: Dict[tuple, "_Lease"] = {}
+        self._draining: list = []  # revoked leases with in-flight pushes
+        self._lease_acquiring: set = set()
+        self._lease_lock = threading.Lock()
+        self._lease_idle_s = float(os.environ.get("RAY_TPU_LEASE_IDLE_S",
+                                                  "1.0"))
+        self._lease_reaper_started = False
         self._pull_sem: Optional[asyncio.Semaphore] = None
         self._pulled: "OrderedDict[ObjectID, ObjectMeta]" = OrderedDict()
         self._pulled_lock = threading.Lock()  # loop inserts, user threads free
@@ -683,13 +707,14 @@ class CoreClient:
                     cfut = self._pending_calls.get(ref.id)
                 if cfut is not None:
                     meta = (await asyncio.wrap_future(cfut))["meta"]
-                    self.local_metas[ref.id] = meta
                     with self._pending_lock:
                         self._pending_calls.pop(ref.id, None)
-                else:
+                if cfut is None or meta is None:
+                    # no pending call, or a lease failover resubmitted the
+                    # task through the head: resolve via the directory
                     meta = await self.conn.request(
                         "get_meta", object_id=ref.id.binary(), timeout=None)
-                    self.local_metas[ref.id] = meta
+                self.local_metas[ref.id] = meta
             value = await self._read_value_async(meta)
             if meta.error or isinstance(value, RayTpuError):
                 raise value
@@ -707,8 +732,19 @@ class CoreClient:
                 return True
             with self._pending_lock:
                 cfut = self._pending_calls.get(r.id)
-            # a finished-but-failed actor call counts as ready: get() surfaces it
-            return cfut is not None and cfut.done()
+            if cfut is None or not cfut.done():
+                return False
+            # a finished-but-failed call counts as ready (get surfaces it);
+            # a lease failover (None meta) is NOT ready — the resubmitted
+            # task resolves through the head directory instead
+            try:
+                if cfut.result()["meta"] is None:
+                    with self._pending_lock:
+                        self._pending_calls.pop(r.id, None)
+                    return False
+            except BaseException:
+                pass
+            return True
 
         while True:
             ready_set.update(r for r in refs if check_local(r))
@@ -812,6 +848,170 @@ class CoreClient:
         for oid, token in tokens or []:
             self.ref_tracker.borrow_commit(oid, token)
 
+    # ------------------------------------------------------------- leases
+    @staticmethod
+    def _lease_shape(fn_key: bytes, options: dict) -> tuple:
+        res = options.get("resources") or {"CPU": 1}
+        return (fn_key, tuple(sorted(res.items())))
+
+    @staticmethod
+    def _lease_eligible(options: dict, num_returns) -> bool:
+        """Direct pushes cover the common shape; anything needing the
+        head's placement machinery takes the scheduled path."""
+        return (num_returns == 1
+                and options.get("num_returns") != "streaming"
+                and not options.get("placement_group")
+                and not options.get("label_selector")
+                and not options.get("runtime_env")
+                and options.get("scheduling_strategy", "hybrid") == "hybrid")
+
+    def _maybe_acquire_lease(self, shape: tuple, options: dict) -> None:
+        """Fire-and-forget lease acquisition — never blocks a submit."""
+        with self._lease_lock:
+            if shape in self._leases or shape in self._lease_acquiring:
+                return
+            self._lease_acquiring.add(shape)
+
+        async def _acquire():
+            try:
+                rep = await self.conn.request("acquire_lease",
+                                              options=options)
+                if rep is not None:
+                    lease = _Lease(WorkerID(rep["worker_id"]),
+                                   tuple(rep["addr"]))
+                    with self._lease_lock:
+                        self._leases[shape] = lease
+                    self._start_lease_reaper()
+            finally:
+                with self._lease_lock:
+                    self._lease_acquiring.discard(shape)
+
+        asyncio.run_coroutine_threadsafe(_acquire(), self.loop)
+
+    def _start_lease_reaper(self) -> None:
+        if self._lease_reaper_started:
+            return
+        self._lease_reaper_started = True
+
+        def _reap():
+            now = time.monotonic()
+            dead = []
+            with self._lease_lock:
+                for shape, lease in list(self._leases.items()):
+                    if (lease.dead or (lease.inflight == 0 and
+                                       now - lease.last_used > self._lease_idle_s)):
+                        dead.append((shape, lease))
+                        del self._leases[shape]
+            for shape, lease in dead:
+                try:
+                    self.conn.push("release_lease",
+                                   worker_id=lease.worker_id.binary())
+                except Exception:
+                    pass
+            self.loop.call_later(max(self._lease_idle_s / 2, 0.25), _reap)
+
+        self.loop.call_soon_threadsafe(
+            lambda: self.loop.call_later(self._lease_idle_s, _reap))
+
+    async def _on_lease_revoke_msg(self, worker_id):
+        self._on_lease_revoke(worker_id)
+        return True
+
+    def _on_lease_revoke(self, worker_id: bytes) -> None:
+        """Head wants the worker back. Stop submitting NOW, but only
+        hand it back once in-flight pushes drain — releasing a busy
+        worker would let the head queue new tasks behind ours, and if one
+        of ours blocks on an object THOSE tasks produce, that's deadlock."""
+        wid = WorkerID(worker_id)
+        release_now = False
+        with self._lease_lock:
+            for shape, lease in list(self._leases.items()):
+                if lease.worker_id == wid:
+                    del self._leases[shape]
+                    if lease.inflight == 0:
+                        release_now = True
+                    else:
+                        lease.dead = True  # drain in _lease_exec_async
+                        self._draining.append(lease)
+        if release_now:
+            try:
+                self.conn.push("release_lease", worker_id=worker_id)
+            except Exception:
+                pass
+
+    async def _lease_exec_async(self, lease: "_Lease", spec: dict):
+        """Push one task to the leased worker; on a dead worker/lease the
+        task is resubmitted through the head (same return ids — the head
+        path seals them) and the pending-call resolves to a None meta so
+        get() falls through to the head directory."""
+        try:
+            conn = self._direct.get(lease.addr)
+            if conn is None or conn.closed:
+                reader_writer = await asyncio.open_connection(*lease.addr)
+                conn = protocol.Connection(*reader_writer,
+                                           name=f"lease-{lease.addr[1]}")
+                conn.start()
+                self._direct[lease.addr] = conn
+            rep = await conn.request("lease_exec", spec=spec)
+            if rep.get("retired"):
+                lease.dead = True
+            return rep
+        except (protocol.ConnectionLost, protocol.RpcError,
+                ConnectionRefusedError, OSError):
+            lease.dead = True
+            # failover: the scheduled path retries/fails it properly
+            self.conn.push("submit_task", spec=spec)
+            return {"meta": None}
+        finally:
+            lease.inflight -= 1
+            lease.last_used = time.monotonic()
+            if lease.dead and lease.inflight == 0 and lease in self._draining:
+                # revoked mid-burst: last in-flight push done, hand it back
+                self._draining.remove(lease)
+                try:
+                    self.conn.push("release_lease",
+                                   worker_id=lease.worker_id.binary())
+                except Exception:
+                    pass
+
+    def _try_lease_submit(self, fn_key, payload, deps, tokens, options,
+                          task_id, return_id: ObjectID) -> bool:
+        shape = self._lease_shape(fn_key, options)
+        with self._lease_lock:
+            lease = self._leases.get(shape)
+            if lease is None or lease.dead:
+                lease = None
+            else:
+                lease.inflight += 1
+                lease.last_used = time.monotonic()
+        if lease is None:
+            self._maybe_acquire_lease(shape, options)
+            return False
+        spec = {"task_id": task_id, "fn_key": fn_key, "args": payload,
+                "deps": deps, "return_ids": [return_id.binary()],
+                "borrows": [(o.binary(), t) for o, t in tokens],
+                "options": options}
+        # caller-held pins keep deps alive until completion (the head is
+        # not involved, so it cannot pin them — same as direct actor
+        # calls); deps already includes the big-args payload object
+        pins = [ObjectRef(ObjectID(b)) for b in deps]
+        cfut = asyncio.run_coroutine_threadsafe(
+            self._lease_exec_async(lease, spec), self.loop)
+        with self._pending_lock:
+            self._pending_calls[return_id] = cfut
+
+        def _on_done(f, _pins=pins):
+            _pins.clear()
+            try:
+                meta = f.result()["meta"]
+            except BaseException:
+                return
+            if meta is not None:
+                self.local_metas[meta.object_id] = meta
+
+        cfut.add_done_callback(_on_done)
+        return True
+
     def submit_task(self, fn_key: bytes, args: tuple, kwargs: dict,
                     options: dict, num_returns: int = 1) -> List[ObjectRef]:
         payload, deps, tokens = self.build_args_payload(args, kwargs)
@@ -822,6 +1022,10 @@ class CoreClient:
             deps = deps + [payload["meta"].object_id.binary()]
         task_id = TaskID.generate()
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
+        if (self._lease_eligible(options, num_returns)
+                and self._try_lease_submit(fn_key, payload, deps, tokens,
+                                           options, task_id, return_ids[0])):
+            return [ObjectRef(return_ids[0])]
         spec = {"task_id": task_id, "fn_key": fn_key, "args": payload,
                 "deps": deps, "return_ids": [o.binary() for o in return_ids],
                 # head releases these if the task dies before any worker
@@ -937,6 +1141,10 @@ class CoreClient:
             return False
         try:
             meta = cfut.result(timeout=timeout)["meta"]
+            if meta is None:
+                # lease failover: the task was resubmitted through the
+                # head — resolve via the head directory instead
+                return False
             self.local_metas[meta.object_id] = meta
         except TimeoutError:
             raise GetTimeoutError(f"actor call {oid} not finished in time")

@@ -97,6 +97,9 @@ class WorkerInfo:
         self.current_record = None
         self.retiring = False  # max_calls reached; exiting after current task
         self.host: Optional[str] = None  # peer host of the registration conn
+        # lease protocol: WorkerID of the client this worker is leased to
+        # for direct task pushes (None = scheduled by the head)
+        self.leased_to: Optional[WorkerID] = None
 
 
 class ActorInfo:
@@ -255,7 +258,8 @@ class PlacementGroupInfo:
 class Head:
     def __init__(self, session: str, num_cpus: Optional[float] = None,
                  resources: Optional[dict] = None, num_tpu_chips: Optional[int] = None,
-                 object_store_bytes: int = 2 << 30, max_workers: Optional[int] = None,
+                 object_store_bytes: Optional[int] = None,
+                 max_workers: Optional[int] = None,
                  labels: Optional[dict] = None):
         self.session = session
         self.node_id = NodeID.generate()
@@ -268,6 +272,11 @@ class Head:
                                   conn=None, max_workers=head_max, is_head=True)
         self.nodes: Dict[NodeID, NodeInfo] = {self.node_id: self.head_node}
 
+        from ray_tpu.core.store import default_store_bytes
+
+        if object_store_bytes is None or object_store_bytes <= 0:
+            # reference-parity: 30% of RAM capped by /dev/shm (node.py:1409)
+            object_store_bytes = default_store_bytes()
         self.store = SharedMemoryStore(
             session, capacity_bytes=object_store_bytes, create_arena=True,
             namespace=(self.node_id.hex()[:8]
@@ -748,6 +757,37 @@ class Head:
 
         async def list_state(kind):
             return self._list_state(kind)
+
+        async def acquire_lease(options):
+            """Grant an idle worker to the requesting client for DIRECT
+            task pushes — the reference's lease protocol
+            (`normal_task_submitter.cc:328` RequestWorkerLease + `:515`
+            PushNormalTask): once granted, same-shape submissions bypass
+            this head entirely until the lease is released/revoked."""
+            w = conn_state.get("worker")
+            if w is None:
+                return None
+            resources = options.get("resources", {"CPU": 1})
+            node = self._select_node(resources, options.get("label_selector"),
+                                     options.get("scheduling_strategy",
+                                                 "hybrid"))
+            if node is None:
+                return None
+            lw = self._idle_worker_on(node)
+            if lw is None:
+                self._request_worker(node)  # warm the pool for a retry
+                return None
+            self._acquire(lw, resources)
+            lw.leased_to = w.worker_id
+            return {"worker_id": lw.worker_id.binary(),
+                    "addr": (lw.host or "127.0.0.1", lw.port)}
+
+        async def release_lease(worker_id):
+            lw = self.workers.get(WorkerID(worker_id))
+            if lw is not None and getattr(lw, "leased_to", None) is not None:
+                lw.leased_to = None
+                self.notify_task_done(lw)  # resources back + idle + kick
+            return True
 
         async def task_done(task_id):
             w = conn_state.get("worker")
@@ -1385,6 +1425,16 @@ class Head:
         # further to do here beyond a safety valve for empty pools
         if not self.queue:
             return
+        # fairness: queued work + leased-out workers → ask one holder to
+        # give its worker back (reference lease stealing/cancellation)
+        for lw in self.workers.values():
+            if lw.leased_to is not None:
+                holder = self.workers.get(lw.leased_to)
+                if (holder is not None and holder.conn is not None
+                        and not holder.conn.closed):
+                    holder.conn.push("lease_revoke",
+                                     worker_id=lw.worker_id.binary())
+                    break
 
     def _spawn_local_worker(self) -> None:
         from ray_tpu.core.resources import strip_device_env
@@ -1420,6 +1470,11 @@ class Head:
             if meta.owner == w.worker_id and oid not in self.obj_interest_seen:
                 self.obj_interest_seen.add(oid)
                 self._maybe_evict(oid)
+        # a dead client's leased workers go back to the pool
+        for lw in self.workers.values():
+            if lw.leased_to == w.worker_id:
+                lw.leased_to = None
+                self.notify_task_done(lw)
         self.workers.pop(w.worker_id, None)
         node = self.nodes.get(w.node_id)
         if node is not None:
@@ -1985,6 +2040,7 @@ class Head:
         self._release(w)
         node = self.nodes.get(w.node_id)
         if (not w.is_driver and w.actor_id is None and not w.retiring
+                and w.leased_to is None
                 and node is not None and w not in node.idle):
             node.idle.append(w)
         self._kick()

@@ -97,7 +97,7 @@ def main() -> None:
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpu-chips", type=int, default=None)
     p.add_argument("--resources", type=str, default=None)
-    p.add_argument("--object-store-bytes", type=int, default=2 << 30)
+    p.add_argument("--object-store-bytes", type=int, default=-1)
     p.add_argument("--max-workers", type=int, default=None)
     p.add_argument("--labels", type=str, default=None)
     p.add_argument("--no-dashboard", action="store_true")

@@ -76,12 +76,14 @@ class NodeDaemon:
             resources=self.resources, labels=self.labels,
             max_workers=self.max_workers, data_port=self.data_port)
         self.session = reply["session"]
-        from ray_tpu.core.store import SharedMemoryStore
+        from ray_tpu.core.store import (SharedMemoryStore,
+                                        default_store_bytes as _default_store_bytes)
 
         self.store = SharedMemoryStore(
             self.session,
-            capacity_bytes=int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES",
-                                              str(2 << 30))),
+            capacity_bytes=(
+                int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", "0"))
+                or _default_store_bytes()),
             create_arena=self._create_arena, namespace=self.store_ns)
         # spills retarget our local meta copy; the head owns the canonical
         # entry and must learn the new location

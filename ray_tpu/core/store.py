@@ -34,6 +34,24 @@ from ray_tpu.core.serialization import SerializedObject
 from ray_tpu.utils.platform import STATE_DIR
 
 INLINE_THRESHOLD = 100 * 1024  # small objects ride the control plane inline
+
+
+def default_store_bytes() -> int:
+    """Reference-parity sizing (`python/ray/_private/node.py:1409`
+    determine_plasma_store_config): 30% of system memory, capped by what
+    /dev/shm can actually hold. The old fixed 2 GiB default forced big
+    put workloads through watermark spilling and fresh page-faulting
+    overflow segments — the measured multi-client put regression."""
+    try:
+        ram = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        ram = 8 << 30
+    try:
+        st = os.statvfs("/dev/shm")
+        shm_free = st.f_bavail * st.f_frsize
+    except OSError:
+        shm_free = ram // 2
+    return max(512 << 20, min(int(ram * 0.30), int(shm_free * 0.80)))
 ARENA_HIGH_WATERMARK = 0.85    # head starts spilling above this fill ratio
 ARENA_LOW_WATERMARK = 0.75     # ...down to this
 

@@ -72,7 +72,10 @@ class WorkerRuntime:
         api._attach_existing_client(self.client)
         self.client.on_disconnect = lambda: self.shutdown_event.set()
         self.client.on_registered = self._apply_sys_path
-        self.client.start(direct_handlers={"actor_call": self._on_actor_call})
+        self.client.start(direct_handlers={
+            "actor_call": self._on_actor_call,
+            "lease_exec": self._on_lease_exec,
+        })
         if "driver_sys_path" not in (self.client.node_info or {}):
             self._extend_sys_path()
 
@@ -141,6 +144,48 @@ class WorkerRuntime:
         loop = asyncio.get_running_loop()
         loop.run_in_executor(self.task_executor, self._run_task, spec)
         return True
+
+    async def _on_lease_exec(self, spec):
+        """Direct task push from a lease-holding client (reference
+        PushNormalTask, `normal_task_submitter.cc:515`): executes on the
+        task thread and replies with the result meta — the head is not on
+        this path at all."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.task_executor,
+                                          self._run_lease_task, spec)
+
+    def _run_lease_task(self, spec):
+        rid = ObjectID(spec["return_ids"][0])
+        opts = spec.get("options", {})
+        task_key = spec["task_id"].binary()
+        self._task_threads[task_key] = threading.get_ident()
+        try:
+            fn = self.client.fn_manager.load(spec["fn_key"])
+            args, kwargs = self._resolve_args(spec["args"])
+            from ray_tpu.util import tracing
+
+            with tracing.execute_span(opts.get("name", "task"),
+                                      opts.get("trace_ctx")):
+                result = fn(*args, **kwargs)
+            meta = self.client.store_result(rid, result, register=False)
+        except BaseException as e:  # noqa: BLE001 - failures become error objects
+            err = e if isinstance(e, (TaskError, TaskCancelledError)) else \
+                TaskError(repr(e), traceback.format_exc())
+            meta = self.client.store_result(rid, err, register=False,
+                                            is_error=True)
+        finally:
+            self._task_threads.pop(task_key, None)
+            max_calls = opts.get("max_calls")
+            if max_calls:
+                fn_key = spec["fn_key"]
+                self._fn_calls[fn_key] = self._fn_calls.get(fn_key, 0) + 1
+                if self._fn_calls[fn_key] >= max_calls:
+                    self._retiring = True
+                    try:
+                        self.client.head_push("worker_retiring")
+                    except Exception:
+                        pass
+        return {"meta": meta, "retired": self._retiring}
 
     async def _on_cancel_task(self, task_id):
         ident = self._task_threads.get(task_id)
