@@ -21,7 +21,8 @@ from collections import OrderedDict
 
 from ray_tpu.core import object_transfer, protocol, refcount, serialization
 from ray_tpu.core.exceptions import (ActorDiedError, GetTimeoutError,
-                                     ObjectLostError, RayTpuError)
+                                     ObjectLostError, RayTpuError,
+                                     WorkerCrashedError)
 from ray_tpu.core.function_manager import FunctionManager
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
@@ -177,11 +178,18 @@ class CoreClient:
             meta = await asyncio.shield(task)
             if not self.device_store.contains(oid):
                 # freed while we were staging: the free handler saw no
-                # snapshot entry, so WE must release it or the shm leaks
-                try:
-                    self.store.free(meta)
-                except Exception:
-                    pass
+                # snapshot entry, so the snapshot must be released here or
+                # the shm leaks. Exactly ONE of the concurrent fetchers
+                # sharing this staging task may free it — the check-and-set
+                # is race-free because every waiter resumes on this loop.
+                if not getattr(task, "_orphan_freed", False):
+                    task._orphan_freed = True
+                    try:
+                        self.store.free(meta)
+                    except Exception as e:
+                        print(f"[ray_tpu] freeing orphan snapshot of "
+                              f"{oid.hex()[:12]} failed: {e!r}",
+                              file=sys.stderr, flush=True)
                 raise FileNotFoundError(f"device object {oid} freed")
             self._device_snapshots[oid] = meta
         return {"meta": meta}
@@ -471,7 +479,10 @@ class CoreClient:
         return meta
 
     def store_result(self, oid: ObjectID, value: Any, register: bool,
-                     is_error: bool = False) -> ObjectMeta:
+                     is_error: bool = False,
+                     via_head: bool = False) -> ObjectMeta:
+        """`via_head=True` promises the meta reaches the head on another
+        channel (e.g. generator_yield seals it) — skip the extra push."""
         ser = serialization.serialize(value)
         meta = self.store.put_serialized(oid, ser)
         meta.error = is_error
@@ -483,11 +494,16 @@ class CoreClient:
         self.local_metas[oid] = meta
         if register:
             self._register_meta(meta)
-        elif meta.contained:
-            # a direct actor reply embedding refs MUST reach the head: the
-            # containment pin is what keeps the inner objects alive once
-            # the producer drops its own refs. Non-blocking push — this
-            # path runs on the loop for async actor methods.
+        elif not via_head and (meta.contained or meta.kind != "inline"):
+            # Two cases where a direct-reply result MUST still reach the
+            # head. Embedded refs: the containment pin is what keeps the
+            # inner objects alive once the producer drops its own refs.
+            # Non-inline payloads: the bytes live in node storage (shm
+            # arena / spill), and only a head directory entry lets the
+            # consumer's eventual ref-drop free them — unregistered, the
+            # dec writes a tombstone and the arena bytes leak forever.
+            # Non-blocking push — this path runs on the loop for async
+            # actor methods.
             self._registered.add(oid)
             self.head_push("put_meta", meta=meta)
         return meta
@@ -959,15 +975,40 @@ class CoreClient:
         except (protocol.ConnectionLost, protocol.RpcError,
                 ConnectionRefusedError, OSError):
             lease.dead = True
-            # failover: the scheduled path retries/fails it properly
-            self.conn.push("submit_task", spec=spec)
-            return {"meta": None}
+            # The worker may have executed the task and only the reply was
+            # lost — resubmitting through the head can run it twice, so the
+            # failover is gated on the task's retry policy (reference
+            # NormalTaskSubmitter only re-queues retryable tasks on worker
+            # death). Non-retryable tasks surface a worker-died error.
+            if spec.get("options", {}).get("max_retries", 3):
+                spec["failover"] = True  # head skips the duplicate holder add
+                self.conn.push("submit_task", spec=spec)
+                return {"meta": None}
+            rid = ObjectID(spec["return_ids"][0])
+            # terminal failure: the head never sees this spec and the dead
+            # worker never deserialized the args, so the client must drop
+            # the borrow pins itself (idempotent vs a racing worker commit)
+            self.release_borrows(
+                [(ObjectID(b), t) for b, t in spec.get("borrows", [])])
+            err = WorkerCrashedError(
+                f"leased worker {lease.worker_id.hex()[:12]} died executing "
+                f"a task with max_retries=0; the task may or may not have "
+                f"run")
+            meta = self.store_result(rid, err, register=True, is_error=True)
+            return {"meta": meta}
         finally:
-            lease.inflight -= 1
-            lease.last_used = time.monotonic()
-            if lease.dead and lease.inflight == 0 and lease in self._draining:
-                # revoked mid-burst: last in-flight push done, hand it back
-                self._draining.remove(lease)
+            with self._lease_lock:
+                # _try_lease_submit increments under this lock from user
+                # threads; an unlocked decrement here can lose an update and
+                # strand a positive count, leaking the leased worker
+                lease.inflight -= 1
+                lease.last_used = time.monotonic()
+                release = (lease.dead and lease.inflight == 0
+                           and lease in self._draining)
+                if release:
+                    # revoked mid-burst: last in-flight push done
+                    self._draining.remove(lease)
+            if release:
                 try:
                     self.conn.push("release_lease",
                                    worker_id=lease.worker_id.binary())

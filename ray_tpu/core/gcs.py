@@ -411,11 +411,16 @@ class Head:
         async def submit_task(spec):
             w = conn_state["worker"]
             rec = TaskRecord(spec, w)
-            for rid in spec["return_ids"]:
-                # the submitter constructs ObjectRefs for every return id;
-                # record it as holder NOW so a fast task's sealed result
-                # can't be evicted before the submitter's inc flush lands
-                self._add_holder(ObjectID(rid), w.worker_id)
+            if not spec.get("failover"):
+                for rid in spec["return_ids"]:
+                    # the submitter constructs ObjectRefs for every return
+                    # id; record it as holder NOW so a fast task's sealed
+                    # result can't be evicted before the submitter's inc
+                    # flush lands. Lease-failover resubmissions skip this:
+                    # their inc landed long ago (and may already have a
+                    # matching dec), so a re-added holder entry would never
+                    # be released and the sealed result would leak.
+                    self._add_holder(ObjectID(rid), w.worker_id)
             if spec["options"].get("num_returns") != "streaming":
                 entry = {"spec": spec, "produced": set(),
                          "recon_left": spec["options"].get("max_retries", 3),

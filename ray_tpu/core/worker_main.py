@@ -267,7 +267,9 @@ class WorkerRuntime:
         count = 0
         for item in result:
             oid = ObjectID.generate()
-            meta = self.client.store_result(oid, item, register=False)
+            # via_head: generator_yield seals this meta at the head itself
+            meta = self.client.store_result(oid, item, register=False,
+                                            via_head=True)
             # the head seals the meta; the reply is delayed for backpressure
             self.client.head_request("generator_yield", gen_id=gen_id.binary(),
                                      meta=meta, backpressure=backpressure)

@@ -116,3 +116,45 @@ def test_lease_worker_death_falls_back(cluster):
         except ProcessLookupError:
             pass
     assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(50)]
+
+
+def test_lease_result_registered_and_reclaimed(cluster):
+    """Regression (r3 advisor, high): a lease-path task result above the
+    inline threshold must be registered with the head — otherwise the
+    consumer's ref-drop writes a tombstone and the bytes leak in the
+    worker's arena forever. Asserts both halves: the result appears in
+    the head directory, and dropping the ref evicts it."""
+    import gc
+
+    import numpy as np
+
+    @ray_tpu.remote
+    def big_result(n):
+        return np.ones((n,), dtype=np.uint8)
+
+    # establish a lease for this shape
+    assert int(ray_tpu.get(big_result.remote(8), timeout=30).sum()) == 8
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not _client()._leases:
+        ray_tpu.get(big_result.remote(8), timeout=30)
+    assert _client()._leases, "lease never established"
+
+    ref = big_result.remote(300_000)  # > inline threshold: lands in shm
+    assert int(ray_tpu.get(ref, timeout=30).sum()) == 300_000
+
+    def _object_ids():
+        return {o["object_id"] for o in _client().head_request(
+            "list_state", kind="objects")}
+
+    oid = ref.hex()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and oid not in _object_ids():
+        time.sleep(0.1)
+    assert oid in _object_ids(), \
+        "lease-path result never registered with the head (leak)"
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and oid in _object_ids():
+        time.sleep(0.1)
+    assert oid not in _object_ids(), "dropped lease result not evicted"
