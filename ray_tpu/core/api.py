@@ -65,6 +65,19 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             return _client.node_info
         if address is None and (env_addr := os.environ.get("RAY_TPU_ADDRESS")):
             address = env_addr
+        if address is not None and address.startswith("ray-tpu://"):
+            # remote-driver mode (reference Ray Client, `ray://host:port`):
+            # everything rides one multiplexed connection to the head-side
+            # proxy — no reachability to workers/data servers/shm needed
+            from ray_tpu.client_proxy.client import (ProxyClient,
+                                                     parse_proxy_address)
+
+            host, port = parse_proxy_address(address)
+            client = ProxyClient(host, port)
+            client.start()
+            _client = client
+            atexit.register(shutdown)
+            return client.node_info
         if address is None:
             session = f"s{uuid.uuid4().hex[:12]}"
             cmd = [sys.executable, "-m", "ray_tpu.core.head_main",

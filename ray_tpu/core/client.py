@@ -70,6 +70,10 @@ class CoreClient:
                                         self._on_evicted_object)
         self._extra_handlers.setdefault("lease_revoke",
                                         self._on_lease_revoke_msg)
+        if is_driver:
+            # streamed worker-log lines (task/actor prints) land at the
+            # submitting terminal by default (reference print_logs)
+            self._extra_handlers.setdefault("log_lines", self._on_log_lines)
         self._direct: Dict[Tuple[str, int], protocol.Connection] = {}
         self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
         self.loop = asyncio.new_event_loop()
@@ -131,6 +135,13 @@ class CoreClient:
                 self.store.free(snap)  # staged host copy dies with the value
             except Exception:
                 pass
+        return True
+
+    async def _on_log_lines(self, entries):
+        """Head-streamed worker log lines: print at this driver."""
+        from ray_tpu.core import worker_logs
+
+        worker_logs.print_driver_entries(entries)
         return True
 
     async def _on_evicted_object(self, meta):
@@ -319,7 +330,8 @@ class CoreClient:
         self.node_info = await self.conn.request(
             "register_worker", worker_id=self.worker_id.binary(), pid=os.getpid(),
             port=self.direct_port, is_driver=self.is_driver,
-            node_id=bytes.fromhex(node_id_hex) if node_id_hex else None)
+            node_id=bytes.fromhex(node_id_hex) if node_id_hex else None,
+            log_tag=os.environ.get("RAY_TPU_LOG_TAG"))
         self.node_id = NodeID(self.node_info["node_id"])
         if (self.store.isolated and not self.store.namespace
                 and not os.environ.get("RAY_TPU_STORE_NAMESPACE")):
@@ -961,33 +973,42 @@ class CoreClient:
         path seals them) and the pending-call resolves to a None meta so
         get() falls through to the head directory."""
         try:
-            conn = self._direct.get(lease.addr)
-            if conn is None or conn.closed:
-                reader_writer = await asyncio.open_connection(*lease.addr)
-                conn = protocol.Connection(*reader_writer,
-                                           name=f"lease-{lease.addr[1]}")
-                conn.start()
-                self._direct[lease.addr] = conn
+            try:
+                conn = self._direct.get(lease.addr)
+                if conn is None or conn.closed:
+                    reader_writer = await asyncio.open_connection(*lease.addr)
+                    conn = protocol.Connection(*reader_writer,
+                                               name=f"lease-{lease.addr[1]}")
+                    conn.start()
+                    self._direct[lease.addr] = conn
+            except (ConnectionRefusedError, OSError):
+                # connect-phase failure: the task was provably never sent,
+                # so resubmitting through the head is safe for ANY retry
+                # policy (no duplicate-execution risk)
+                lease.dead = True
+                spec["failover"] = True  # head skips the dup holder add
+                self.conn.push("submit_task", spec=spec)
+                return {"meta": None}
             rep = await conn.request("lease_exec", spec=spec)
             if rep.get("retired"):
                 lease.dead = True
             return rep
-        except (protocol.ConnectionLost, protocol.RpcError,
-                ConnectionRefusedError, OSError):
+        except (protocol.ConnectionLost, protocol.RpcError, OSError):
             lease.dead = True
-            # The worker may have executed the task and only the reply was
-            # lost — resubmitting through the head can run it twice, so the
-            # failover is gated on the task's retry policy (reference
-            # NormalTaskSubmitter only re-queues retryable tasks on worker
-            # death). Non-retryable tasks surface a worker-died error.
+            # The request was in flight: the worker may have executed the
+            # task and only the reply was lost — resubmitting through the
+            # head can run it twice, so the failover is gated on the
+            # task's retry policy (reference NormalTaskSubmitter only
+            # re-queues retryable tasks on worker death). Non-retryable
+            # tasks surface a worker-died error.
             if spec.get("options", {}).get("max_retries", 3):
                 spec["failover"] = True  # head skips the duplicate holder add
                 self.conn.push("submit_task", spec=spec)
                 return {"meta": None}
             rid = ObjectID(spec["return_ids"][0])
-            # terminal failure: the head never sees this spec and the dead
-            # worker never deserialized the args, so the client must drop
-            # the borrow pins itself (idempotent vs a racing worker commit)
+            # terminal failure: the head never sees this spec, so the
+            # client must drop the borrow pins itself (idempotent vs a
+            # racing worker commit)
             self.release_borrows(
                 [(ObjectID(b), t) for b, t in spec.get("borrows", [])])
             err = WorkerCrashedError(

@@ -100,6 +100,7 @@ class WorkerInfo:
         # lease protocol: WorkerID of the client this worker is leased to
         # for direct task pushes (None = scheduled by the head)
         self.leased_to: Optional[WorkerID] = None
+        self.log_tag: Optional[str] = None  # stem of its log files
 
 
 class ActorInfo:
@@ -353,6 +354,12 @@ class Head:
         # if their lineage entry gets cap-evicted meanwhile, consumers must
         # get ObjectLostError, not an eternal hang
         self._lost_pending: Set[ObjectID] = set()
+        # worker log capture (reference log_monitor.py): per-file ring of
+        # recent lines — the CLI/dashboard read this, so logs from remote
+        # nodes work without a shared filesystem. LRU-bounded by file
+        # count: worker churn must not grow head memory forever.
+        self.log_ring: "OrderedDict[str, deque]" = OrderedDict()
+        self._log_monitor = None
 
     def _task_event(self, task_id, name: str, state: str, *,
                     worker=None, node_id=None, error: str = None) -> None:
@@ -374,13 +381,15 @@ class Head:
             except Exception:
                 return None
 
-        async def register_worker(worker_id, pid, port, is_driver, node_id=None):
+        async def register_worker(worker_id, pid, port, is_driver, node_id=None,
+                                  log_tag=None):
             nid = NodeID(node_id) if node_id else self.node_id
             node = self.nodes.get(nid) or self.head_node
             w = WorkerInfo(WorkerID(worker_id), conn_state["conn"], pid, port,
                            is_driver, node.node_id)
             w.host = _peer_host()  # reachable host for direct actor calls
             w.proc = self._spawned.pop(pid, None)
+            w.log_tag = log_tag    # maps this worker to its log files
             self.workers[w.worker_id] = w
             conn_state["worker"] = w
             node.workers.add(w.worker_id)
@@ -714,6 +723,7 @@ class Head:
                 "actors": {a.hex(): info.state for a, info in self.actors.items()},
                 "uptime": time.time() - self.start_time,
                 "dashboard_port": getattr(self, "dashboard_port", None),
+                "client_proxy_port": getattr(self, "client_proxy_port", None),
             }
 
         async def submit_job(entrypoint, metadata=None, env=None,
@@ -762,6 +772,49 @@ class Head:
 
         async def list_state(kind):
             return self._list_state(kind)
+
+        async def log_batch(entries):
+            """Tailed lines pushed by a node daemon's LogMonitor."""
+            self._on_log_batch(entries)
+            return True
+
+        async def list_logs():
+            """Log files known to the head: this machine's session log
+            tree plus everything the ring has seen from remote nodes."""
+            from ray_tpu.core import worker_logs
+
+            out = worker_logs.list_log_files(self.session)
+            for name in self.log_ring:
+                out.setdefault(name, None)  # remote: size unknown
+            return [{"file": n, "size": s}
+                    for n, s in sorted(out.items())]
+
+        async def get_log(filename, tail=None):
+            """Lines of one log file: full file when it lives on this
+            machine, ring contents otherwise (remote nodes, no shared FS).
+            File IO runs in an executor — a multi-GB log must not stall
+            the head's event loop."""
+            from ray_tpu.core import worker_logs
+
+            if os.sep in filename or filename.startswith("."):
+                raise ValueError(f"bad log filename {filename!r}")
+            lines = None
+            path = worker_logs.find_log_file(self.session, filename)
+            if path is not None:
+                try:
+                    lines = await asyncio.get_running_loop().run_in_executor(
+                        None, worker_logs.read_log_lines, path,
+                        int(tail) if tail else None)
+                except OSError:
+                    lines = None
+            if lines is None:
+                ring = self.log_ring.get(filename)
+                if ring is None:
+                    return None
+                lines = list(ring)
+                if tail:
+                    lines = lines[-int(tail):]
+            return lines
 
         async def acquire_lease(options):
             """Grant an idle worker to the requesting client for DIRECT
@@ -1443,15 +1496,56 @@ class Head:
 
     def _spawn_local_worker(self) -> None:
         from ray_tpu.core.resources import strip_device_env
+        from ray_tpu.core import worker_logs
 
         env = strip_device_env(dict(os.environ))
         env["RAY_TPU_HEAD_PORT"] = str(self.port)
         env["RAY_TPU_SESSION"] = self.session
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env, stdout=None, stderr=None)
+        # fd-level stdio capture into the session log dir (reference
+        # node.py:1426 worker redirection); unbuffered so a task's print()
+        # reaches the tailer (and the driver) promptly
+        out, err, tag = worker_logs.open_worker_logs(self.session)
+        env["RAY_TPU_LOG_TAG"] = tag
+        env.setdefault("PYTHONUNBUFFERED", "1")
+        with out, err:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env, stdout=out, stderr=err)
         self._spawned[proc.pid] = proc
+
+    def _on_log_batch(self, entries: List[dict]) -> None:
+        """Freshly tailed worker-log lines (local monitor thread or a node
+        daemon's push): retain in the ring and stream to every connected
+        driver, where they print — a remote task's print() is visible at
+        the submitting terminal by default (reference log_monitor →
+        pubsub → driver print_logs path)."""
+        from ray_tpu.core.worker_logs import RING_LINES
+
+        tags = {w.log_tag: w.pid for w in self.workers.values()
+                if getattr(w, "log_tag", None)}
+        for e in entries:
+            stem = e["file"].rsplit(".", 1)[0]
+            pid = tags.get(stem[len("worker-"):]) if \
+                stem.startswith("worker-") else None
+            if pid is not None:
+                e["pid"] = pid
+            ring = self.log_ring.get(e["file"])
+            if ring is None:
+                ring = self.log_ring[e["file"]] = deque(maxlen=RING_LINES)
+                from ray_tpu.core.worker_logs import MAX_LOG_FILES_RETAINED
+
+                while len(self.log_ring) > MAX_LOG_FILES_RETAINED:
+                    self.log_ring.popitem(last=False)
+            else:
+                self.log_ring.move_to_end(e["file"])
+            ring.extend(e["lines"])
+        for w in self.workers.values():
+            if w.is_driver and w.conn is not None and not w.conn.closed:
+                try:
+                    w.conn.push("log_lines", entries=entries)
+                except Exception:
+                    pass
 
     def _on_worker_disconnect(self, w: WorkerInfo) -> None:
         # a dead process holds nothing: release its ref interest and any
@@ -1973,6 +2067,7 @@ class Head:
         if kind == "workers":
             return [{"worker_id": w.hex(), "pid": i.pid, "is_driver": i.is_driver,
                      "node_id": i.node_id.hex(),
+                     "log_tag": getattr(i, "log_tag", None),
                      "actor": i.actor_id.hex() if i.actor_id else None,
                      "task": i.running_task.hex() if i.running_task else None}
                     for w, i in self.workers.items()]
@@ -2035,6 +2130,16 @@ class Head:
         from ray_tpu.core.job_manager import JobManager
 
         self.job_manager = JobManager(self.session, self.port)
+        # tail this node's worker log files; batches land on the loop via
+        # _on_log_batch (ring + fan-out to drivers)
+        from ray_tpu.core import worker_logs
+
+        loop = asyncio.get_running_loop()
+        self._log_monitor = worker_logs.LogMonitor(
+            worker_logs.session_log_dir(self.session),
+            emit=lambda batch: loop.call_soon_threadsafe(
+                self._on_log_batch, batch))
+        self._log_monitor.start()
         return self.port
 
     def notify_task_done(self, w: WorkerInfo) -> None:
@@ -2059,6 +2164,8 @@ class Head:
 
     async def stop(self) -> None:
         self._shutdown = True
+        if self._log_monitor is not None:
+            self._log_monitor.stop()
         for node in self.nodes.values():
             if node.conn is not None and not node.conn.closed:
                 node.conn.push("shutdown_node")

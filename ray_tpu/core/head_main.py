@@ -61,6 +61,23 @@ async def amain(args) -> None:
             ports["dashboard_port"] = dport
         except Exception as e:  # dashboard is best-effort, never blocks boot
             print(f"RAY_TPU_DASHBOARD_ERROR={e!r}", file=sys.stderr, flush=True)
+    if not args.no_client_proxy:
+        try:
+            from ray_tpu.client_proxy.server import ClientProxyServer
+
+            # same bind policy as the head/data servers: localhost unless
+            # the operator opts into external exposure via RAY_TPU_BIND_HOST
+            # (any connecting client gets a full driver — RCE surface)
+            cps = ClientProxyServer("127.0.0.1", port)
+            cp_port = await cps.start(
+                host=os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"),
+                port=args.client_proxy_port)
+            head.client_proxy_port = cp_port
+            print(f"RAY_TPU_CLIENT_PROXY_PORT={cp_port}", flush=True)
+            ports["client_proxy_port"] = cp_port
+        except Exception as e:  # remote-driver ingress is best-effort
+            print(f"RAY_TPU_CLIENT_PROXY_ERROR={e!r}", file=sys.stderr,
+                  flush=True)
     if args.port_file:
         # atomic write so pollers never read a partial file; lets the CLI
         # spawn the head fully detached (stdout→devnull, no inherited pipe)
@@ -107,6 +124,8 @@ def main() -> None:
     p.add_argument("--restore", action="store_true",
                    help="restore session state from a prior head snapshot")
     p.add_argument("--dashboard-port", type=int, default=0)
+    p.add_argument("--no-client-proxy", action="store_true")
+    p.add_argument("--client-proxy-port", type=int, default=0)
     args = p.parse_args()
     try:
         asyncio.run(amain(args))

@@ -89,9 +89,27 @@ class NodeDaemon:
         # entry and must learn the new location
         self.store.on_spill = lambda m: self.conn.push("object_spilled",
                                                        meta=m)
+        # tail this node's worker log files; new lines ride the control
+        # connection to the head, which fans them out to drivers and keeps
+        # its ring for the CLI/dashboard (reference log_monitor.py role)
+        from ray_tpu.core import worker_logs
+
+        loop = asyncio.get_running_loop()
+
+        def _emit(batch):
+            loop.call_soon_threadsafe(
+                lambda: self.conn.push("log_batch", entries=batch)
+                if self.conn is not None and not self.conn.closed else None)
+
+        self._log_monitor = worker_logs.LogMonitor(
+            worker_logs.session_log_dir(
+                self.session, f"node-{self.node_id.hex()[:12]}"),
+            emit=_emit)
+        self._log_monitor.start()
 
     async def _spawn_worker(self):
         from ray_tpu.core.resources import strip_device_env
+        from ray_tpu.core import worker_logs
 
         env = strip_device_env(dict(os.environ))
         env["RAY_TPU_HEAD_PORT"] = str(self.head_port)
@@ -100,9 +118,17 @@ class NodeDaemon:
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         if self.store_ns:
             env["RAY_TPU_STORE_NAMESPACE"] = self.store_ns
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env, stdout=None, stderr=None)
+        # fd-level stdio capture; the daemon's LogMonitor tails these and
+        # pushes appended lines to the head (reference log_monitor.py)
+        out, err, tag = worker_logs.open_worker_logs(
+            self.session, tag=f"{self.node_id.hex()[:6]}-{os.urandom(3).hex()}",
+            subdir=f"node-{self.node_id.hex()[:12]}")
+        env["RAY_TPU_LOG_TAG"] = tag
+        env.setdefault("PYTHONUNBUFFERED", "1")
+        with out, err:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env, stdout=out, stderr=err)
         self.procs[proc.pid] = proc
         return proc.pid
 
@@ -142,6 +168,8 @@ class NodeDaemon:
 
     async def run(self):
         await self.stopping.wait()
+        if getattr(self, "_log_monitor", None) is not None:
+            self._log_monitor.stop()
         for proc in self.procs.values():
             try:
                 proc.kill()

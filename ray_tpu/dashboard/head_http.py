@@ -136,6 +136,25 @@ def build_app(head) -> web.Application:
     app.router.add_get("/api/jobs/{job_id}", job_get)
     app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
     app.router.add_post("/api/jobs/{job_id}/stop", job_stop)
+    # ------------------------------------------- worker log surface
+    # (reference: dashboard/modules/log REST endpoints)
+    async def logs_list(_req):
+        handlers = head._handlers({})
+        return _json(await handlers["list_logs"]())
+
+    async def log_get(req):
+        handlers = head._handlers({})
+        tail = req.query.get("tail")
+        lines = await handlers["get_log"](
+            filename=req.match_info["filename"],
+            tail=int(tail) if tail else None)
+        if lines is None:
+            raise web.HTTPNotFound()
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    app.router.add_get("/api/logs", logs_list)
+    app.router.add_get("/api/logs/{filename}", log_get)
     app.router.add_get("/api/summary", summary)
     app.router.add_get("/metrics", metrics)
     return app

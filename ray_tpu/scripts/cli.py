@@ -77,6 +77,7 @@ def cmd_start(args) -> None:
                 if os.path.exists(port_file):
                     ports = json.load(open(port_file))
                     port, dash = ports["port"], ports.get("dashboard_port")
+                    cproxy = ports.get("client_proxy_port")
                     break
                 time.sleep(0.05)
         finally:
@@ -94,6 +95,9 @@ def cmd_start(args) -> None:
             print(f"dashboard: http://127.0.0.1:{dash}")
         print(f"join with: ray-tpu start --address={addr}")
         print(f"drivers:   RAY_TPU_ADDRESS={addr} python my_script.py")
+        if cproxy:
+            print(f"remote drivers: ray_tpu.init("
+                  f"address=\"ray-tpu://<this-host>:{cproxy}\")")
         if args.block:
             try:
                 proc.wait()
@@ -208,6 +212,32 @@ def cmd_job(args) -> None:
         print("stopped" if client.stop_job(args.job_id) else "not running")
 
 
+def cmd_logs(args) -> None:
+    """Worker log access (reference `ray logs`): list the session's log
+    files, or print one (`ray-tpu logs worker-<tag>.err --tail 50`).
+    `--worker <worker_id>` resolves a live/recent worker's files."""
+    client = _connect(args)
+    target = args.filename
+    if args.worker:
+        rows = client.head_request("list_state", kind="workers")
+        match = [w for w in rows
+                 if w["worker_id"].startswith(args.worker) and w.get("log_tag")]
+        if not match:
+            sys.exit(f"no worker with id prefix {args.worker!r} "
+                     f"(or it has no captured logs)")
+        target = f"worker-{match[0]['log_tag']}.{args.stream}"
+    if not target:
+        for row in client.head_request("list_logs"):
+            size = row["size"]
+            print(f"{'?' if size is None else size:>10}  {row['file']}")
+        return
+    lines = client.head_request("get_log", filename=target, tail=args.tail)
+    if lines is None:
+        sys.exit(f"no such log file: {target}")
+    for line in lines:
+        print(line)
+
+
 def cmd_serve(args) -> None:
     _connect(args)
     from ray_tpu import serve as serve_api
@@ -259,6 +289,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("logs", help="list or print worker log files")
+    sp.add_argument("filename", nargs="?", default=None,
+                    help="log file name (omit to list)")
+    sp.add_argument("--worker", default=None,
+                    help="worker id (hex prefix) instead of a filename")
+    sp.add_argument("--stream", choices=["out", "err"], default="out",
+                    help="which stream with --worker")
+    sp.add_argument("--tail", type=int, default=None)
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("serve")
     ssub = sp.add_subparsers(dest="serve_cmd", required=True)

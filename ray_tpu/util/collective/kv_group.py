@@ -53,10 +53,20 @@ def _to_numpy(tensor) -> np.ndarray:
 
 
 def _write_back(tensor, value: np.ndarray):
-    """In-place update when the tensor supports it (numpy); reference
-    collectives mutate their input tensors (collective.py allreduce doc)."""
+    """In-place update when the tensor supports it; the reference's
+    collectives mutate their input tensors (`collective.py:778-791`
+    copies results back into torch tensors), so a torch caller porting
+    code must see its tensor updated — silently returning a copy would
+    leave it unchanged. jax.Arrays are immutable by design; callers get
+    the returned value (documented divergence)."""
     if isinstance(tensor, np.ndarray):
         tensor[...] = value
+        return tensor
+    if type(tensor).__module__.startswith("torch"):
+        import torch
+
+        with torch.no_grad():
+            tensor.copy_(torch.from_numpy(np.ascontiguousarray(value)))
         return tensor
     return value
 

@@ -53,6 +53,28 @@ def _reduce_op(op: ReduceOp):
             ReduceOp.PRODUCT: pprod}[op]
 
 
+def _rs_program(op: ReduceOp):
+    """Per-shard reduce-scatter body over mesh axis "p"; factored out so
+    tests can lower it on a local mesh and assert the HLO really is a
+    reduce-scatter, not a full allreduce."""
+    from jax import lax
+
+    if op is ReduceOp.SUM:
+        def fn(a):  # a: [1, world, ...] local block
+            return lax.psum_scatter(a[0], "p", scatter_dimension=0,
+                                    tiled=True)
+        return fn
+    red = _reduce_op(op)
+
+    def fn(a):
+        # non-sum ops have no scatter primitive in XLA: reduce, then
+        # slice inside the program (the compiler sees the slice)
+        full = red(a[0], "p")               # [world, ...]
+        idx = lax.axis_index("p")
+        return lax.dynamic_index_in_dim(full, idx, 0, keepdims=True)
+    return fn
+
+
 class XlaMultihostGroup:
     """One member process of a cross-process device collective gang."""
 
@@ -323,16 +345,22 @@ class XlaMultihostGroup:
         return [gathered[i] for i in range(self.world_size)]
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM, timeout=None):
-        """Input [world, ...]; returns this rank's reduced slice."""
+        """Input [world, ...]; returns this rank's reduced slice.
+
+        SUM lowers to `lax.psum_scatter` INSIDE the shard_map program —
+        a true reduce-scatter moving ~1/world of the allreduce bytes
+        (slicing on the host after a full psum would force XLA to
+        materialize and ship the whole reduced tensor to every rank).
+        Reference semantics: `util/collective/collective.py:525`."""
         arr = np.asarray(tensor)
         if arr.shape[0] != self.world_size:
             raise ValueError(
                 f"reducescatter input leading dim {arr.shape[0]} != world "
                 f"{self.world_size}")
-        # psum the full [world, ...] then each rank keeps its slice — XLA
-        # lowers psum+slice to reduce-scatter on device meshes
+
         with self._op_lock:
-            return self._allreduce_np(arr, op)[self.rank]
+            out = self._shard_map(_rs_program(op), self._global(arr))
+            return self._local_of(out)
 
     def barrier(self, timeout=None):
         from jax.experimental import multihost_utils

@@ -193,3 +193,44 @@ def test_xla_send_recv_ring(xla_group):
 
 def test_xla_barrier(xla_group):
     xla_group.barrier()
+
+
+def test_multihost_reducescatter_lowering_and_numerics(devices8):
+    """The xla-multihost reducescatter must lower to a TRUE reduce-scatter
+    HLO (psum_scatter inside the program), not a full allreduce + host
+    slice — the latter moves ~world x the optimal bytes (r3 VERDICT weak
+    #2; reference semantics `util/collective/collective.py:525`)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.util.collective.xla_multihost import _rs_program
+
+    world = 8
+    mesh = Mesh(np.array(devices8), ("p",))
+    x = np.arange(world * world * 4, dtype=np.float32).reshape(world, world, 4)
+    g = jax.device_put(x, NamedSharding(mesh, P("p")))
+    f = jax.jit(jax.shard_map(_rs_program(ReduceOp.SUM), mesh=mesh,
+                              in_specs=P("p"), out_specs=P("p")))
+    out = np.asarray(f(g))
+    np.testing.assert_allclose(out, np.stack(
+        [x.sum(axis=0)[i] for i in range(world)]))
+    hlo = f.lower(g).compile().as_text()
+    assert "reduce-scatter" in hlo, "SUM path must lower to reduce-scatter"
+    assert "all-reduce" not in hlo, "SUM path must NOT be a full allreduce"
+    # non-sum ops: no scatter primitive exists; numerics still must hold
+    fmax = jax.jit(jax.shard_map(_rs_program(ReduceOp.MAX), mesh=mesh,
+                                 in_specs=P("p"), out_specs=P("p")))
+    np.testing.assert_allclose(np.asarray(fmax(g)), np.stack(
+        [x.max(axis=0)[i] for i in range(world)]))
+
+
+def test_write_back_mutates_torch_in_place(devices8):
+    """Reference collectives mutate torch tensors in place
+    (`collective.py:778-791`); a silently returned copy breaks ports."""
+    torch = pytest.importorskip("torch")
+    from ray_tpu.util.collective.kv_group import _write_back
+
+    t = torch.zeros(4)
+    out = _write_back(t, np.arange(4.0, dtype=np.float32))
+    assert out is t
+    np.testing.assert_allclose(t.numpy(), np.arange(4.0))
