@@ -1,0 +1,120 @@
+"""Command runners: how the launcher/providers reach a node.
+
+Parity: `python/ray/autoscaler/_private/command_runner.py`
+(SSHCommandRunner / DockerCommandRunner). The seam every launcher and
+provider operation goes through — tests swap in a recording mock, the
+local provider a subshell, production SSH.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+_SSH_OPTS = [
+    "-o", "ConnectTimeout=10",
+    "-o", "StrictHostKeyChecking=no",
+    "-o", "UserKnownHostsFile=/dev/null",
+    "-o", "LogLevel=ERROR",
+    # multiplex connections like the reference (ControlMaster) so repeated
+    # setup commands don't re-handshake
+    "-o", "ControlMaster=auto",
+    "-o", "ControlPersist=10s",
+]
+
+
+class CommandRunner:
+    """One target node. `run` executes a shell command; `rsync_up/down`
+    move files. Implementations must be safe to call from threads."""
+
+    def run(self, cmd: str, timeout: Optional[float] = None,
+            env: Optional[dict] = None) -> Tuple[int, str]:
+        raise NotImplementedError
+
+    def rsync_up(self, source: str, target: str) -> None:
+        raise NotImplementedError
+
+    def rsync_down(self, source: str, target: str) -> None:
+        raise NotImplementedError
+
+    def remote_shell_command(self) -> List[str]:
+        """argv for an interactive shell (CLI `attach`)."""
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunner):
+    """Runs on THIS machine (single-host clusters, CI, and the head node
+    when `ray-tpu up` executes on it directly)."""
+
+    def run(self, cmd: str, timeout: Optional[float] = None,
+            env: Optional[dict] = None) -> Tuple[int, str]:
+        merged = {**os.environ, **(env or {})}
+        proc = subprocess.run(["bash", "-c", cmd], capture_output=True,
+                              text=True, timeout=timeout, env=merged)
+        return proc.returncode, proc.stdout + proc.stderr
+
+    def rsync_up(self, source: str, target: str) -> None:
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+        subprocess.run(["rsync", "-a", source, target], check=True)
+
+    rsync_down = rsync_up
+
+    def remote_shell_command(self) -> List[str]:
+        return ["bash"]
+
+
+class SSHCommandRunner(CommandRunner):
+    """Drives a remote node over ssh/rsync (reference SSHCommandRunner)."""
+
+    def __init__(self, host: str, user: Optional[str] = None,
+                 ssh_key: Optional[str] = None, port: int = 22):
+        self.host = host
+        self.user = user
+        self.ssh_key = ssh_key
+        self.port = port
+
+    def _target(self) -> str:
+        return f"{self.user}@{self.host}" if self.user else self.host
+
+    def _ssh_base(self) -> List[str]:
+        base = ["ssh", *_SSH_OPTS, "-p", str(self.port)]
+        if self.ssh_key:
+            base += ["-i", self.ssh_key]
+        return base
+
+    def run(self, cmd: str, timeout: Optional[float] = None,
+            env: Optional[dict] = None) -> Tuple[int, str]:
+        exports = "".join(f"export {k}={v!r}; " for k, v in (env or {}).items())
+        argv = self._ssh_base() + [self._target(),
+                                   f"bash -c {exports + cmd!r}"]
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout)
+        return proc.returncode, proc.stdout + proc.stderr
+
+    def _rsync(self, source: str, target: str) -> None:
+        ssh_cmd = " ".join(self._ssh_base())
+        subprocess.run(["rsync", "-az", "-e", ssh_cmd, source, target],
+                       check=True)
+
+    def rsync_up(self, source: str, target: str) -> None:
+        self._rsync(source, f"{self._target()}:{target}")
+
+    def rsync_down(self, source: str, target: str) -> None:
+        self._rsync(f"{self._target()}:{source}", target)
+
+    def remote_shell_command(self) -> List[str]:
+        return self._ssh_base() + ["-tt", self._target()]
+
+
+def make_runner(node_cfg: dict, auth: dict) -> CommandRunner:
+    """`{"host": ...}` + auth → runner. host in (localhost, 127.0.0.1,
+    "local") short-circuits to the local runner so single-machine configs
+    and CI need no sshd."""
+    host = node_cfg.get("host", "localhost")
+    if host in ("localhost", "127.0.0.1", "local"):
+        return LocalCommandRunner()
+    return SSHCommandRunner(host, user=auth.get("ssh_user"),
+                            ssh_key=auth.get("ssh_private_key"),
+                            port=int(auth.get("ssh_port", 22)))

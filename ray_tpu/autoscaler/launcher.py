@@ -1,0 +1,257 @@
+"""Cluster launcher: `ray-tpu up/down/exec/attach cluster.yaml`.
+
+Parity: `python/ray/autoscaler/_private/commands.py` (`ray up/down/exec/
+attach/rsync`) — bring a whole cluster up from one YAML, over the
+command-runner seam (SSH for real fleets, local subshells for
+single-machine and CI).
+
+Config schema (reference cluster.yaml, trimmed to this runtime):
+
+```yaml
+cluster_name: demo
+provider:
+  type: ssh            # or "local"
+auth:
+  ssh_user: ubuntu
+  ssh_private_key: ~/.ssh/id_rsa
+head_node:
+  host: 10.0.0.1
+  port: 7777           # optional fixed head port
+  num_cpus: 8          # optional resource overrides
+worker_nodes:
+  - host: 10.0.0.2
+    num_cpus: 16
+  - host: 10.0.0.3
+worker_node_types:     # optional: autoscaler node types (SSHNodeProvider)
+  default:
+    resources: {CPU: 16}
+    max_nodes: 2
+setup_commands:        # run on every node before start
+  - pip install -e /opt/ray_tpu
+file_mounts:           # target: source, rsync'd to every node
+  /opt/app: ./app
+env:                   # exported for start commands
+  JAX_PLATFORMS: cpu
+```
+
+State: `<STATE_DIR>/clusters/<name>.json` records the head address and
+started nodes, so `down`/`exec`/`attach` work without re-reading hosts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.command_runner import (CommandRunner,
+                                               LocalCommandRunner,
+                                               make_runner)
+from ray_tpu.utils.platform import STATE_DIR
+
+CLUSTER_DIR = os.path.join(STATE_DIR, "clusters")
+
+
+def load_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    cfg.setdefault("cluster_name", "default")
+    cfg.setdefault("provider", {"type": "local"})
+    cfg.setdefault("auth", {})
+    cfg.setdefault("head_node", {"host": "localhost"})
+    cfg.setdefault("worker_nodes", [])
+    cfg.setdefault("setup_commands", [])
+    cfg.setdefault("file_mounts", {})
+    cfg.setdefault("env", {})
+    return cfg
+
+
+def _state_path(name: str) -> str:
+    os.makedirs(CLUSTER_DIR, exist_ok=True)
+    return os.path.join(CLUSTER_DIR, f"{name}.json")
+
+
+def _save_state(name: str, state: dict) -> None:
+    tmp = _state_path(name) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, _state_path(name))
+
+
+def load_state(name: str) -> Optional[dict]:
+    try:
+        with open(_state_path(name)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _python(cfg: dict) -> str:
+    return cfg.get("python", sys.executable)
+
+
+def _prepare_node(cfg: dict, node: dict, runner: CommandRunner,
+                  log) -> None:
+    for target, source in cfg["file_mounts"].items():
+        log(f"  rsync {source} -> {node.get('host')}:{target}")
+        runner.rsync_up(source, target)
+    for cmd in cfg["setup_commands"]:
+        log(f"  setup: {cmd}")
+        rc, out = runner.run(cmd, timeout=600, env=cfg["env"])
+        if rc != 0:
+            raise RuntimeError(f"setup command failed on "
+                               f"{node.get('host')}: {cmd}\n{out}")
+
+
+def _start_flags(node: dict) -> str:
+    flags = ""
+    if node.get("num_cpus") is not None:
+        flags += f" --num-cpus {node['num_cpus']}"
+    if node.get("num_tpu_chips") is not None:
+        flags += f" --num-tpu-chips {node['num_tpu_chips']}"
+    if node.get("resources"):
+        flags += f" --resources {shlex.quote(json.dumps(node['resources']))}"
+    if node.get("labels"):
+        flags += f" --labels {shlex.quote(json.dumps(node['labels']))}"
+    return flags
+
+
+def up(cfg: dict, log=print) -> dict:
+    """Bring the cluster up: head first, then every worker joins it.
+    Returns the saved state dict (head address etc.)."""
+    name = cfg["cluster_name"]
+    head = cfg["head_node"]
+    head_runner = make_runner(head, cfg["auth"])
+    log(f"[{name}] preparing head {head.get('host', 'localhost')}")
+    _prepare_node(cfg, head, head_runner, log)
+    port = head.get("port", 0)
+    cli = f"{_python(cfg)} -m ray_tpu.scripts.cli"
+    log(f"[{name}] starting head")
+    rc, out = head_runner.run(
+        f"{cli} start --head --port {port}{_start_flags(head)}",
+        timeout=120, env=cfg["env"])
+    if rc != 0:
+        raise RuntimeError(f"head start failed:\n{out}")
+    addr, head_pid = None, None
+    for line in out.splitlines():
+        if line.startswith("started head at "):
+            rest = line.split("started head at ", 1)[1].strip()
+            addr = rest.split(" ", 1)[0]
+            if "(pid " in rest:
+                head_pid = int(rest.split("(pid ", 1)[1].rstrip(")"))
+    if addr is None:
+        raise RuntimeError(f"could not parse head address from:\n{out}")
+    # the address the WORKERS use: the head host's reachable name
+    host = head.get("host", "localhost")
+    join_addr = addr if host in ("localhost", "127.0.0.1", "local") else \
+        f"{host}:{addr.rsplit(':', 1)[1]}"
+    state = {"cluster_name": name, "head": head, "head_pid": head_pid,
+             "address": join_addr,
+             "auth": cfg["auth"], "workers": [], "env": cfg["env"],
+             "python": _python(cfg)}
+    _save_state(name, state)
+    for node in cfg["worker_nodes"]:
+        runner = make_runner(node, cfg["auth"])
+        log(f"[{name}] preparing worker {node.get('host', 'localhost')}")
+        _prepare_node(cfg, node, runner, log)
+        rc, out = runner.run(
+            f"{cli} start --address {join_addr}{_start_flags(node)}",
+            timeout=120, env=cfg["env"])
+        if rc != 0:
+            raise RuntimeError(f"worker start failed on "
+                               f"{node.get('host')}:\n{out}")
+        node = dict(node)
+        node["pid"] = parse_daemon_pid(out)
+        state["workers"].append(node)
+        _save_state(name, state)
+    log(f"[{name}] up: head at {join_addr}, "
+        f"{len(state['workers'])} worker node(s)")
+    return state
+
+
+def parse_daemon_pid(out: str) -> Optional[int]:
+    for line in out.splitlines():
+        if line.startswith("node daemon started (pid "):
+            return int(line.split("(pid ", 1)[1].split(")", 1)[0])
+    return None
+
+
+def down(name_or_cfg, log=print) -> None:
+    """Stop every node recorded in the cluster state (reference
+    `ray down`). Kills the RECORDED pids, not every ray-tpu process on
+    the machine — co-located clusters (and the test harness) survive."""
+    state = name_or_cfg if isinstance(name_or_cfg, dict) else \
+        load_state(name_or_cfg)
+    if state is None:
+        raise RuntimeError(f"no cluster state for {name_or_cfg!r}; "
+                           f"was it started with `ray-tpu up`?")
+    name = state["cluster_name"]
+    for node in state["workers"]:
+        runner = make_runner(node, state.get("auth", {}))
+        log(f"[{name}] stopping worker {node.get('host', 'localhost')}")
+        try:
+            if node.get("pid"):
+                runner.run(f"kill {node['pid']} 2>/dev/null || true",
+                           timeout=30)
+        except Exception as e:
+            log(f"  stop failed (continuing): {e!r}")
+    runner = make_runner(state["head"], state.get("auth", {}))
+    log(f"[{name}] stopping head {state['head'].get('host', 'localhost')}")
+    try:
+        if state.get("head_pid"):
+            # SIGTERM → head.stop() pushes shutdown_node to every daemon
+            runner.run(f"kill {state['head_pid']} 2>/dev/null || true",
+                       timeout=30)
+        else:
+            cli = (f"{state.get('python', sys.executable)} "
+                   f"-m ray_tpu.scripts.cli")
+            runner.run(f"{cli} stop", timeout=60)
+    except Exception as e:
+        log(f"  stop failed (continuing): {e!r}")
+    try:
+        os.unlink(_state_path(name))
+    except OSError:
+        pass
+    log(f"[{name}] down")
+
+
+def exec_cmd(name: str, cmd: str, on: str = "head") -> int:
+    """Run a shell command on a cluster node (reference `ray exec`).
+    RAY_TPU_ADDRESS is exported so `python my_driver.py` just works."""
+    state = load_state(name)
+    if state is None:
+        raise RuntimeError(f"no cluster state for {name!r}")
+    node = state["head"] if on == "head" else state["workers"][int(on)]
+    runner = make_runner(node, state.get("auth", {}))
+    env = dict(state.get("env", {}))
+    env["RAY_TPU_ADDRESS"] = state["address"]
+    rc, out = runner.run(cmd, env=env)
+    if out:
+        print(out, end="" if out.endswith("\n") else "\n")
+    return rc
+
+
+def attach_argv(name: str) -> List[str]:
+    """argv for an interactive shell on the head (reference `ray attach`)."""
+    state = load_state(name)
+    if state is None:
+        raise RuntimeError(f"no cluster state for {name!r}")
+    runner = make_runner(state["head"], state.get("auth", {}))
+    return runner.remote_shell_command()
+
+
+def rsync(name: str, source: str, target: str, up_: bool = True) -> None:
+    state = load_state(name)
+    if state is None:
+        raise RuntimeError(f"no cluster state for {name!r}")
+    runner = make_runner(state["head"], state.get("auth", {}))
+    if up_:
+        runner.rsync_up(source, target)
+    else:
+        runner.rsync_down(source, target)

@@ -84,3 +84,110 @@ class LocalNodeProvider(NodeProvider):
     def shutdown(self) -> None:
         for pid in list(self._procs):
             self.terminate_node(pid)
+
+
+class SSHNodeProvider(NodeProvider):
+    """Scales over a fixed fleet of SSH-reachable machines (reference
+    `autoscaler/_private/local/node_provider.py` — the "local" provider's
+    on-prem host-pool model, driven through the CommandRunner seam).
+
+    Node types carry a `hosts` list; `create_node` claims the next free
+    host and starts a node daemon joining the head, `terminate_node`
+    stops it and returns the host to the pool. The same seam the cluster
+    launcher uses, so `ray-tpu up` + autoscaler share one transport.
+    """
+
+    def __init__(self, node_types: Dict[str, dict], head_address: str,
+                 auth: Optional[dict] = None, python: Optional[str] = None):
+        super().__init__(node_types)
+        from ray_tpu.autoscaler.command_runner import make_runner
+
+        self.head_address = head_address
+        self.auth = auth or {}
+        self.python = python or sys.executable
+        self._make_runner = make_runner
+        self._nodes: Dict[str, dict] = {}   # provider_id -> host cfg
+        self._types: Dict[str, str] = {}
+        self._counter = 0
+
+    def _free_host(self, node_type: str) -> Optional[dict]:
+        used = {n["host"] for n in self._nodes.values()}
+        for host in self.node_types[node_type].get("hosts", []):
+            cfg = host if isinstance(host, dict) else {"host": host}
+            if cfg["host"] not in used:
+                return cfg
+        return None
+
+    def create_node(self, node_type: str) -> str:
+        import json as _json
+        import shlex
+        import threading
+
+        cfg = self._free_host(node_type)
+        if cfg is None:
+            raise RuntimeError(f"no free host for node type {node_type!r}")
+        spec = self.node_types[node_type]
+        self._counter += 1
+        provider_id = f"ssh-{node_type}-{self._counter}"
+        runner = self._make_runner(cfg, self.auth)
+        flags = ""
+        res = spec.get("resources")
+        if res:
+            flags += f" --resources {shlex.quote(_json.dumps(res))}"
+        # provider-node-id label: how the autoscaler correlates this
+        # provider node with its head registration (idle detection and
+        # scale-down are impossible without it); spec labels ride along
+        labels = {**spec.get("labels", {}),
+                  "ray_tpu.io/provider-node-id": provider_id}
+        flags += f" --labels {shlex.quote(_json.dumps(labels))}"
+        # claim the host NOW, start in the background: an SSH round trip
+        # (up to ~2 min) inside the autoscaler tick would serialize
+        # scale-up and freeze idle-node termination meanwhile
+        entry = {**cfg, "pid": None, "failed": False}
+        self._nodes[provider_id] = entry
+        self._types[provider_id] = node_type
+
+        def _start():
+            from ray_tpu.autoscaler.launcher import parse_daemon_pid
+
+            try:
+                rc, out = runner.run(
+                    f"{self.python} -m ray_tpu.scripts.cli start "
+                    f"--address {self.head_address}{flags}", timeout=120)
+            except Exception:
+                rc, out = 1, "runner raised"
+            if rc != 0:
+                entry["failed"] = True  # host back to the pool next scan
+                self._nodes.pop(provider_id, None)
+                self._types.pop(provider_id, None)
+            else:
+                entry["pid"] = parse_daemon_pid(out)
+
+        threading.Thread(target=_start, daemon=True,
+                         name=f"ssh-start-{provider_id}").start()
+        return provider_id
+
+    def terminate_node(self, provider_id: str) -> None:
+        cfg = self._nodes.pop(provider_id, None)
+        self._types.pop(provider_id, None)
+        if cfg is None:
+            return
+        runner = self._make_runner(cfg, self.auth)
+        try:
+            if cfg.get("pid"):
+                # the recorded daemon only — never every ray-tpu process
+                # on a (possibly shared) host
+                runner.run(f"kill {cfg['pid']} 2>/dev/null || true",
+                           timeout=30)
+        except Exception:
+            pass
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+    def node_type_of(self, provider_id: str) -> str:
+        return self._types[provider_id]
+
+    def shutdown(self) -> None:
+        for pid in list(self._nodes):
+            self.terminate_node(pid)

@@ -92,6 +92,18 @@ class CoreClient:
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
         self.node_id: Optional[NodeID] = None
+        # head-restart survival (reference GCS-client reconnect): bounded
+        # reconnect window; 0 restores die-on-disconnect behavior
+        self._reconnect_s = float(os.environ.get(
+            "RAY_TPU_RECONNECT_TIMEOUT_S", "30"))
+        self._closing = False
+        self._connected = threading.Event()
+        self._connected.set()
+        # head-scheduled submissions not yet observed complete, keyed by
+        # first return id: a restarted head lost its queue, so these are
+        # replayed on reconnect (client-side re-queue; bounded FIFO)
+        self._inflight_specs: "OrderedDict[ObjectID, dict]" = OrderedDict()
+        self._inflight_lock = threading.Lock()
         # cross-node pull machinery (loop-confined): data-server conns,
         # in-flight pull dedup, LRU-bounded cache of pulled copies
         self._data_conns: Dict[Tuple[str, int], protocol.Connection] = {}
@@ -144,10 +156,18 @@ class CoreClient:
         worker_logs.print_driver_entries(entries)
         return True
 
+    def _note_complete(self, oid: ObjectID) -> None:
+        """A task's result meta was observed: its spec no longer needs
+        head-restart replay."""
+        if self._inflight_specs:
+            with self._inflight_lock:
+                self._inflight_specs.pop(oid, None)
+
     async def _on_evicted_object(self, meta):
         """Head evicted an object we own: drop our mapping, accounting and
         caches (auto-eviction must clean the producer like manual free())."""
         oid = meta.object_id
+        self._note_complete(oid)
         self.local_metas.pop(oid, None)
         self._registered.discard(oid)
         pulled = self._drop_pulled(oid)
@@ -355,10 +375,126 @@ class CoreClient:
                     [p for p in _sys.path if p]).encode(), overwrite=True)
 
     def _handle_head_loss(self):
+        # Reconnect-with-backoff (reference retryable_grpc_client + GCS
+        # client reconnect semantics): a restarted head gets this process
+        # back — re-register, replay directory entries and ref holds —
+        # instead of the whole cluster's clients dying with it.
+        if self._closing or self._reconnect_s <= 0:
+            if self.on_disconnect:
+                self.on_disconnect()
+            return
+        if not self._connected.is_set():
+            return  # a reconnect loop is already running
+        self._connected.clear()
+        asyncio.ensure_future(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        deadline = time.monotonic() + self._reconnect_s
+        delay = 0.2
+        while not self._closing and time.monotonic() < deadline:
+            try:
+                conn = await protocol.connect(self.head_host, self.head_port,
+                                              handlers=self._extra_handlers,
+                                              name="head")
+            except OSError:
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.6, 2.0)
+                continue
+            node_id_hex = os.environ.get("RAY_TPU_NODE_ID")
+            try:
+                info = await conn.request(
+                    "register_worker", worker_id=self.worker_id.binary(),
+                    pid=os.getpid(), port=self.direct_port,
+                    is_driver=self.is_driver,
+                    node_id=(bytes.fromhex(node_id_hex)
+                             if node_id_hex else None),
+                    log_tag=os.environ.get("RAY_TPU_LOG_TAG"))
+            except Exception:
+                try:
+                    await conn.close()
+                except Exception:
+                    pass
+                await asyncio.sleep(delay)
+                continue
+            self.conn = conn
+            self.node_info = info
+            self.node_id = NodeID(info["node_id"])
+            conn.on_close = lambda c: self._handle_head_loss()
+            # enablement is the head's setting; the restarted head may
+            # differ and a non-reporting client would see early evictions
+            self.ref_tracker.set_enabled(info.get("refcount", True))
+            # the restarted head lost our directory entries and holds:
+            # replay every meta we registered, then re-announce live refs
+            for oid in list(self._registered):
+                meta = self.local_metas.get(oid)
+                if meta is not None:
+                    try:
+                        conn.push("put_meta", meta=meta)
+                    except Exception:
+                        pass
+            self.ref_tracker.resync()
+            if self.is_driver:
+                import json as _json
+                import sys as _sys
+
+                try:
+                    await conn.request(
+                        "kv_put", ns="cluster", key=b"driver_sys_path",
+                        value=_json.dumps(
+                            [p for p in _sys.path if p]).encode(),
+                        overwrite=True)
+                except Exception:
+                    pass
+            # leased workers likely died with the head; mark dead so the
+            # next submit fails over through the (new) head
+            with self._lease_lock:
+                for lease in self._leases.values():
+                    lease.dead = True
+            # client-side task re-queue: the restarted head has no task
+            # queue, and a push can die in the old socket's buffer — so
+            # every submission not yet observed complete is replayed
+            # (at-least-once for retryable tasks, like lease failover;
+            # max_retries=0 tasks surface an error instead of re-running)
+            with self._inflight_lock:
+                pending = list(self._inflight_specs.items())
+            for rid0, spec in pending:
+                if rid0 in self.local_metas:
+                    with self._inflight_lock:
+                        self._inflight_specs.pop(rid0, None)
+                    continue
+                if spec.get("options", {}).get("max_retries", 3):
+                    sp = dict(spec)
+                    sp["failover"] = True  # skip the dup holder add
+                    try:
+                        conn.push("submit_task", spec=sp)
+                    except Exception:
+                        pass
+                else:
+                    err = WorkerCrashedError(
+                        "head restarted while a max_retries=0 task was in "
+                        "flight; it may or may not have run")
+                    try:
+                        self.store_result(rid0, err, register=True,
+                                          is_error=True)
+                    except Exception:
+                        pass
+                    with self._inflight_lock:
+                        self._inflight_specs.pop(rid0, None)
+            self._connected.set()
+            return
+        self._connected.set()  # unblock waiters into their errors
         if self.on_disconnect:
             self.on_disconnect()
 
+    def _wait_connected(self) -> None:
+        """Block a sync API call while a reconnect is in progress (bounded
+        by the reconnect window) so callers see a brief stall, not an
+        immediate ConnectionLost, across a head restart."""
+        if not self._connected.is_set():
+            self._connected.wait(timeout=self._reconnect_s + 5)
+
     def shutdown(self) -> None:
+        self._closing = True
         refcount.activate(None)
 
         async def _close():
@@ -384,6 +520,7 @@ class CoreClient:
         return fut.result(timeout=timeout)
 
     def head_request(self, method: str, **kwargs) -> Any:
+        self._wait_connected()
         return self._call(self.conn.request(method, **kwargs))
 
     # ------------------------------------------------------------- objects
@@ -544,6 +681,7 @@ class CoreClient:
         meta = self.local_metas.get(ref.id)
         if meta is not None and ref.id not in self._registered:
             self._registered.add(ref.id)
+            self._wait_connected()
             self._call(self.conn.request("put_meta", meta=meta))
 
     def adopt_meta(self, meta: ObjectMeta) -> ObjectRef:
@@ -718,6 +856,7 @@ class CoreClient:
                     if meta is None:
                         raise GetTimeoutError(f"get timed out on {ref}")
                     self.local_metas[ref.id] = meta
+                self._note_complete(ref.id)
                 value = self._read_value(meta)
                 if meta.error or isinstance(value, RayTpuError):
                     raise value
@@ -743,6 +882,7 @@ class CoreClient:
                     meta = await self.conn.request(
                         "get_meta", object_id=ref.id.binary(), timeout=None)
                 self.local_metas[ref.id] = meta
+            self._note_complete(ref.id)
             value = await self._read_value_async(meta)
             if meta.error or isinstance(value, RayTpuError):
                 raise value
@@ -1098,8 +1238,18 @@ class CoreClient:
         # needed — a blocking round trip here caps pipelined submission at
         # ~500 tasks/s; a push lets the socket batch thousands/s (head-side
         # submission failures seal error objects on the return ids)
+        self._wait_connected()  # ride out a head restart, don't drop tasks
         if self.conn.closed:
             raise protocol.ConnectionLost("head connection closed")
+        with self._inflight_lock:
+            # retained until the result meta is observed; replayed to a
+            # restarted head (which lost its queue AND any push that died
+            # in the old socket's buffer)
+            self._inflight_specs[return_ids[0]] = spec
+            while len(self._inflight_specs) > 4096:
+                self._inflight_specs.popitem(last=False)
+        # bind the CURRENT conn: a reconnect between here and the loop
+        # callback must not push into the dead connection object
         self.loop.call_soon_threadsafe(
             functools.partial(self.conn.push, "submit_task", spec=spec))
         return [ObjectRef(o) for o in return_ids]
@@ -1113,6 +1263,7 @@ class CoreClient:
                 "args": payload, "deps": deps, "options": options,
                 "borrows": [(o.binary(), t) for o, t in tokens],
                 "methods": methods}
+        self._wait_connected()
         reply = self._call(self.conn.request("create_actor", spec=spec))
         return ActorID(reply["actor_id"])
 
@@ -1217,19 +1368,24 @@ class CoreClient:
         return True
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._wait_connected()
         self._call(self.conn.request("kill_actor", actor_id=actor_id.binary(),
                                      no_restart=no_restart))
 
     # ------------------------------------------------------------------ kv
     def kv_put(self, ns: str, key: bytes, value: bytes, overwrite=True) -> bool:
+        self._wait_connected()
         return self._call(self.conn.request("kv_put", ns=ns, key=key,
                                             value=value, overwrite=overwrite))
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        self._wait_connected()
         return self._call(self.conn.request("kv_get", ns=ns, key=key))
 
     def kv_del(self, ns: str, key: bytes) -> bool:
+        self._wait_connected()
         return self._call(self.conn.request("kv_del", ns=ns, key=key))
 
     def kv_keys(self, ns: str, prefix: bytes) -> list:
+        self._wait_connected()
         return self._call(self.conn.request("kv_keys", ns=ns, prefix=prefix))

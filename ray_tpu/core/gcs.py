@@ -1884,6 +1884,10 @@ class Head:
         self._persist_runtime_env_blobs()
         snap = {
             "session": self.session,
+            # identity is durable: metas/labels stamped with the head's
+            # node id must stay valid across a restart, or every replayed
+            # shm object looks like it came from a dead node
+            "node_id": self.node_id.binary(),
             "kv": {k: v for k, v in self.kv.items()
                    if k[0] not in ("_metrics", "_runtime_env")},
             "detached_actors": detached,
@@ -1973,6 +1977,24 @@ class Head:
             return False
         with open(path, "rb") as f:
             snap = pickle.load(f)
+        if snap.get("node_id"):
+            # adopt the predecessor's node identity (see save_snapshot)
+            new_id = NodeID(snap["node_id"])
+            if new_id != self.node_id:
+                old_id = self.node_id
+                self.nodes[new_id] = self.nodes.pop(self.node_id)
+                self.node_id = new_id
+                self.head_node.node_id = new_id
+                if self.store.namespace == old_id.hex()[:8]:
+                    # isolation mode derived the store namespace from the
+                    # pre-adoption id: rebuild under the adopted id or no
+                    # client (they resolve by the ADOPTED id) can map our
+                    # arena — and replayed metas couldn't be opened here
+                    cap = self.store.capacity
+                    self.store.shutdown()
+                    self.store = SharedMemoryStore(
+                        self.session, capacity_bytes=cap, create_arena=True,
+                        namespace=new_id.hex()[:8])
         self.kv.update(snap["kv"])
         self._restore_runtime_env_blobs()
         self.job_counter = snap.get("job_counter", 0)

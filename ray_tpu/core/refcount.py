@@ -194,3 +194,13 @@ class RefTracker:
     def flush_now(self) -> None:
         """Synchronous flush (tests / shutdown)."""
         self._flush()
+
+    def resync(self) -> None:
+        """A restarted head wiped its holder state: re-announce every oid
+        this process still holds, ordered BEFORE any queued transitions so
+        a pending dec can never race ahead of its re-announced inc."""
+        with self._flush_lock:
+            self._drain()
+            self._ops = [("i", oid.binary()) for oid in self.counts] \
+                + self._ops
+        self._flush()

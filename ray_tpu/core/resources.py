@@ -41,17 +41,104 @@ def detect_num_tpu_chips() -> int:
     return 0
 
 
+# --------------------------------------------------- GKE/GCE pod metadata
+# Reference: `python/ray/_private/accelerators/tpu.py:326-433` — GKE pods
+# preset env vars; GCE TPU VMs expose the same facts via the metadata
+# server. Without this, multi-host pod bring-up cannot self-label slices
+# and gang scheduling needs hand-set env vars on every host.
+GCE_METADATA_ENDPOINT = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/attributes/")
+_gce_cache: Dict[str, Optional[str]] = {}
+_gce_down = False
+
+
+def _gce_metadata(key: str) -> Optional[str]:
+    """One metadata-server attribute; cached, fast-fails permanently for
+    the process once the server proves unreachable (non-GCP hosts).
+    `RAY_TPU_GCE_METADATA_ENDPOINT` overrides the endpoint (tests point it
+    at a local mock; also enables probing on chip-less hosts)."""
+    global _gce_down
+    if key in _gce_cache:
+        return _gce_cache[key]
+    endpoint = os.environ.get("RAY_TPU_GCE_METADATA_ENDPOINT",
+                              GCE_METADATA_ENDPOINT)
+    if _gce_down and endpoint == GCE_METADATA_ENDPOINT:
+        return None
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(endpoint.rstrip("/") + "/" + key,
+                                 headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            value = resp.read().decode() if resp.status == 200 else None
+    except (urllib.error.URLError, OSError, TimeoutError):
+        _gce_down = True
+        value = None
+    _gce_cache[key] = value
+    return value
+
+
+def _probe_metadata() -> bool:
+    """Only touch the metadata server when this host plausibly has TPUs
+    (or a test mock endpoint is set) — CPU-only nodes must not pay a
+    resolve timeout at every bring-up."""
+    return (bool(os.environ.get("RAY_TPU_GCE_METADATA_ENDPOINT"))
+            or detect_num_tpu_chips() > 0)
+
+
 def tpu_pod_type() -> Optional[str]:
-    """Slice/pod type, e.g. 'v5e-64' (env-provided in our world)."""
-    return os.environ.get("RAY_TPU_POD_TYPE") or os.environ.get("TPU_ACCELERATOR_TYPE")
+    """Slice/pod type, e.g. 'v5e-64': env (GKE presets it) → GCE
+    metadata `accelerator-type`."""
+    explicit = (os.environ.get("RAY_TPU_POD_TYPE")
+                or os.environ.get("TPU_ACCELERATOR_TYPE"))
+    if explicit:
+        return explicit
+    if _probe_metadata():
+        return _gce_metadata("accelerator-type")
+    return None
 
 
 def tpu_worker_id() -> int:
-    return int(os.environ.get("RAY_TPU_WORKER_ID", os.environ.get("TPU_WORKER_ID", "0")))
+    # empty string == unset: lets a parent scrub inherited TPU identity
+    # vars for child nodes without tripping int("")
+    env = (os.environ.get("RAY_TPU_WORKER_ID")
+           or os.environ.get("TPU_WORKER_ID"))
+    if env:
+        return int(env)
+    if _probe_metadata():
+        mid = _gce_metadata("agent-worker-number")
+        if mid is not None:
+            try:
+                return int(mid)
+            except ValueError:
+                pass
+    return 0
 
 
 def tpu_slice_name() -> Optional[str]:
-    return os.environ.get("RAY_TPU_SLICE_NAME") or os.environ.get("TPU_NAME")
+    explicit = (os.environ.get("RAY_TPU_SLICE_NAME")
+                or os.environ.get("TPU_NAME"))
+    if explicit:
+        return explicit
+    if _probe_metadata():
+        return _gce_metadata("instance-id")
+    return None
+
+
+def tpu_topology() -> Optional[str]:
+    """Physical topology, e.g. '2x4': env (GKE) → GCE `tpu-env` blob."""
+    if (topo := os.environ.get("TPU_TOPOLOGY")):
+        return topo
+    if _probe_metadata():
+        blob = _gce_metadata("tpu-env")
+        if blob:
+            import re
+
+            m = re.search(r"TOPOLOGY:\s*'([^']+)'", blob)
+            if m:
+                return m.group(1)
+    return None
 
 
 def node_resources(num_cpus: Optional[float] = None,
@@ -78,7 +165,7 @@ def node_labels() -> Dict[str, str]:
     if (pod := tpu_pod_type()):
         labels["ray.io/tpu-pod-type"] = pod
     labels["ray.io/tpu-worker-id"] = str(tpu_worker_id())
-    if (topo := os.environ.get("TPU_TOPOLOGY")):
+    if (topo := tpu_topology()):
         labels["ray.io/tpu-topology"] = topo
     return labels
 

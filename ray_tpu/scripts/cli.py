@@ -64,10 +64,18 @@ def cmd_start(args) -> None:
         os.close(fd)
         os.unlink(port_file)   # head_main atomically re-creates it when ready
         cmd += ["--port-file", port_file]
-        # fully detach stdio: a live head must not hold the CLI's pipes
-        # (otherwise `ray-tpu start --head | tee` never sees EOF)
-        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
-                                stderr=None, start_new_session=True)
+        # fully detach stdio: a live head must not hold the CLI's pipes —
+        # an inherited stdout OR stderr keeps `ray-tpu start --head | tee`
+        # (and any capture_output caller, e.g. the cluster launcher's
+        # command runner) waiting for EOF forever. stderr goes to a session
+        # log file so head errors stay diagnosable.
+        from ray_tpu.core.worker_logs import session_log_dir
+
+        err_path = os.path.join(session_log_dir(cmd[cmd.index("--session") + 1]),
+                                "head.err")
+        with open(err_path, "ab") as errf:
+            proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                    stderr=errf, start_new_session=True)
         port = dash = None
         try:
             deadline = time.time() + 60
@@ -90,7 +98,7 @@ def cmd_start(args) -> None:
             sys.exit("head failed to start (timeout)")
         addr = f"127.0.0.1:{port}"
         _save_address(addr)
-        print(f"started head at {addr}")
+        print(f"started head at {addr} (pid {proc.pid})")
         if dash:
             print(f"dashboard: http://127.0.0.1:{dash}")
         print(f"join with: ray-tpu start --address={addr}")
@@ -114,10 +122,14 @@ def cmd_start(args) -> None:
             cmd += ["--num-tpu-chips", str(args.num_tpu_chips)]
         if args.resources:
             cmd += ["--resources", args.resources]
+        if args.labels:
+            cmd += ["--labels", args.labels]
         # same detachment as the head branch: the daemon must not hold the
-        # CLI's pipes or die with the terminal
-        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
-                                stderr=None, start_new_session=True)
+        # CLI's pipes or die with the terminal; stderr to a state-dir file
+        os.makedirs(STATE_DIR, exist_ok=True)
+        with open(os.path.join(STATE_DIR, "node_daemon.err"), "ab") as errf:
+            proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                    stderr=errf, start_new_session=True)
         print(f"node daemon started (pid {proc.pid}), joined {args.address}")
         if args.block:
             try:
@@ -212,6 +224,51 @@ def cmd_job(args) -> None:
         print("stopped" if client.stop_job(args.job_id) else "not running")
 
 
+def cmd_up(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    cfg = launcher.load_config(args.config_file)
+    state = launcher.up(cfg)
+    print(f"cluster {state['cluster_name']!r} is up at {state['address']}")
+    print(f"  attach:  ray-tpu attach {state['cluster_name']}")
+    print(f"  exec:    ray-tpu exec {state['cluster_name']} -- <cmd>")
+    print(f"  down:    ray-tpu down {state['cluster_name']}")
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    target = args.cluster
+    if target.endswith((".yaml", ".yml")):
+        target = launcher.load_config(target)["cluster_name"]
+    launcher.down(target)
+
+
+def cmd_exec(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    parts = args.command
+    if parts and parts[0] == "--":
+        parts = parts[1:]
+    rc = launcher.exec_cmd(args.cluster, " ".join(parts), on=args.node)
+    sys.exit(rc)
+
+
+def cmd_attach(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    argv = launcher.attach_argv(args.cluster)
+    os.execvp(argv[0], argv)
+
+
+def cmd_rsync(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    launcher.rsync(args.cluster, args.source, args.target,
+                   up_=args.rsync_cmd == "rsync-up")
+    print("done")
+
+
 def cmd_logs(args) -> None:
     """Worker log access (reference `ray logs`): list the session's log
     files, or print one (`ray-tpu logs worker-<tag>.err --tail 50`).
@@ -267,6 +324,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpu-chips", type=int, default=None)
     sp.add_argument("--resources", default=None, help="JSON dict")
+    sp.add_argument("--labels", default=None, help="JSON dict (worker nodes)")
     sp.add_argument("--block", action="store_true")
     sp.set_defaults(fn=cmd_start)
 
@@ -289,6 +347,32 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("up", help="bring a cluster up from cluster.yaml")
+    sp.add_argument("config_file")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear a launched cluster down")
+    sp.add_argument("cluster", help="cluster name or its cluster.yaml")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("exec", help="run a command on a cluster node")
+    sp.add_argument("cluster")
+    sp.add_argument("--node", default="head",
+                    help='"head" or a worker index')
+    sp.add_argument("command", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("attach", help="interactive shell on the head")
+    sp.add_argument("cluster")
+    sp.set_defaults(fn=cmd_attach)
+
+    for name in ("rsync-up", "rsync-down"):
+        sp = sub.add_parser(name)
+        sp.add_argument("cluster")
+        sp.add_argument("source")
+        sp.add_argument("target")
+        sp.set_defaults(fn=cmd_rsync, rsync_cmd=name)
 
     sp = sub.add_parser("logs", help="list or print worker log files")
     sp.add_argument("filename", nargs="?", default=None,

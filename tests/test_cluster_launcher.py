@@ -1,0 +1,198 @@
+"""Cluster launcher + SSH provider seam.
+
+Reference parity: `python/ray/autoscaler/_private/commands.py` (`ray
+up/down/exec`) and `command_runner.py`. Two tiers:
+- mock-runner unit test: asserts the exact command/rsync flow `up()`
+  drives through the CommandRunner seam (what SSH would execute);
+- real localhost integration: `up()` a head + 1 worker via
+  LocalCommandRunner subshells, run a task on the worker's resources
+  through the launched cluster, `exec`, then `down()` and assert the
+  recorded pids are gone.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import launcher
+from ray_tpu.autoscaler.command_runner import (CommandRunner,
+                                               LocalCommandRunner,
+                                               SSHCommandRunner, make_runner)
+
+
+class MockRunner(CommandRunner):
+    """Records every command; scripted replies for start commands."""
+
+    def __init__(self, host):
+        self.host = host
+        self.commands = []
+        self.rsyncs = []
+
+    def run(self, cmd, timeout=None, env=None):
+        self.commands.append(cmd)
+        if "start --head" in cmd:
+            return 0, "started head at 127.0.0.1:7777 (pid 4242)\n"
+        if "start --address" in cmd:
+            return 0, "node daemon started (pid 555), joined x\n"
+        return 0, ""
+
+    def rsync_up(self, source, target):
+        self.rsyncs.append((source, target))
+
+
+def test_up_drives_runner_seam(monkeypatch, tmp_path):
+    runners = {}
+
+    def fake_make_runner(node_cfg, auth):
+        host = node_cfg.get("host", "localhost")
+        return runners.setdefault(host, MockRunner(host))
+
+    monkeypatch.setattr(launcher, "make_runner", fake_make_runner)
+    src = tmp_path / "app"
+    src.mkdir()
+    cfg = {
+        "cluster_name": "mock",
+        "provider": {"type": "ssh"},
+        "auth": {"ssh_user": "u"},
+        "head_node": {"host": "10.0.0.1", "num_cpus": 8},
+        "worker_nodes": [{"host": "10.0.0.2"}, {"host": "10.0.0.3"}],
+        "setup_commands": ["echo setup"],
+        "file_mounts": {"/opt/app": str(src)},
+        "env": {},
+        "python": "python3",
+    }
+    state = launcher.up(cfg, log=lambda *a, **k: None)
+    assert state["address"] == "10.0.0.1:7777"
+    assert state["head_pid"] == 4242
+    assert [w["pid"] for w in state["workers"]] == [555, 555]
+    head = runners["10.0.0.1"]
+    assert any("start --head" in c and "--num-cpus 8" in c
+               for c in head.commands)
+    assert head.commands[0] == "echo setup"
+    assert head.rsyncs == [(str(src), "/opt/app")]
+    for w in ("10.0.0.2", "10.0.0.3"):
+        assert any("start --address 10.0.0.1:7777" in c
+                   for c in runners[w].commands)
+    # down kills the recorded pids, not a machine-wide pkill
+    launcher.down("mock", log=lambda *a, **k: None)
+    assert any("kill 4242" in c for c in head.commands)
+    assert any("kill 555" in c for c in runners["10.0.0.2"].commands)
+    assert launcher.load_state("mock") is None
+
+
+def test_ssh_runner_command_shape():
+    r = SSHCommandRunner("10.1.2.3", user="ubuntu", ssh_key="/k", port=2222)
+    argv = r.remote_shell_command()
+    assert argv[0] == "ssh" and "ubuntu@10.1.2.3" in argv
+    assert "-i" in argv and "/k" in argv and "2222" in argv
+    assert make_runner({"host": "localhost"}, {}).__class__ is \
+        LocalCommandRunner
+
+
+def test_up_exec_task_down_localhost(tmp_path):
+    """Real bring-up through the seam: head + 1 worker as local
+    subshells, a task placed on the worker's custom resource, down."""
+    import yaml
+
+    cfg_file = tmp_path / "cluster.yaml"
+    cfg_file.write_text(yaml.safe_dump({
+        "cluster_name": "lctest",
+        "provider": {"type": "local"},
+        "head_node": {"host": "localhost", "num_cpus": 2},
+        "worker_nodes": [
+            {"host": "localhost", "num_cpus": 2,
+             "resources": {"CPU": 2, "lcworker": 4}},
+        ],
+        "env": {"RAY_TPU_NUM_CHIPS": "0"},
+    }))
+    cfg = launcher.load_config(str(cfg_file))
+    state = launcher.up(cfg)
+    try:
+        addr = state["address"]
+        # a driver (fresh process, like `ray-tpu exec`) runs a task that
+        # can only sit on the launched WORKER node
+        drv = tmp_path / "drv.py"
+        drv.write_text(textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+            import ray_tpu
+
+            ray_tpu.init(address={addr!r})
+
+            @ray_tpu.remote(resources={{"lcworker": 1}})
+            def where():
+                import os
+                return os.getpid()
+
+            print("task-pid", ray_tpu.get(where.remote(), timeout=60))
+            ray_tpu.shutdown()
+        """))
+        rc = launcher.exec_cmd("lctest", f"{sys.executable} {drv}")
+        assert rc == 0
+    finally:
+        launcher.down("lctest")
+    # recorded processes actually died
+    for pid in [state["head_pid"]] + [w["pid"] for w in state["workers"]]:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.2)
+            except ProcessLookupError:
+                break
+        else:
+            raise AssertionError(f"pid {pid} survived down()")
+
+
+def test_ssh_node_provider_pool(monkeypatch):
+    """The autoscaler-facing provider claims/releases hosts through the
+    same runner seam and kills only the recorded daemon pid."""
+    from ray_tpu.autoscaler import node_provider as np_mod
+
+    runners = {}
+
+    def fake_make_runner(node_cfg, auth):
+        host = node_cfg.get("host")
+        return runners.setdefault(host, MockRunner(host))
+
+    monkeypatch.setattr("ray_tpu.autoscaler.command_runner.make_runner",
+                        fake_make_runner)
+    prov = np_mod.SSHNodeProvider(
+        {"default": {"resources": {"CPU": 4},
+                     "hosts": ["10.9.0.1", "10.9.0.2"],
+                     "max_nodes": 2}},
+        head_address="10.9.0.0:7777", auth={"ssh_user": "u"})
+    def _wait_started():
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            nodes = list(prov._nodes.values())
+            if nodes and all(n["pid"] is not None for n in nodes):
+                return
+            time.sleep(0.05)
+        raise AssertionError("async node start never completed")
+
+    a = prov.create_node("default")
+    b = prov.create_node("default")
+    _wait_started()  # create_node is async: returns before the SSH lands
+    assert sorted(runners) == ["10.9.0.1", "10.9.0.2"]
+    assert len(prov.non_terminated_nodes()) == 2
+    with pytest.raises(RuntimeError, match="no free host"):
+        prov.create_node("default")
+    # the start command carries the provider-node-id label the autoscaler
+    # correlates registrations by (scale-down is blind without it)
+    assert any("provider-node-id" in c
+               for r in runners.values() for c in r.commands)
+    assert prov.node_type_of(a) == "default"
+    prov.terminate_node(a)
+    assert any("kill 555" in c
+               for r in runners.values() for c in r.commands)
+    assert len(prov.non_terminated_nodes()) == 1
+    c = prov.create_node("default")  # freed host is reusable
+    _wait_started()
+    assert len(prov.non_terminated_nodes()) == 2
+    prov.shutdown()
+    assert prov.non_terminated_nodes() == []

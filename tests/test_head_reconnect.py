@@ -1,0 +1,124 @@
+"""Live clients survive a head restart (reconnect + re-register + replay).
+
+Reference parity: `src/ray/rpc/retryable_grpc_client.cc` + GCS client
+reconnect semantics — the head is SIGKILLed mid-run and restarted on the
+SAME port with `--restore`; the connected driver's subsequent
+put/get/submit succeed without re-initializing.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _start_head(session: str, port: int, restore: bool = False):
+    cmd = [sys.executable, "-m", "ray_tpu.core.head_main",
+           "--session", session, "--port", str(port), "--num-cpus", "4",
+           "--enable-snapshots", "--no-dashboard", "--no-client-proxy"]
+    if restore:
+        cmd.append("--restore")
+    from ray_tpu.core.resources import strip_device_env
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                            env=strip_device_env(dict(os.environ)))
+    line = proc.stdout.readline()
+    assert line.startswith("RAY_TPU_HEAD_PORT="), line
+    return proc
+
+
+@pytest.fixture()
+def restartable_head(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_RECONNECT_TIMEOUT_S", "30")
+    monkeypatch.setenv("RAY_TPU_EVICT_GRACE_S", "0")
+    session = f"rcn{os.getpid()}"
+    port = _free_port()
+    proc = _start_head(session, port)
+    state = {"proc": proc, "port": port, "session": session}
+    yield state
+    ray_tpu.shutdown()
+    state["proc"].kill()
+    state["proc"].wait()
+
+
+@ray_tpu.remote
+def plus(a, b):
+    return a + b
+
+
+def test_driver_survives_head_restart(restartable_head):
+    st = restartable_head
+    ray_tpu.init(address=f"127.0.0.1:{st['port']}")
+
+    ref_before = ray_tpu.put({"k": 123})
+    assert ray_tpu.get(plus.remote(1, 2), timeout=60) == 3
+    time.sleep(2.5)  # one snapshot cycle
+
+    # SIGKILL the head mid-session; restart on the SAME port
+    st["proc"].kill()
+    st["proc"].wait()
+    time.sleep(1.0)
+    st["proc"] = _start_head(st["session"], st["port"], restore=True)
+
+    # the SAME driver keeps working: reconnect + re-register + replay
+    assert ray_tpu.get(plus.remote(20, 22), timeout=120) == 42
+    ref = ray_tpu.put([1, 2, 3])
+    assert ray_tpu.get(ref, timeout=60) == [1, 2, 3]
+    # an object put BEFORE the restart is still readable: the directory
+    # entry was replayed from this client's local metas
+    assert ray_tpu.get(ref_before, timeout=60)["k"] == 123
+
+    # refcount replay: a pre-restart object's eventual drop still evicts
+    from ray_tpu.core.api import _global_client
+
+    c = _global_client()
+    import numpy as np
+
+    big = ray_tpu.put(np.ones(300_000, dtype=np.uint8))
+    oid = big.hex()
+
+    def _ids():
+        return {o["object_id"] for o in c.head_request(
+            "list_state", kind="objects")}
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and oid not in _ids():
+        time.sleep(0.1)
+    assert oid in _ids()
+    del big
+    import gc
+
+    gc.collect()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and oid in _ids():
+        time.sleep(0.2)
+    assert oid not in _ids(), "post-restart refcounting broken"
+
+
+def test_reconnect_disabled_still_dies(restartable_head, monkeypatch):
+    """RAY_TPU_RECONNECT_TIMEOUT_S=0 keeps the old fail-fast contract."""
+    monkeypatch.setenv("RAY_TPU_RECONNECT_TIMEOUT_S", "0")
+    st = restartable_head
+    ray_tpu.init(address=f"127.0.0.1:{st['port']}")
+    from ray_tpu.core.api import _global_client
+
+    died = []
+    _global_client().on_disconnect = lambda: died.append(True)
+    st["proc"].kill()
+    st["proc"].wait()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not died:
+        time.sleep(0.1)
+    assert died, "on_disconnect did not fire with reconnect disabled"
