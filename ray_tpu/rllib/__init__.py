@@ -13,6 +13,8 @@ from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.env.envs import (Box, CartPole, Discrete, Env, Pendulum,
                                     VectorEnv, make_env, register_env)
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
@@ -21,7 +23,8 @@ from ray_tpu.rllib.core.rl_module import ModuleSpec, RLModule, spec_from_env
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-    "SAC", "SACConfig", "Box", "CartPole", "Discrete", "Env", "Pendulum",
+    "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
+    "Box", "CartPole", "Discrete", "Env", "Pendulum",
     "VectorEnv", "make_env", "register_env", "SingleAgentEnvRunner",
     "EnvRunnerGroup", "ModuleSpec", "RLModule", "spec_from_env",
 ]

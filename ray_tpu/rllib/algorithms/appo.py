@@ -1,0 +1,100 @@
+"""APPO: asynchronous PPO — IMPALA's actor-learner pipeline with a
+PPO-style clipped surrogate on V-trace advantages.
+
+Parity: `rllib/algorithms/appo/appo.py` + the torch learner
+(`appo/torch/appo_torch_learner.py`): same decoupled rollout/aggregation
+architecture as IMPALA (reused wholesale here), but the policy update is
+the clipped surrogate ratio against the ROLLOUT policy, advantages come
+from V-trace, and a periodically-updated target network regularizes the
+update (optional KL term, reference `use_kl_loss`/`kl_coeff`). The
+target params ride the batch as a replicated aux pytree, so the whole
+update — V-trace scan, surrogate, KL, apply — is one jitted XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
+                                             ImpalaLearner, vtrace)
+
+
+class APPOLearner(ImpalaLearner):
+    """Clipped-surrogate V-trace learner with a target network."""
+
+    def __init__(self, spec, cfg: "APPOConfig", mesh=None):
+        super().__init__(spec, cfg, mesh=mesh)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._updates_since_target = 0
+
+    def loss(self, params, batch, rng):
+        c = self.cfg
+        obs = batch["obs"]                     # [T, N, ...] time-major
+        T, N = obs.shape[:2]
+        flat_obs = obs.reshape((T * N,) + obs.shape[2:])
+        flat_act = batch["actions"].reshape(
+            (T * N,) + batch["actions"].shape[2:])
+        dist = self.module.dist(params, flat_obs)
+        logp = dist.log_prob(flat_act).reshape(T, N)
+        v = self.module.value(params, flat_obs).reshape(T, N)
+
+        # V-trace targets/advantages under the TARGET policy (reference
+        # APPO: old_policy corrects the off-policy gap; it lags several
+        # updates, so the surrogate clip below bounds the step)
+        target_dist = self.module.dist(batch["_target_params"], flat_obs)
+        old_logp = target_dist.log_prob(flat_act).reshape(T, N)
+        vs, pg_adv = vtrace(batch["logp"], old_logp, batch["rewards"],
+                            v, batch["dones"], batch["last_values"],
+                            c.gamma, c.vtrace_rho_bar, c.vtrace_c_bar)
+        if c.normalize_advantages:
+            pg_adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+        # PPO clipped surrogate vs the ROLLOUT (behavior) policy
+        ratio = jnp.exp(logp - batch["logp"])
+        clipped = jnp.clip(ratio, 1.0 - c.clip_param, 1.0 + c.clip_param)
+        pg_loss = -jnp.minimum(ratio * pg_adv, clipped * pg_adv).mean()
+        vf_loss = 0.5 * ((v - vs) ** 2).mean()
+        entropy = dist.entropy().mean()
+        total = (pg_loss + c.vf_loss_coeff * vf_loss
+                 - c.entropy_coeff * entropy)
+        metrics = {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                   "entropy": entropy,
+                   "mean_ratio": ratio.mean()}
+        if c.use_kl_loss:
+            # KL(target || current) over the batch states (reference
+            # appo_torch_learner KL term against the old policy)
+            kl = target_dist.kl(dist).mean()
+            total = total + c.kl_coeff * kl
+            metrics["kl"] = kl
+        return total, metrics
+
+    def update(self, batch):
+        batch = dict(batch)
+        batch["_target_params"] = self.target_params
+        metrics = super().update(batch)
+        self._updates_since_target += 1
+        if self._updates_since_target >= self.cfg.target_update_freq:
+            self._updates_since_target = 0
+            # NETWORK_TARGET_UPDATE: full copy (reference tau=1.0 default)
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+        return metrics
+
+
+class APPO(IMPALA):
+    def _build_learner(self, mesh):
+        return APPOLearner(self.module_spec, self.config, mesh=mesh)
+
+
+class APPOConfig(IMPALAConfig):
+    algo_class = APPO
+
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.4           # reference APPOConfig.clip_param
+        self.use_kl_loss = False
+        self.kl_coeff = 1.0
+        self.normalize_advantages = False
+        self.target_update_freq = 4     # learner updates per target copy

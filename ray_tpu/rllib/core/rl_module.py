@@ -118,6 +118,10 @@ class Categorical:
         p = jnp.exp(self.logits)
         return -jnp.sum(p * self.logits, axis=-1)
 
+    def kl(self, other: "Categorical"):
+        p = jnp.exp(self.logits)
+        return jnp.sum(p * (self.logits - other.logits), axis=-1)
+
     def mode(self):
         return jnp.argmax(self.logits, axis=-1)
 
@@ -137,6 +141,12 @@ class DiagGaussian:
 
     def entropy(self):
         return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    def kl(self, other: "DiagGaussian"):
+        var, ovar = jnp.exp(2 * self.log_std), jnp.exp(2 * other.log_std)
+        return jnp.sum(other.log_std - self.log_std
+                       + (var + (self.mean - other.mean) ** 2) / (2 * ovar)
+                       - 0.5, axis=-1)
 
     def mode(self):
         return self.mean

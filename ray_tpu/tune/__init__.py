@@ -8,6 +8,9 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      PopulationBasedTraining)
 from ray_tpu.tune.search import (BasicVariantGenerator, choice, grid_search,
                                  loguniform, randint, uniform)
+from ray_tpu.tune.searcher import RandomSearcher, Searcher
+from ray_tpu.tune.optuna_search import OptunaSearch
+from ray_tpu.tune.hyperopt_search import HyperOptSearch
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
                                 with_resources)
 
@@ -22,4 +25,5 @@ __all__ = [
     "choice", "uniform", "loguniform", "randint", "grid_search",
     "BasicVariantGenerator", "FIFOScheduler", "ASHAScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "RandomSearcher", "OptunaSearch", "HyperOptSearch",
 ]

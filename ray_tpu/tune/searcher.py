@@ -19,6 +19,10 @@ from ray_tpu.tune.search import Choice, Domain, GridSearch
 class Searcher:
     """Suggestion algorithm interface (reference searcher.py)."""
 
+    # sentinel return from suggest(): the search space is exhausted and no
+    # further trials will ever be suggested (reference Searcher.FINISHED)
+    FINISHED = "FINISHED"
+
     def set_search_properties(self, metric: str, mode: str,
                               param_space: Dict[str, Any]) -> None:
         self.metric = metric
@@ -26,7 +30,8 @@ class Searcher:
         self.param_space = param_space
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        """Next config to try; None = no more suggestions for now."""
+        """Next config to try; None = no suggestion RIGHT NOW (retry
+        later); Searcher.FINISHED = permanently done."""
         raise NotImplementedError
 
     def on_trial_complete(self, trial_id: str,

@@ -21,6 +21,7 @@ from ray_tpu.train.config import RunConfig
 from ray_tpu.train.worker_group import TrainWorker
 from ray_tpu.tune import schedulers as sched_lib
 from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.searcher import Searcher
 
 POLL_S = 0.1
 
@@ -33,6 +34,10 @@ class TuneConfig:
     max_concurrent_trials: int = 8
     scheduler: Optional[Any] = None
     seed: Optional[int] = None
+    # a Searcher makes trial generation sequential-adaptive: each new
+    # trial's config is suggested from live results of finished ones
+    # (reference TuneConfig.search_alg)
+    search_alg: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -109,16 +114,72 @@ class Tuner:
         storage = self.run_config.resolved_storage_path()
         os.makedirs(storage, exist_ok=True)
         scheduler = self.tune_config.scheduler or sched_lib.FIFOScheduler()
-        gen = BasicVariantGenerator(self.param_space,
-                                    self.tune_config.num_samples,
-                                    seed=self.tune_config.seed)
-        trials = [_Trial(f"trial_{i:04d}", cfg)
-                  for i, cfg in enumerate(gen.variants())]
-        pending = list(trials)
+        searcher = self.tune_config.search_alg
+        if searcher is not None:
+            from ray_tpu.tune.search import GridSearch
+
+            grid_keys = [k for k, v in self.param_space.items()
+                         if isinstance(v, GridSearch)]
+            if grid_keys:
+                # a searcher samples; it cannot honor exhaustive-grid
+                # semantics — failing loudly beats silently skipping
+                # grid values (reference raises the same way)
+                raise ValueError(
+                    f"grid_search params {grid_keys} cannot be combined "
+                    f"with a search_alg; use tune.choice() instead")
+            searcher.set_search_properties(self.tune_config.metric,
+                                           self.tune_config.mode,
+                                           self.param_space)
+            trials: List[_Trial] = []
+            pending: List[_Trial] = []
+        else:
+            gen = BasicVariantGenerator(self.param_space,
+                                        self.tune_config.num_samples,
+                                        seed=self.tune_config.seed)
+            trials = [_Trial(f"trial_{i:04d}", cfg)
+                      for i, cfg in enumerate(gen.variants())]
+            pending = list(trials)
         running: List[_Trial] = []
         resources = getattr(self.trainable, "_tune_resources", {"CPU": 1})
 
-        while pending or running:
+        def _searcher_complete(t: "_Trial") -> None:
+            if searcher is None:
+                return
+            try:
+                searcher.on_trial_complete(
+                    t.id, metrics=t.last_metrics or None,
+                    error=t.state == "ERRORED")
+            except Exception:
+                pass
+
+        searcher_finished = searcher is None
+        while (pending or running
+               or (not searcher_finished
+                   and len(trials) < self.tune_config.num_samples)):
+            # adaptive generation: ask the searcher for the next config
+            # only when a slot opens, so suggestions see fresh completions
+            while (not searcher_finished
+                   and len(trials) < self.tune_config.num_samples
+                   and len(running) + len(pending)
+                   < self.tune_config.max_concurrent_trials):
+                tid = f"trial_{len(trials):04d}"
+                try:
+                    cfg = searcher.suggest(tid)
+                except Exception as e:
+                    # a broken searcher must not abort fit() mid-run and
+                    # orphan the live trial actors
+                    print(f"[ray_tpu.tune] searcher.suggest failed, "
+                          f"stopping generation: {e!r}")
+                    searcher_finished = True
+                    break
+                if cfg is None:
+                    break  # searcher is not ready; retry next tick
+                if cfg is Searcher.FINISHED or cfg == Searcher.FINISHED:
+                    searcher_finished = True  # space exhausted for good
+                    break
+                t = _Trial(tid, cfg)
+                trials.append(t)
+                pending.append(t)
             while pending and len(running) < self.tune_config.max_concurrent_trials:
                 t = pending.pop(0)
                 try:
@@ -128,6 +189,7 @@ class Tuner:
                     t.state = "ERRORED"
                     t.error = f"trial failed to start: {e!r}"
                     self._stop_actor(t)
+                    _searcher_complete(t)
                     continue
                 running.append(t)
             time.sleep(POLL_S)
@@ -139,6 +201,7 @@ class Tuner:
                     t.error = "trial actor died"
                     running.remove(t)
                     self._stop_actor(t)
+                    _searcher_complete(t)
                     continue
                 decision = sched_lib.CONTINUE
                 for rep in st["reports"]:
@@ -149,6 +212,11 @@ class Tuner:
                     t.history.append(metrics)
                     if rep["checkpoint_path"]:
                         t.checkpoint_path = rep["checkpoint_path"]
+                    if searcher is not None:
+                        try:
+                            searcher.on_trial_result(t.id, metrics)
+                        except Exception:
+                            pass
                     d = scheduler.on_result(t.id, metrics)
                     if d != sched_lib.CONTINUE:
                         decision = d
@@ -157,14 +225,17 @@ class Tuner:
                     t.error = st["error"]
                     running.remove(t)
                     self._stop_actor(t)
+                    _searcher_complete(t)
                 elif st["done"]:
                     t.state = "COMPLETED"
                     running.remove(t)
                     self._stop_actor(t)
+                    _searcher_complete(t)
                 elif decision == sched_lib.STOP:
                     t.state = "STOPPED"
                     running.remove(t)
                     self._stop_actor(t)
+                    _searcher_complete(t)
                 elif isinstance(decision, tuple) and decision[0] == "EXPLOIT":
                     _, donor_id, mutate = decision
                     donor = next(d for d in trials if d.id == donor_id)
@@ -175,6 +246,7 @@ class Tuner:
                         t.error = f"exploit restart failed: {e!r}"
                         running.remove(t)
                         self._stop_actor(t)
+                        _searcher_complete(t)
         results = [TrialResult(
             trial_id=t.id, config=t.config, metrics=t.last_metrics,
             checkpoint=Checkpoint(t.checkpoint_path) if t.checkpoint_path else None,

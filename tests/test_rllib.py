@@ -270,3 +270,103 @@ def test_bc_from_dataset():
     r = algo.train()
     assert np.isfinite(r["bc_nll"])
     algo.stop()
+
+
+def test_impala_learns_cartpole():
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_envs_per_env_runner=16,
+                         rollout_fragment_length=128)
+            .training(lr=7e-4, entropy_coeff=0.003)
+            .debugging(seed=0)
+            .build())
+    first = algo.train()
+    for _ in range(89):
+        result = algo.train()
+    assert result["episode_return_mean"] > 60, result
+    assert result["episode_return_mean"] > first.get("episode_return_mean",
+                                                     22)
+    algo.stop()
+
+
+def test_impala_async_pipeline(cluster):
+    """Decoupled rollouts -> aggregation actor -> V-trace learner."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=16)
+            .training(fragments_per_batch=2, updates_per_iteration=3)
+            .build())
+    r = algo.train()
+    assert r["num_learner_updates"] >= 1
+    r = algo.train()
+    assert r["training_iteration"] == 2
+    algo.stop()
+
+
+def test_appo_learns_cartpole():
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_envs_per_env_runner=16,
+                         rollout_fragment_length=128)
+            .training(lr=7e-4, entropy_coeff=0.003, clip_param=0.3,
+                      use_kl_loss=True, kl_coeff=0.1, target_update_freq=2)
+            .debugging(seed=0)
+            .build())
+    first = algo.train()
+    for _ in range(89):
+        result = algo.train()
+    assert result["episode_return_mean"] > 60, result
+    assert result["episode_return_mean"] > first.get("episode_return_mean",
+                                                     22)
+    assert algo.learner.target_params is not None
+    algo.stop()
+
+
+def test_appo_async_pipeline(cluster):
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=16)
+            .training(fragments_per_batch=2, updates_per_iteration=3)
+            .build())
+    r = algo.train()
+    assert r["num_learner_updates"] >= 1
+    algo.stop()
+
+
+def test_vtrace_reduces_to_gae_like_targets_on_policy():
+    """On-policy (ratios==1), V-trace vs targets equal the discounted
+    n-step returns — the published identity, checked numerically."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.impala import vtrace
+
+    T, N = 5, 3
+    rng = np.random.default_rng(0)
+    rewards = rng.normal(size=(T, N)).astype(np.float32)
+    values = rng.normal(size=(T, N)).astype(np.float32)
+    last_values = rng.normal(size=(N,)).astype(np.float32)
+    dones = np.zeros((T, N), np.float32)
+    logp = rng.normal(size=(T, N)).astype(np.float32)
+    gamma = 0.9
+    vs, _ = vtrace(jnp.asarray(logp), jnp.asarray(logp),
+                   jnp.asarray(rewards), jnp.asarray(values),
+                   jnp.asarray(dones), jnp.asarray(last_values), gamma)
+    # reference recursion computed directly
+    expect = np.zeros((T, N), np.float32)
+    next_values = np.concatenate([values[1:], last_values[None]], axis=0)
+    deltas = rewards + gamma * next_values - values
+    acc = np.zeros((N,), np.float32)
+    for t in reversed(range(T)):
+        acc = deltas[t] + gamma * acc
+        expect[t] = acc + values[t]
+    np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-4, atol=1e-4)
