@@ -31,15 +31,18 @@ def main():
 
     import os
 
-    seq_len = 1024
-    per_chip_batch = int(os.environ.get("BENCH_BATCH", "24"))
-    # sweep 2026-07 r2 (see benchmarks/MFU_ANALYSIS.md): dots-remat @ 24
-    # is the best config the relay will compile (it rejects batch >= 40;
-    # remat=False and dots_all OOM/underperform; flash loses to XLA's
-    # fused dense attention at seq 1024)
+    preset = os.environ.get("BENCH_PRESET", "gpt2-125m")
+    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+    # defaults per preset from the 2026-07 sweeps (benchmarks/MFU_ANALYSIS.md
+    # + r4 350M sweep): dots-remat @ 24 is the best 125M config the relay
+    # will compile (it rejects batch >= 40; remat=False and dots_all
+    # OOM/underperform; flash loses to XLA's fused dense attention at 1024)
+    default_batch = {"gpt2-125m": 24, "gpt2-350m": 14,
+                     "gpt2-774m": 4, "gpt2-1.5b": 2}.get(preset, 8)
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
     batch = per_chip_batch * n
     cfg = gpt2.GPT2Config.preset(
-        "gpt2-125m", max_seq_len=seq_len,
+        preset, max_seq_len=seq_len,
         remat=os.environ.get("BENCH_REMAT", "1") != "0",
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots"),
         attn_impl=os.environ.get("BENCH_ATTN", "auto"))
@@ -73,11 +76,14 @@ def main():
     mfu = (gpt2.flops_per_token(cfg, seq_len) * tps_per_chip) / 197e12  # v5e bf16 peak
 
     print(json.dumps({
-        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+        "metric": f"{preset.replace('-', '_').replace('.', '_')}"
+                  f"_train_tokens_per_sec_per_chip",
         "value": round(tps_per_chip, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(tps_per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3),
+        "vs_baseline": round(tps_per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3)
+        if preset == "gpt2-125m" else None,
         "extra": {"n_chips": n, "seq_len": seq_len, "per_chip_batch": per_chip_batch,
+                  "preset": preset,
                   "step_ms": round(dt / iters * 1e3, 2), "approx_mfu": round(mfu, 3),
                   "loss": loss_val},
     }))

