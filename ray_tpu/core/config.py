@@ -1,0 +1,216 @@
+"""Central config registry: every tunable in ONE table.
+
+Parity: `src/ray/common/ray_config_def.h` (the reference's 222-flag
+X-macro table) + `RayConfig` introspection. Before this module, ~40
+`RAY_TPU_*` env vars were read ad hoc across ~25 files — no single list,
+no introspection, no way to ask a running cluster what it's tuned to.
+
+- `config.get("name")` — typed value: explicit override → env var →
+  default. Call-time reads, so tests that set env vars keep working.
+- `config.dump()` — every flag with value + where it came from
+  (`ray-tpu config` CLI, `/api/config` dashboard, state API).
+- **negotiated flags** adopt the HEAD's value at registration (shipped
+  in the `register_worker` reply): a process whose environment differs
+  from the head's must not silently diverge on semantics the whole
+  cluster shares. Precedence for negotiated flags is override > head >
+  env > default (the head beats local env, an explicit in-process
+  `set()` beats everything); non-negotiated flags skip the head tier.
+  `refcount` pioneered this in r3; the mechanism is now general.
+
+Adding a flag = one table row; reading env directly for a tunable is a
+review error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str           # python-side name (snake_case)
+    env: str            # environment variable
+    type: type          # bool | int | float | str
+    default: Any
+    doc: str
+    negotiated: bool = False  # cluster-wide: clients adopt the head's value
+
+
+def _b(v: str) -> bool:
+    return v not in ("0", "false", "False", "")
+
+
+FLAGS: List[Flag] = [
+    # ----------------------------------------------------- object lifetime
+    Flag("refcount", "RAY_TPU_REFCOUNT", bool, True,
+         "Distributed reference counting drives object eviction "
+         "(free() optional). Reference: ReferenceCounter.", negotiated=True),
+    Flag("evict_grace_s", "RAY_TPU_EVICT_GRACE_S", float, 0.0,
+         "Grace before evicting an interest-free object; 0 = fully "
+         "explicit lifetime (borrow pins).", negotiated=True),
+    Flag("refcount_flush_s", "RAY_TPU_REFCOUNT_FLUSH_S", float, 0.1,
+         "Batching window for ref transitions pushed to the head."),
+    Flag("lineage_cap", "RAY_TPU_LINEAGE_CAP", int, 10_000,
+         "Max reconstructable-task lineage entries at the head."),
+    Flag("lineage_bytes", "RAY_TPU_LINEAGE_BYTES", int, 256 << 20,
+         "Byte cap for lineage specs (inline args pin memory)."),
+    # ------------------------------------------------------- object store
+    Flag("object_store_bytes", "RAY_TPU_OBJECT_STORE_BYTES", int, 0,
+         "Node object-store capacity; 0 = 30% of RAM capped by /dev/shm."),
+    Flag("store_isolation", "RAY_TPU_STORE_ISOLATION", bool, False,
+         "Per-node store namespaces on one machine (forces real "
+         "cross-node transfers in tests)."),
+    Flag("store_namespace", "RAY_TPU_STORE_NAMESPACE", str, "",
+         "Explicit store namespace (else derived from node id)."),
+    Flag("disable_native_store", "RAY_TPU_DISABLE_NATIVE_STORE", bool, False,
+         "Skip the C++ arena store even if built."),
+    Flag("pull_cache_bytes", "RAY_TPU_PULL_CACHE_BYTES", int, 1 << 30,
+         "Per-process LRU cache of cross-node pulled objects."),
+    # -------------------------------------------------------- data plane
+    Flag("transfer_chunk_bytes", "RAY_TPU_TRANSFER_CHUNK_BYTES", int, 4 << 20,
+         "Chunk size for cross-node object pulls."),
+    Flag("transfer_window", "RAY_TPU_TRANSFER_WINDOW", int, 4,
+         "In-flight chunks per pull (windowed transfer)."),
+    Flag("transfer_server_reads", "RAY_TPU_TRANSFER_SERVER_READS", int, 8,
+         "Concurrent chunk reads served per data server."),
+    Flag("ici_fetch_timeout_s", "RAY_TPU_ICI_FETCH_TIMEOUT_S", float, 60.0,
+         "Bound on a gang-ICI device fetch before the consumer surfaces "
+         "ObjectLostError (a dead peer poisons the pair collective)."),
+    # ----------------------------------------------------------- runtime
+    Flag("head_host", "RAY_TPU_HEAD_HOST", str, "127.0.0.1",
+         "Head host for spawned workers."),
+    Flag("bind_host", "RAY_TPU_BIND_HOST", str, "127.0.0.1",
+         "Bind address for every server (head/data/direct/proxy); set "
+         "0.0.0.0 to accept off-box connections."),
+    Flag("address", "RAY_TPU_ADDRESS", str, "",
+         "Default cluster address for init()/CLI."),
+    Flag("lease_idle_s", "RAY_TPU_LEASE_IDLE_S", float, 1.0,
+         "Idle time before a leased worker returns to the pool."),
+    Flag("reconnect_timeout_s", "RAY_TPU_RECONNECT_TIMEOUT_S", float, 30.0,
+         "Window for clients to reconnect to a restarted head; 0 = die "
+         "on disconnect."),
+    Flag("runtime_env_cache_bytes", "RAY_TPU_RUNTIME_ENV_CACHE_BYTES",
+         int, 2 << 30, "Head-side cap for cached runtime_env packages."),
+    Flag("testing_rpc_failure", "RAY_TPU_TESTING_RPC_FAILURE", str, "",
+         "Chaos injection: 'method:prob,...' (reference rpc_chaos)."),
+    # ------------------------------------------------------------- memory
+    Flag("memory_monitor", "RAY_TPU_MEMORY_MONITOR", bool, True,
+         "OOM monitor kills the newest task when node memory crosses "
+         "the threshold."),
+    Flag("memory_usage_threshold", "RAY_TPU_MEMORY_USAGE_THRESHOLD",
+         float, 0.95, "Fraction of node memory that triggers the killer."),
+    Flag("memory_monitor_interval_s", "RAY_TPU_MEMORY_MONITOR_INTERVAL_S",
+         float, 1.0, "Monitor poll interval."),
+    Flag("meminfo_path", "RAY_TPU_MEMINFO_PATH", str, "/proc/meminfo",
+         "Meminfo source (tests point this at a fixture)."),
+    # ------------------------------------------------------------ logging
+    Flag("log_to_driver", "RAY_TPU_LOG_TO_DRIVER", bool, True,
+         "Stream worker prints to the submitting driver's terminal."),
+    # ------------------------------------------------------ observability
+    Flag("tracing", "RAY_TPU_TRACING", bool, False,
+         "OpenTelemetry-style span export."),
+    Flag("metrics_push_interval_s", "RAY_TPU_METRICS_PUSH_INTERVAL_S",
+         float, 5.0, "Worker metrics push cadence."),
+    # --------------------------------------------------------------- TPU
+    Flag("num_chips", "RAY_TPU_NUM_CHIPS", int, -1,
+         "Override TPU chip autodetection (-1 = autodetect)."),
+    Flag("pod_type", "RAY_TPU_POD_TYPE", str, "",
+         "Override slice/pod type (else GKE env / GCE metadata)."),
+    Flag("slice_name", "RAY_TPU_SLICE_NAME", str, "",
+         "Override slice name (else TPU_NAME / GCE metadata)."),
+    Flag("worker_id", "RAY_TPU_WORKER_ID", str, "",
+         "Override TPU pod worker index."),
+    Flag("gce_metadata_endpoint", "RAY_TPU_GCE_METADATA_ENDPOINT", str, "",
+         "Override the GCE metadata server (tests use a local mock)."),
+    # --------------------------------------------------------------- data
+    Flag("data_memory_budget_bytes", "RAY_TPU_DATA_MEMORY_BUDGET_BYTES",
+         int, 256 << 20,
+         "Streaming executor in-flight byte budget (adaptive window)."),
+    # -------------------------------------------------------------- train
+    Flag("torch_backend", "RAY_TPU_TORCH_BACKEND", str, "gloo",
+         "torch.distributed backend for TorchTrainer."),
+    Flag("torch_timeout_s", "RAY_TPU_TORCH_TIMEOUT_S", float, 60.0,
+         "torch.distributed init timeout."),
+]
+
+_BY_NAME: Dict[str, Flag] = {f.name: f for f in FLAGS}
+_BY_ENV: Dict[str, Flag] = {f.env: f for f in FLAGS}
+
+
+class Config:
+    """Process-wide view: overrides > env > head-negotiated > default."""
+
+    def __init__(self) -> None:
+        self._overrides: Dict[str, Any] = {}
+        self._head_values: Dict[str, Any] = {}
+
+    def _parse(self, flag: Flag, raw: str) -> Any:
+        if flag.type is bool:
+            return _b(raw)
+        try:
+            return flag.type(raw)
+        except (TypeError, ValueError):
+            return flag.default
+
+    def get(self, name: str) -> Any:
+        flag = _BY_NAME[name]
+        if name in self._overrides:
+            return self._overrides[name]
+        if flag.negotiated and name in self._head_values:
+            return self._head_values[name]  # head beats local env
+        raw = os.environ.get(flag.env)
+        if raw is not None and raw != "":
+            return self._parse(flag, raw)
+        return flag.default
+
+    def source(self, name: str) -> str:
+        flag = _BY_NAME[name]
+        if name in self._overrides:
+            return "override"
+        if flag.negotiated and name in self._head_values:
+            return "head"
+        raw = os.environ.get(flag.env)
+        if raw is not None and raw != "":
+            return "env"
+        return "default"
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in _BY_NAME:
+            raise KeyError(f"unknown config flag {name!r}")
+        self._overrides[name] = value
+
+    # ----------------------------------------------- cluster distribution
+    def negotiated_snapshot(self) -> Dict[str, Any]:
+        """The head's values for every negotiated flag — shipped to each
+        process in the register_worker reply."""
+        return {f.name: self.get(f.name) for f in FLAGS if f.negotiated}
+
+    def adopt_head(self, values: Optional[Dict[str, Any]]) -> None:
+        """Client side: record the head's negotiated values. get() ranks
+        them above local env (never above an explicit set() override),
+        and source() reports them as "head" — provenance stays honest."""
+        if not values:
+            return
+        self._head_values.update(values)
+
+    # ------------------------------------------------------ introspection
+    def dump(self) -> List[dict]:
+        return [{"name": f.name, "env": f.env,
+                 "type": f.type.__name__,
+                 "value": self.get(f.name), "default": f.default,
+                 "source": self.source(f.name),
+                 "negotiated": f.negotiated, "doc": f.doc}
+                for f in FLAGS]
+
+
+GLOBAL = Config()
+
+
+def get(name: str) -> Any:
+    return GLOBAL.get(name)
+
+
+def dump() -> List[dict]:
+    return GLOBAL.dump()

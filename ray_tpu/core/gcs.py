@@ -28,6 +28,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu.core import config as _config
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from ray_tpu.core.store import ObjectMeta, SharedMemoryStore
@@ -309,11 +310,10 @@ class Head:
         # reconstruction of lost objects (reference: TaskManager lineage +
         # object_recovery_manager). Bounded FIFO.
         self.lineage: "OrderedDict[ObjectID, dict]" = OrderedDict()
-        self.lineage_cap = int(os.environ.get("RAY_TPU_LINEAGE_CAP", "10000"))
+        self.lineage_cap = _config.get("lineage_cap")
         # byte cap mirrors the reference's RAY_max_lineage_bytes: specs keep
         # inline args alive, so count must not be the only bound
-        self.lineage_bytes_cap = int(os.environ.get(
-            "RAY_TPU_LINEAGE_BYTES", str(256 << 20)))
+        self.lineage_bytes_cap = _config.get("lineage_bytes")
         self.lineage_bytes = 0
         self._reconstructing: Set[ObjectID] = set()
         # ------- distributed object lifetime (reference_count.h parity) ---
@@ -323,7 +323,7 @@ class Head:
         # generator items), or a reconstructable lineage entry needs it as
         # an input (lineage_dep_pins). When all empty, it is evicted after
         # a short grace window that absorbs in-flight handoffs.
-        self.refcount_enabled = os.environ.get("RAY_TPU_REFCOUNT", "1") != "0"
+        self.refcount_enabled = _config.get("refcount")
         self.obj_holders: Dict[ObjectID, Set[WorkerID]] = {}
         self.obj_pins: Dict[ObjectID, int] = {}
         self.worker_holds: Dict[WorkerID, Set[ObjectID]] = {}
@@ -347,8 +347,7 @@ class Head:
         self._evict_due: Dict[ObjectID, float] = {}
         # borrow pins make lifetime explicit, so no grace window is needed
         # to absorb in-flight handoffs (was 2.0 s of correctness-by-timing)
-        self.evict_grace_s = float(os.environ.get(
-            "RAY_TPU_EVICT_GRACE_S", "0.0"))
+        self.evict_grace_s = _config.get("evict_grace_s")
         self.objects_evicted = 0
         # produced objects lost to node death, awaiting lazy reconstruction;
         # if their lineage entry gets cap-evicted meanwhile, consumers must
@@ -402,6 +401,10 @@ class Head:
                     # the head's refcount setting is authoritative; clients
                     # enable/disable their trackers from this reply
                     "refcount": self.refcount_enabled,
+                    # full negotiated-config snapshot (ray_config_def.h
+                    # style single source of truth; "refcount" above is
+                    # the r3-era key, kept for compatibility)
+                    "config": _config.GLOBAL.negotiated_snapshot(),
                     "driver_sys_path": self.kv.get(("cluster", b"driver_sys_path"))}
 
         async def register_node(node_id, resources, labels, max_workers,
@@ -772,6 +775,10 @@ class Head:
 
         async def list_state(kind):
             return self._list_state(kind)
+
+        async def get_config():
+            """The head's full flag table (ray-tpu config CLI, dashboard)."""
+            return _config.GLOBAL.dump()
 
         async def log_batch(entries):
             """Tailed lines pushed by a node daemon's LogMonitor."""
@@ -2053,8 +2060,7 @@ class Head:
         """Evict oldest runtime_env packages beyond the byte cap (no URI
         refcounting — workers keep extracted copies, so only a cold worker
         after eviction would refetch-and-fail, matching a bounded cache)."""
-        cap = int(os.environ.get("RAY_TPU_RUNTIME_ENV_CACHE_BYTES",
-                                 str(2 << 30)))
+        cap = _config.get("runtime_env_cache_bytes")
         entries = [(k, v) for k, v in self.kv.items()
                    if k[0] == "_runtime_env"]
         total = sum(len(v) for _, v in entries) + incoming

@@ -14,12 +14,14 @@ import os
 import time
 from typing import Callable, List, Optional
 
+from ray_tpu.core import config
+
 
 def system_memory_fraction() -> float:
     """Fraction of system memory in use, from /proc/meminfo (cgroup-unaware
     fallback; containers with limits can point RAY_TPU_MEMINFO_PATH at a
     synthetic file or use the env override hook in tests)."""
-    path = os.environ.get("RAY_TPU_MEMINFO_PATH", "/proc/meminfo")
+    path = config.get("meminfo_path")
     total = avail = None
     try:
         with open(path) as f:
@@ -62,9 +64,9 @@ class MemoryMonitor:
                  usage_fn: Callable[[], float] = system_memory_fraction):
         self.head = head
         self.threshold = threshold if threshold is not None else float(
-            os.environ.get("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.95"))
+            config.get("memory_usage_threshold"))
         self.interval_s = interval_s if interval_s is not None else float(
-            os.environ.get("RAY_TPU_MEMORY_MONITOR_INTERVAL_S", "1.0"))
+            config.get("memory_monitor_interval_s"))
         self.usage_fn = usage_fn
         self.num_kills = 0
 

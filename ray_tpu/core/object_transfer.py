@@ -23,9 +23,19 @@ from typing import Callable, Dict, Optional
 
 from ray_tpu.core.store import ObjectMeta, SharedMemoryStore
 
-CHUNK = int(os.environ.get("RAY_TPU_TRANSFER_CHUNK_BYTES", str(4 << 20)))
-WINDOW = int(os.environ.get("RAY_TPU_TRANSFER_WINDOW", "4"))
-SERVER_CONCURRENCY = int(os.environ.get("RAY_TPU_TRANSFER_SERVER_READS", "8"))
+from ray_tpu.core import config as _config
+
+
+def CHUNK() -> int:
+    return _config.get("transfer_chunk_bytes")
+
+
+def WINDOW() -> int:
+    return _config.get("transfer_window")
+
+
+def SERVER_CONCURRENCY() -> int:
+    return _config.get("transfer_server_reads")
 
 
 def make_data_handlers(get_store: Callable[[], Optional[SharedMemoryStore]]):
@@ -39,7 +49,7 @@ def make_data_handlers(get_store: Callable[[], Optional[SharedMemoryStore]]):
         # loop in-process and from tests' loops)
         key = id(asyncio.get_running_loop())
         if key not in sems:
-            sems[key] = asyncio.Semaphore(SERVER_CONCURRENCY)
+            sems[key] = asyncio.Semaphore(SERVER_CONCURRENCY())
         return sems[key]
 
     async def fetch_chunk(meta: ObjectMeta, offset: int, length: int):
@@ -82,19 +92,20 @@ async def pull_object(conn, meta: ObjectMeta, store: SharedMemoryStore) -> Objec
     chunked gets). Returns the local cached-copy meta."""
     pending = store.allocate_raw(meta.object_id, meta.size)
     try:
-        offsets = list(range(0, meta.size, CHUNK)) or [0]
+        chunk = CHUNK()
+        offsets = list(range(0, meta.size, chunk)) or [0]
         idx = 0
         inflight: Dict[int, asyncio.Future] = {}
         while idx < len(offsets) or inflight:
-            while idx < len(offsets) and len(inflight) < WINDOW:
+            while idx < len(offsets) and len(inflight) < WINDOW():
                 o = offsets[idx]
                 idx += 1
-                ln = min(CHUNK, meta.size - o)
+                ln = min(chunk, meta.size - o)
                 inflight[o] = conn.request_future(
                     "fetch_chunk", meta=meta, offset=o, length=ln)
             o = min(inflight)
             data = await inflight.pop(o)
-            expected = min(CHUNK, meta.size - o)
+            expected = min(chunk, meta.size - o)
             got = memoryview(data).nbytes if data is not None else 0
             if got != expected:
                 # a silently short chunk would seal a zero-padded buffer

@@ -37,7 +37,10 @@ from typing import Dict, List, Optional
 
 from ray_tpu.core.ids import ObjectID
 
-FLUSH_S = float(os.environ.get("RAY_TPU_REFCOUNT_FLUSH_S", "0.1"))
+def _flush_s() -> float:
+    from ray_tpu.core import config
+
+    return config.get("refcount_flush_s")
 
 _active: Optional["RefTracker"] = None
 
@@ -145,7 +148,7 @@ class RefTracker:
         self._flush_scheduled = True
         try:
             self.client.loop.call_soon_threadsafe(
-                lambda: self.client.loop.call_later(FLUSH_S, self._flush))
+                lambda: self.client.loop.call_later(_flush_s(), self._flush))
         except RuntimeError:
             self._flush_scheduled = False  # loop closed (shutdown)
 

@@ -153,6 +153,12 @@ def build_app(head) -> web.Application:
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
+    async def config_dump(_req):
+        from ray_tpu.core import config as cfg
+
+        return _json(cfg.dump())
+
+    app.router.add_get("/api/config", config_dump)
     app.router.add_get("/api/logs", logs_list)
     app.router.add_get("/api/logs/{filename}", log_get)
     app.router.add_get("/api/summary", summary)

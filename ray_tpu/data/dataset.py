@@ -27,8 +27,13 @@ from ray_tpu.data.block import (Block, batch_to_block, block_concat,
 DEFAULT_WINDOW = 8  # initial in-flight block tasks (adapts to a byte budget)
 # streaming memory budget (reference resource_budget_backpressure_policy):
 # the in-flight window adapts so (avg block bytes x window) stays under it
-DATA_MEMORY_BUDGET = int(os.environ.get(
-    "RAY_TPU_DATA_MEMORY_BUDGET_BYTES", str(256 << 20)))
+from ray_tpu.core import config as _config
+
+
+def DATA_MEMORY_BUDGET() -> int:   # call-time: env/set() changes apply
+    return _config.get("data_memory_budget_bytes")
+
+
 MIN_WINDOW, MAX_WINDOW = 2, 64
 
 
@@ -462,7 +467,7 @@ class Dataset:
                 if adapt and blocks_seen:
                     avg = max(bytes_seen // blocks_seen, 1)
                     window = min(MAX_WINDOW, max(
-                        MIN_WINDOW, int(DATA_MEMORY_BUDGET // avg)))
+                        MIN_WINDOW, int(DATA_MEMORY_BUDGET() // avg)))
                 self._last_window = window  # introspection (stats/tests)
                 while idx < len(self._partitions) and len(pending) < window:
                     ref = submit(idx, self._partitions[idx])

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import math
 from functools import partial
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -356,3 +356,66 @@ def flops_per_token(cfg: GPT2Config, seq_len: int) -> float:
     n = num_params(cfg) - cfg.vocab_size * cfg.d_model  # non-embedding
     attn = 12 * cfg.n_layer * cfg.d_model * seq_len
     return 6 * (n + cfg.vocab_size * cfg.d_model) + attn
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint IO (serve real weights: the reference's serve.llm loads HF
+# checkpoints into its engines; here trained params round-trip through an
+# npz so Serve replicas host what the trainer produced, not random init)
+# ---------------------------------------------------------------------------
+
+_CFG_FIELDS = ("vocab_size", "n_layer", "n_head", "d_model", "d_ff",
+               "max_seq_len")
+
+
+def save_params(path: str, params: Params, cfg: GPT2Config) -> str:
+    """Write params + the architecture fields needed to rebuild them.
+    One npz (path-keyed flat pytree) + a json sidecar; no orbax needed
+    for single-host serving checkpoints."""
+    import json
+    import os
+
+    import numpy as np
+
+    os.makedirs(path, exist_ok=True)
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                       for k in kp)
+        flat[key] = np.asarray(leaf)
+    tmp = os.path.join(path, "params.npz.tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, os.path.join(path, "params.npz"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({k: getattr(cfg, k) for k in _CFG_FIELDS}, f)
+    return path
+
+
+def load_params(path: str, cfg: Optional[GPT2Config] = None
+                ) -> Tuple[Params, GPT2Config]:
+    """Load a save_params checkpoint; architecture comes from the sidecar
+    (runtime knobs like remat/attn_impl come from `cfg` when given)."""
+    import json
+    import os
+
+    import numpy as np
+
+    with open(os.path.join(path, "config.json")) as f:
+        arch = json.load(f)
+    base = cfg or GPT2Config()
+    cfg = dataclasses.replace(base, **arch)
+    template = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    leaves_kp = jax.tree_util.tree_flatten_with_path(template)[0]
+    with np.load(os.path.join(path, "params.npz")) as z:
+        loaded = []
+        for kp, leaf in leaves_kp:
+            key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                           for k in kp)
+            arr = z[key]
+            if tuple(arr.shape) != tuple(leaf.shape):
+                raise ValueError(f"checkpoint leaf {key}: shape "
+                                 f"{arr.shape} != expected {leaf.shape}")
+            loaded.append(jnp.asarray(arr, dtype=leaf.dtype))
+    treedef = jax.tree_util.tree_structure(template)
+    return jax.tree_util.tree_unflatten(treedef, loaded), cfg

@@ -224,6 +224,28 @@ def cmd_job(args) -> None:
         print("stopped" if client.stop_job(args.job_id) else "not running")
 
 
+def cmd_config(args) -> None:
+    """The running head's full flag table (reference `ray_config_def.h`
+    introspection): value, default, and where each value came from."""
+    if args.local:
+        from ray_tpu.core import config as cfg
+
+        rows = cfg.dump()
+    else:
+        rows = _connect(args).head_request("get_config")
+    if args.json:
+        print(json.dumps(rows, indent=1, default=str))
+        return
+    w = max(len(r["name"]) for r in rows)
+    for r in rows:
+        mark = " [negotiated]" if r["negotiated"] else ""
+        star = "" if r["source"] == "default" else f"  ({r['source']})"
+        print(f"{r['name']:<{w}}  {r['value']!r:<14}{star}{mark}")
+        if args.verbose:
+            print(f"{'':<{w}}  env={r['env']} default={r['default']!r}")
+            print(f"{'':<{w}}  {r['doc']}")
+
+
 def cmd_up(args) -> None:
     from ray_tpu.autoscaler import launcher
 
@@ -347,6 +369,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("config", help="show the cluster's config flags")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--local", action="store_true",
+                    help="this process's view instead of the head's")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--verbose", "-v", action="store_true")
+    sp.set_defaults(fn=cmd_config)
 
     sp = sub.add_parser("up", help="bring a cluster up from cluster.yaml")
     sp.add_argument("config_file")

@@ -7,8 +7,11 @@ slot batch (ray_tpu/models/gpt2.py decode_step); requests are admitted into
 free slots as others finish (continuous batching), so decode throughput
 stays at the full batch width under load.
 
-No network egress: prompts are byte-level tokenized by default (real
-checkpoints would ship their own tokenizer).
+Real weights: `checkpoint=` loads a `gpt2.save_params` directory (what
+the trainer writes), so replicas serve trained parameters, not random
+init; `tokenizer=` accepts any encode/decode object (an HF tokenizer
+adapter is provided, gated on a locally cached vocab — zero egress).
+ByteTokenizer remains the self-contained fallback.
 """
 
 from __future__ import annotations
@@ -35,6 +38,28 @@ class ByteTokenizer:
             "utf-8", errors="replace")
 
 
+class HFTokenizer:
+    """transformers tokenizer adapter (reference serve.llm uses the HF
+    tokenizer of the served checkpoint). Requires the vocab to already be
+    on disk/cache — this environment has no egress, so construction
+    fails loudly rather than downloading."""
+
+    def __init__(self, name_or_path: str):
+        try:
+            from transformers import AutoTokenizer
+        except ImportError as e:  # pragma: no cover - env-dependent
+            raise ImportError("HFTokenizer requires `transformers`") from e
+        self._tok = AutoTokenizer.from_pretrained(name_or_path,
+                                                  local_files_only=True)
+        self.eos_id = self._tok.eos_token_id or 0
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids)
+
+
 class _Request:
     def __init__(self, prompt_ids: List[int], max_tokens: int,
                  temperature: float, top_k: int = 0, top_p: float = 1.0):
@@ -53,7 +78,9 @@ class LLMEngine:
 
     def __init__(self, preset: str = "gpt2-tiny", max_batch: int = 4,
                  max_seq_len: int = 128, seed: int = 0,
-                 model_overrides: Optional[dict] = None):
+                 model_overrides: Optional[dict] = None,
+                 checkpoint: Optional[str] = None,
+                 tokenizer: Any = None):
         import jax
         import jax.numpy as jnp
 
@@ -62,10 +89,21 @@ class LLMEngine:
         self.jax, self.jnp, self.gpt2 = jax, jnp, gpt2
         overrides = dict(model_overrides or {})
         overrides.setdefault("max_seq_len", max_seq_len)
-        self.cfg = gpt2.GPT2Config.preset(preset, **overrides)
-        self.params = gpt2.init_params(jax.random.key(seed), self.cfg)
+        if checkpoint:
+            # REAL weights: architecture from the checkpoint sidecar,
+            # runtime knobs (seq len etc.) from the preset/overrides
+            base = gpt2.GPT2Config.preset(preset, **overrides)
+            self.params, self.cfg = gpt2.load_params(checkpoint, cfg=base)
+            self.checkpoint = checkpoint
+        else:
+            self.cfg = gpt2.GPT2Config.preset(preset, **overrides)
+            self.params = gpt2.init_params(jax.random.key(seed), self.cfg)
+            self.checkpoint = None
         self.max_batch = max_batch
-        self.max_seq_len = self.cfg.max_seq_len
+        # serving window: the caller's bound caps KV-cache memory even
+        # when a checkpoint's architecture allows a longer context (the
+        # sidecar must win for PARAM shapes, never for cache sizing)
+        self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
         self.cache = gpt2.init_cache(self.cfg, max_batch, self.max_seq_len)
         cfg = self.cfg
 
@@ -73,7 +111,7 @@ class LLMEngine:
             return gpt2.decode_step(params, cache, tokens, pos, active, cfg)
 
         self._step = jax.jit(_step, donate_argnums=(1,))
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._slots: List[Optional[_Request]] = [None] * max_batch
@@ -190,10 +228,12 @@ class LLMServer:
     """Deployment callable: OpenAI-completions-shaped request handling."""
 
     def __init__(self, preset: str = "gpt2-tiny", max_batch: int = 4,
-                 max_seq_len: int = 128, model_overrides: Optional[dict] = None):
+                 max_seq_len: int = 128, model_overrides: Optional[dict] = None,
+                 checkpoint: Optional[str] = None, tokenizer: Any = None):
         self.engine = LLMEngine(preset=preset, max_batch=max_batch,
                                 max_seq_len=max_seq_len,
-                                model_overrides=model_overrides)
+                                model_overrides=model_overrides,
+                                checkpoint=checkpoint, tokenizer=tokenizer)
 
     def __call__(self, request: Any) -> dict:
         body = request if isinstance(request, dict) else getattr(
@@ -235,7 +275,8 @@ class OpenAIServer(LLMServer):
             return {"object": "list",
                     "data": [{"id": self.model_id, "object": "model",
                               "owned_by": "ray_tpu"}]}
-        body = getattr(request, "json", None) or {}
+        body = request if isinstance(request, dict) else \
+            getattr(request, "json", None) or {}
         max_tokens = int(body.get("max_tokens", 16))
         temperature = float(body.get("temperature", 1.0))
         top_p = float(body.get("top_p", 1.0))
@@ -265,7 +306,9 @@ class OpenAIServer(LLMServer):
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
-        out = self.engine.generate(prompt=prompt, max_tokens=max_tokens,
+        out = self.engine.generate(prompt=prompt,
+                                   prompt_ids=body.get("prompt_ids"),
+                                   max_tokens=max_tokens,
                                    temperature=temperature, top_k=top_k,
                                    top_p=top_p)
         finish = ("length" if out["completion_tokens"] >= max_tokens
@@ -286,7 +329,8 @@ def build_openai_app(preset: str = "gpt2-tiny", max_batch: int = 4,
                      max_seq_len: int = 128, num_replicas: int = 1,
                      model_id: str = "ray-tpu-llm",
                      model_overrides: Optional[dict] = None,
-                     num_tpu_chips: int = 0):
+                     num_tpu_chips: int = 0,
+                     checkpoint: Optional[str] = None):
     """Deployment graph for an OpenAI-compatible server (reference
     `ray.serve.llm.build_openai_app`); run with
     `serve.run(app, route_prefix="/v1")`."""
@@ -300,14 +344,16 @@ def build_openai_app(preset: str = "gpt2-tiny", max_batch: int = 4,
                      ray_actor_options=actor_options,
                      max_ongoing_requests=max_batch * 2)
     return dep.bind(model_id=model_id, preset=preset, max_batch=max_batch,
-                    max_seq_len=max_seq_len, model_overrides=model_overrides)
+                    max_seq_len=max_seq_len, model_overrides=model_overrides,
+                    checkpoint=checkpoint)
 
 
 def build_llm_deployment(preset: str = "gpt2-tiny", max_batch: int = 4,
                          max_seq_len: int = 128, num_replicas: int = 1,
                          name: str = "llm",
                          model_overrides: Optional[dict] = None,
-                         num_tpu_chips: int = 0):
+                         num_tpu_chips: int = 0,
+                         checkpoint: Optional[str] = None):
     """Deployment for an LLM server (reference build_openai_app analog)."""
     from ray_tpu.serve.api import deployment
 
@@ -319,4 +365,5 @@ def build_llm_deployment(preset: str = "gpt2-tiny", max_batch: int = 4,
         ray_actor_options=actor_options,
         max_ongoing_requests=max_batch * 2)
     return dep.bind(preset=preset, max_batch=max_batch,
-                    max_seq_len=max_seq_len, model_overrides=model_overrides)
+                    max_seq_len=max_seq_len, model_overrides=model_overrides,
+                    checkpoint=checkpoint)

@@ -104,7 +104,10 @@ class IciMember:
 
         v = {"w": jnp.arange(64.0).reshape(8, 8) + 100 * self.rank,
              "tag": f"rank{self.rank}"}
-        return ray_tpu.put_device(v).hex()
+        # the actor HOLDS the ref: dropping it would race refcount
+        # eviction against the consumer's get
+        self._ref = ray_tpu.put_device(v)
+        return self._ref.hex()
 
     def get_value(self, hex_id):
         import jax
@@ -116,6 +119,26 @@ class IciMember:
         val = ray_tpu.get(ObjectRef(ObjectID.from_hex(hex_id)), timeout=120)
         assert isinstance(val["w"], jax.Array), type(val["w"])
         return {"w": np.asarray(val["w"]), "tag": val["tag"]}
+
+    def get_value_any(self, hex_id):
+        import numpy as np
+
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        val = ray_tpu.get(ObjectRef(ObjectID.from_hex(hex_id)), timeout=120)
+        return np.asarray(val["w"])
+
+    def get_error(self, hex_id):
+        """get() expected to FAIL: returns the error string."""
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        try:
+            ray_tpu.get(ObjectRef(ObjectID.from_hex(hex_id)), timeout=120)
+            return "NO-ERROR"
+        except Exception as e:  # noqa: BLE001 - the error IS the result
+            return repr(e)
 
     def staged_snapshots(self):
         """How many host snapshots this process staged (must stay 0 for
@@ -136,5 +159,65 @@ def test_device_object_fetch_over_ici(cluster):
     np.testing.assert_allclose(out["w"], np.arange(64.0).reshape(8, 8))
     assert out["tag"] == "rank0"
     assert ray_tpu.get(members[0].staged_snapshots.remote(), timeout=60) == 0
+    for m in members:
+        ray_tpu.kill(m)
+
+
+def test_stale_membership_falls_back_to_snapshot(cluster):
+    """A membership entry claiming the OWNER is in our gang when it is
+    not (crashed-and-replaced process reusing a worker id, or a group
+    destroyed owner-side only): the consumer must fall back to the shm
+    snapshot path and still return the value (r3 VERDICT weak #4)."""
+    import pickle
+
+    from ray_tpu.util.collective.xla_multihost import _MEMBER_NS
+
+    @ray_tpu.remote
+    class PlainOwner:
+        """NOT a gang member — its membership entry will be forged."""
+
+        def put_value(self):
+            import jax.numpy as jnp
+
+            self._ref = ray_tpu.put_device({"w": jnp.ones((4, 4)) * 7})
+            return self._ref.hex(), \
+                ray_tpu.get_runtime_context().worker_id.hex()
+
+    owner = PlainOwner.options(
+        runtime_env={"env_vars": MEMBER_ENV}).remote()
+    hex_id, owner_wid = ray_tpu.get(owner.put_value.remote(), timeout=120)
+
+    consumers = [IciMember.options(runtime_env={"env_vars": MEMBER_ENV}).remote(
+        2, r, "xmh_stale") for r in range(2)]
+    # warm the gang, then FORGE a stale membership entry for the owner
+    ray_tpu.get([c.staged_snapshots.remote() for c in consumers], timeout=120)
+    from ray_tpu.core.api import _global_client
+
+    _global_client().kv_put(
+        _MEMBER_NS, owner_wid.encode(),
+        pickle.dumps({"group": "xmh_stale", "rank": 0, "world": 2}),
+        overwrite=True)
+    # rank-1 consumer: membership says owner is rank 0 of OUR group; the
+    # owner's fetch_device_ici returns None (no such group there) and the
+    # consumer must fall back — value still arrives, no hang
+    out = ray_tpu.get(consumers[1].get_value_any.remote(hex_id), timeout=120)
+    np.testing.assert_allclose(out, np.full((4, 4), 7.0))
+    for a in [owner] + consumers:
+        ray_tpu.kill(a)
+
+
+def test_crashed_peer_surfaces_error_not_hang(cluster):
+    """Owner replies to the ICI fetch but never enters the transfer
+    (crash between reply and send, simulated by the chaos hook): the
+    consumer must surface ObjectLostError within the fetch timeout
+    instead of blocking in the ppermute forever (r3 VERDICT weak #4)."""
+    env = dict(MEMBER_ENV)
+    env["RAY_TPU_TESTING_ICI_DROP_SEND"] = "1"     # owner drops the send
+    env["RAY_TPU_ICI_FETCH_TIMEOUT_S"] = "5"
+    members = [IciMember.options(runtime_env={"env_vars": env}).remote(
+        2, r, "xmh_crash") for r in range(2)]
+    hex_id = ray_tpu.get(members[0].put_value.remote(), timeout=120)
+    err = ray_tpu.get(members[1].get_error.remote(hex_id), timeout=120)
+    assert "never entered the ICI transfer" in err, err
     for m in members:
         ray_tpu.kill(m)

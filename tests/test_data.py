@@ -316,7 +316,7 @@ def test_adaptive_streaming_window(cluster, monkeypatch):
     list(tiny._stream_blocks())
     assert tiny._last_window > ds_mod.DEFAULT_WINDOW  # tiny blocks: widen
 
-    monkeypatch.setattr(ds_mod, "DATA_MEMORY_BUDGET", 1 << 20)
+    monkeypatch.setenv("RAY_TPU_DATA_MEMORY_BUDGET_BYTES", str(1 << 20))
     big = rdata.range(16).map_batches(
         lambda b: {"x": np.zeros((len(b["id"]), 1 << 17), np.float64)})
     list(big._stream_blocks())

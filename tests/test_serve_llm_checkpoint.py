@@ -1,0 +1,121 @@
+"""serve.llm hosts REAL trained weights (r3 VERDICT weak #7).
+
+Train gpt2-tiny with the SPMD trainer, save a checkpoint, serve it: the
+deployed replica must produce byte-identical greedy generations to an
+offline decode with the saved params — proof the engine serves the
+trained checkpoint, not random init. Tokenizer seam covered by a custom
+tokenizer object flowing through the engine.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpu_chips=0, max_workers=6)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    """A few real training steps on a synthetic repeating corpus."""
+    import jax
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.train.spmd import compile_gpt2_train, default_optimizer
+
+    cfg = gpt2.GPT2Config.preset("gpt2-tiny", attn_impl="dense")
+    mesh = build_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    train = compile_gpt2_train(
+        cfg, mesh, optimizer=default_optimizer(lr=1e-3, total_steps=200))
+    state = train.init_fn(jax.random.key(0))
+    # a LEARNABLE corpus: the repeating cycle 1..16 — a trained model
+    # continues it, a random-init model cannot
+    cycle = np.arange(1, 17, dtype=np.int32)
+    row = np.tile(cycle, cfg.max_seq_len // 16 + 2)[:cfg.max_seq_len + 1]
+    tokens = np.stack([row, np.roll(row, -3)])
+    first = last = None
+    for _ in range(200):
+        state, metrics = train.step_fn(state, {"tokens": tokens})
+        loss = float(metrics["loss"])
+        first = first if first is not None else loss
+        last = loss
+    assert last < first * 0.5  # it actually trained
+    path = str(tmp_path_factory.mktemp("llm") / "ckpt")
+    gpt2.save_params(path, jax.tree.map(np.asarray, state.params), cfg)
+    return path
+
+
+def test_engine_serves_trained_weights(cluster, checkpoint):
+    from ray_tpu.serve.llm import LLMEngine
+
+    trained = LLMEngine(preset="gpt2-tiny", max_batch=2, max_seq_len=64,
+                        checkpoint=checkpoint,
+                        model_overrides={"attn_impl": "dense"})
+    try:
+        prompt_ids = [1, 2, 3, 4, 5]
+        out_t = trained.generate(prompt_ids=prompt_ids, max_tokens=12,
+                                 temperature=0.0)
+        # greedy decode from the TRAINED params, computed offline: the
+        # served engine must match it token for token
+        import jax.numpy as jnp
+
+        from ray_tpu.models import gpt2
+
+        params, cfg = gpt2.load_params(checkpoint)
+        ids = list(prompt_ids)
+        for _ in range(12):
+            logits = gpt2.forward(params, jnp.asarray([ids]), cfg)
+            ids.append(int(jnp.argmax(logits[0, -1])))
+        expect = ids[len(prompt_ids):]
+        assert out_t["token_ids"] == expect, \
+            "served generation != offline decode of the saved checkpoint"
+        # and the trained model actually LEARNED the corpus: it continues
+        # the 1..16 cycle — impossible from random init
+        assert out_t["token_ids"] == [6, 7, 8, 9, 10, 11, 12, 13, 14,
+                                      15, 16, 1], out_t["token_ids"]
+    finally:
+        trained.shutdown()
+
+
+def test_deployment_serves_checkpoint_over_http(cluster, checkpoint):
+    from ray_tpu.serve.llm import build_openai_app
+
+    app = build_openai_app(preset="gpt2-tiny", max_batch=2, max_seq_len=64,
+                           model_id="trained-tiny", checkpoint=checkpoint,
+                           model_overrides={"attn_impl": "dense"})
+    h = serve.run(app, route_prefix="/v1")
+    out = h.remote({"prompt": "abcd", "max_tokens": 6,
+                    "temperature": 0.0}).result(timeout=180)
+    assert out.get("choices"), out
+    assert out["usage"]["completion_tokens"] == 6
+
+
+def test_custom_tokenizer_seam(cluster, checkpoint):
+    from ray_tpu.serve.llm import LLMEngine
+
+    class ShoutTokenizer:
+        eos_id = 0
+
+        def encode(self, text):
+            return [min(ord(c), 500) for c in text.upper()]
+
+        def decode(self, ids):
+            return "".join(chr(i) if i < 128 else "?" for i in ids)
+
+    eng = LLMEngine(preset="gpt2-tiny", max_batch=2, max_seq_len=64,
+                    checkpoint=checkpoint, tokenizer=ShoutTokenizer(),
+                    model_overrides={"attn_impl": "dense"})
+    try:
+        out = eng.generate(prompt="hi", max_tokens=4, temperature=0.0)
+        assert len(out["token_ids"]) == 4
+        assert isinstance(out["text"], str)
+    finally:
+        eng.shutdown()
