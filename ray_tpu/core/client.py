@@ -72,6 +72,10 @@ class CoreClient:
                                         self._on_evicted_object)
         self._extra_handlers.setdefault("lease_revoke",
                                         self._on_lease_revoke_msg)
+        # cooperative stack dump (the reference dashboard's py-spy
+        # reporter, without needing ptrace): every process answers with
+        # the live stacks of all its threads
+        self._extra_handlers.setdefault("dump_stacks", self._on_dump_stacks)
         if is_driver:
             # streamed worker-log lines (task/actor prints) land at the
             # submitting terminal by default (reference print_logs)
@@ -150,6 +154,19 @@ class CoreClient:
             except Exception:
                 pass
         return True
+
+    async def _on_dump_stacks(self):
+        """Formatted stacks of every thread in this process (reference:
+        dashboard reporter's py-spy dump, done cooperatively)."""
+        import traceback
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in frames.items():
+            out.append(f"--- thread {names.get(ident, '?')} ({ident})")
+            out.extend(l.rstrip() for l in traceback.format_stack(frame))
+        return "\n".join(out)
 
     async def _on_log_lines(self, entries):
         """Head-streamed worker log lines: print at this driver."""

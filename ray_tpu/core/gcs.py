@@ -780,6 +780,55 @@ class Head:
             """The head's full flag table (ray-tpu config CLI, dashboard)."""
             return _config.GLOBAL.dump()
 
+        async def reporter_stats():
+            """Per-process stats for every registered worker (reference
+            dashboard reporter module): RSS/CPU/threads from /proc."""
+            page = os.sysconf("SC_PAGE_SIZE")
+            tick = os.sysconf("SC_CLK_TCK")
+            rows = []
+            for w in self.workers.values():
+                row = {"worker_id": w.worker_id.hex(), "pid": w.pid,
+                       "is_driver": w.is_driver,
+                       "node_id": w.node_id.hex(),
+                       "actor": w.actor_id.hex() if w.actor_id else None,
+                       "log_tag": getattr(w, "log_tag", None)}
+                if w.node_id != self.node_id:
+                    # remote pid: /proc here would be a STRANGER's process
+                    row["alive"] = w.conn is not None and not w.conn.closed
+                    row["remote"] = True
+                    rows.append(row)
+                    continue
+                try:
+                    with open(f"/proc/{w.pid}/stat") as f:
+                        parts = f.read().rsplit(") ", 1)[1].split()
+                    # fields after comm: state utime=11 stime=12 (0-based
+                    # within this tail), num_threads=17, rss=21
+                    row["cpu_seconds"] = round(
+                        (int(parts[11]) + int(parts[12])) / tick, 2)
+                    row["num_threads"] = int(parts[17])
+                    row["rss_bytes"] = int(parts[21]) * page
+                    row["alive"] = True
+                except (OSError, IndexError, ValueError):
+                    row["alive"] = False  # remote node or exited
+                rows.append(row)
+            return rows
+
+        async def worker_stacks(worker_id):
+            """Live thread stacks of one worker (cooperative py-spy)."""
+            w = self.workers.get(WorkerID(worker_id))
+            if w is None or w.conn is None or w.conn.closed:
+                return None
+            try:
+                # bounded: a GIL-wedged worker (the exact case being
+                # debugged) can't run its handler — report unreachable
+                # instead of hanging the CLI/dashboard
+                return await asyncio.wait_for(
+                    w.conn.request("dump_stacks"), timeout=10.0)
+            except asyncio.TimeoutError:
+                return ("<worker did not respond within 10s — event loop "
+                        "wedged (GIL-holding C call?); use kernel-level "
+                        "tools for a non-cooperative dump>")
+
         async def log_batch(entries):
             """Tailed lines pushed by a node daemon's LogMonitor."""
             self._on_log_batch(entries)

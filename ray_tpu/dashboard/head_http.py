@@ -158,6 +158,25 @@ def build_app(head) -> web.Application:
 
         return _json(cfg.dump())
 
+    async def reporter(_req):
+        handlers = head._handlers({})
+        return _json(await handlers["reporter_stats"]())
+
+    async def reporter_stacks(req):
+        handlers = head._handlers({})
+        try:
+            wid = bytes.fromhex(req.match_info["worker_id"])
+        except ValueError:
+            raise web.HTTPNotFound()
+        if len(wid) != 16:
+            raise web.HTTPNotFound()
+        text = await handlers["worker_stacks"](worker_id=wid)
+        if text is None:
+            raise web.HTTPNotFound()
+        return web.Response(text=text, content_type="text/plain")
+
+    app.router.add_get("/api/reporter", reporter)
+    app.router.add_get("/api/reporter/stacks/{worker_id}", reporter_stacks)
     app.router.add_get("/api/config", config_dump)
     app.router.add_get("/api/logs", logs_list)
     app.router.add_get("/api/logs/{filename}", log_get)

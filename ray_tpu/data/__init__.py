@@ -3,13 +3,15 @@ SURVEY §2.6) — lazy plans, fused per-block tasks, bounded-window streaming.""
 
 from ray_tpu.data.block import Block
 from ray_tpu.data.dataset import Dataset, GroupedData
-from ray_tpu.data.read_api import (from_items, from_numpy, from_pandas, range,
-                                   read_binary_files, read_csv, read_json,
-                                   read_images, read_numpy, read_parquet,
-                                   read_text)
+from ray_tpu.data.read_api import (from_arrow, from_huggingface, from_items,
+                                   from_numpy, from_pandas, from_torch, range,
+                                   read_binary_files, read_csv, read_images,
+                                   read_json, read_numpy, read_parquet,
+                                   read_sql, read_text, read_tfrecords)
 
 __all__ = [
     "Block", "Dataset", "GroupedData", "range", "from_items", "from_numpy",
     "from_pandas", "read_parquet", "read_csv", "read_json", "read_text",
-    "read_binary_files", "read_numpy", "read_images",
+    "read_binary_files", "read_numpy", "read_images", "read_tfrecords",
+    "read_sql", "from_arrow", "from_torch", "from_huggingface",
 ]

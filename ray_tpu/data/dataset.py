@@ -595,6 +595,54 @@ class Dataset:
             table = block_to_batch(block, "pyarrow")
             pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
 
+    def write_csv(self, path: str) -> None:
+        """One CSV file per block (reference `Dataset.write_csv`)."""
+        import csv
+        import os as _os
+
+        _os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._stream_blocks()):
+            cols = to_numpy_columns(block)
+            out = _os.path.join(path, f"part-{i:05d}.csv")
+            with open(out, "w", newline="") as f:
+                if isinstance(cols, dict):
+                    w = csv.writer(f)
+                    keys = list(cols)
+                    w.writerow(keys)
+                    for row in zip(*(cols[k] for k in keys)):
+                        w.writerow(row)
+                elif cols and all(isinstance(r, dict) for r in cols):
+                    # row blocks of dicts get REAL columns, not reprs
+                    keys = sorted({k for r in cols for k in r})
+                    w = csv.DictWriter(f, fieldnames=keys)
+                    w.writeheader()
+                    w.writerows(cols)
+                else:
+                    w = csv.writer(f)
+                    w.writerow(["item"])
+                    for r in cols:
+                        w.writerow([r])
+
+    def write_json(self, path: str) -> None:
+        """One JSONL file per block (reference `Dataset.write_json`)."""
+        import json as _json
+        import os as _os
+
+        _os.makedirs(path, exist_ok=True)
+
+        def _py(v):
+            return v.item() if isinstance(v, np.generic) else v
+
+        for i, block in enumerate(self._stream_blocks()):
+            out = _os.path.join(path, f"part-{i:05d}.jsonl")
+            with open(out, "w") as f:
+                for row in rows_of(block):
+                    if isinstance(row, dict):
+                        row = {k: _py(v) for k, v in row.items()}
+                    else:
+                        row = {"item": _py(row)}
+                    f.write(_json.dumps(row, default=str) + "\n")
+
     def __repr__(self):
         return (f"Dataset(num_blocks={len(self._partitions)}, "
                 f"ops={[o.kind for o in self._ops]})")

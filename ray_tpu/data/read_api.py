@@ -172,3 +172,176 @@ def read_images(paths, *, size=None, mode: str = "RGB") -> Dataset:
         return [{"image": np.asarray(img), "path": path}]
 
     return Dataset([functools.partial(read_one, f) for f in files])
+
+
+def from_arrow(tables) -> Dataset:
+    """pyarrow Table(s) → Dataset, one block per table, zero-copy
+    (reference `ray.data.from_arrow`)."""
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    return Dataset([t for t in tables])
+
+
+def from_torch(torch_dataset, *, parallelism: int = 8) -> Dataset:
+    """A map-style torch Dataset → row Dataset (reference
+    `ray.data.from_torch`): items materialize lazily per partition."""
+    n = len(torch_dataset)
+    parallelism = max(1, min(parallelism, n or 1))
+    bounds = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def read_slice(lo, hi):
+        import builtins
+
+        # NB: this module defines ray-style `range(n)`, shadowing the builtin
+        return [{"item": torch_dataset[i]} for i in builtins.range(lo, hi)]
+
+    return Dataset([functools.partial(read_slice, int(lo), int(hi))
+                    for lo, hi in zip(bounds[:-1], bounds[1:])])
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = 8) -> Dataset:
+    """A `datasets.Dataset` → Dataset via its arrow table (reference
+    `ray.data.from_huggingface`). The datasets library is optional."""
+    if getattr(hf_dataset, "_indices", None) is not None:
+        # select()/shuffle()/filter() leave an indices mapping over the
+        # ORIGINAL table — materialize rows, or we'd return unselected data
+        rows = [dict(r) for r in hf_dataset]
+        return from_items(rows, parallelism=parallelism)
+    try:
+        table = hf_dataset.data.table      # arrow-backed: zero-copy
+    except AttributeError:
+        rows = [dict(r) for r in hf_dataset]
+        return from_items(rows, parallelism=parallelism)
+    import builtins
+
+    n = max(1, table.num_rows // max(parallelism, 1))
+    return Dataset([table.slice(i, n)
+                    for i in builtins.range(0, table.num_rows, n)])
+
+
+def read_sql(sql: str, connection_factory) -> Dataset:
+    """A SQL query → one read task over any DBAPI connection factory
+    (reference `ray.data.read_sql`). The factory runs INSIDE the read
+    task so connections are per-worker, never pickled."""
+
+    def read_all():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        if not rows:
+            return []
+        return {c: np.asarray([r[i] for r in rows])
+                for i, c in enumerate(cols)}
+
+    return Dataset([read_all])
+
+
+# ------------------------------------------------------------- tfrecords
+def _read_varint(buf: memoryview, pos: int):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _parse_tf_example(data: bytes) -> dict:
+    """Minimal pure-python tf.train.Example parser (wire format only —
+    no tensorflow/protobuf dependency; reference read_tfrecords has the
+    same no-TF fallback). Returns {feature: list|ndarray}."""
+    import struct
+
+    view = memoryview(data)
+
+    def parse_fields(buf, pos, end):
+        while pos < end:
+            tag, pos = _read_varint(buf, pos)
+            field, wire = tag >> 3, tag & 7
+            if wire == 2:
+                ln, pos = _read_varint(buf, pos)
+                yield field, buf[pos:pos + ln], pos
+                pos += ln
+            elif wire == 0:
+                v, pos = _read_varint(buf, pos)
+                yield field, v, pos
+            elif wire == 5:
+                yield field, buf[pos:pos + 4], pos
+                pos += 4
+            elif wire == 1:
+                yield field, buf[pos:pos + 8], pos
+                pos += 8
+            else:
+                raise ValueError(f"unsupported wire type {wire}")
+
+    out: dict = {}
+    # Example{1: Features{1: map<string, Feature>}}
+    for f, features_buf, _ in parse_fields(view, 0, len(view)):
+        if f != 1:
+            continue
+        for f2, entry, _ in parse_fields(features_buf, 0, len(features_buf)):
+            if f2 != 1:
+                continue
+            name, value = None, None
+            for f3, v, _ in parse_fields(entry, 0, len(entry)):
+                if f3 == 1:
+                    name = bytes(v).decode()
+                elif f3 == 2:
+                    # Feature{1: BytesList, 2: FloatList, 3: Int64List}
+                    for f4, lst, _ in parse_fields(v, 0, len(v)):
+                        if f4 == 1:      # bytes_list{1: repeated bytes}
+                            value = [bytes(b) for f5, b, _ in
+                                     parse_fields(lst, 0, len(lst))
+                                     if f5 == 1]
+                        elif f4 == 2:    # float_list{1: packed floats}
+                            packed = b"".join(
+                                bytes(b) for f5, b, _ in
+                                parse_fields(lst, 0, len(lst)) if f5 == 1)
+                            value = np.frombuffer(packed, dtype="<f4")
+                        elif f4 == 3:    # int64_list{1: varints (packed)}
+                            vals = []
+                            for f5, b, _ in parse_fields(lst, 0, len(lst)):
+                                if f5 != 1:
+                                    continue
+                                if isinstance(b, int):
+                                    vals.append(b)
+                                else:
+                                    p = 0
+                                    while p < len(b):
+                                        x, p = _read_varint(b, p)
+                                        vals.append(x)
+                            value = np.asarray(vals, dtype=np.int64)
+            if name is not None:
+                out[name] = value
+    return out
+
+
+def read_tfrecords(paths) -> Dataset:
+    """TFRecord files of tf.train.Example → feature-dict rows
+    (reference `ray.data.read_tfrecords`), parsed with a built-in wire
+    parser — no tensorflow required."""
+    import struct
+
+    files = _expand_paths(paths)
+
+    def read_one(path):
+        rows = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(12)
+                if len(header) < 12:
+                    break
+                (length,) = struct.unpack("<Q", header[:8])
+                data = f.read(length)
+                f.read(4)  # data crc
+                rows.append(_parse_tf_example(data))
+        return rows
+
+    return Dataset([functools.partial(read_one, f) for f in files])

@@ -224,6 +224,24 @@ def cmd_job(args) -> None:
         print("stopped" if client.stop_job(args.job_id) else "not running")
 
 
+def cmd_stack(args) -> None:
+    """Live thread stacks of cluster processes (reference `ray stack`,
+    done cooperatively instead of via py-spy/ptrace)."""
+    client = _connect(args)
+    rows = client.head_request("list_state", kind="workers")
+    if args.worker:
+        rows = [w for w in rows if w["worker_id"].startswith(args.worker)]
+        if not rows:
+            sys.exit(f"no worker with id prefix {args.worker!r}")
+    for w in rows:
+        print(f"===== worker {w['worker_id'][:12]} pid={w['pid']} "
+              f"{'driver' if w['is_driver'] else 'worker'}"
+              f"{' actor=' + w['actor'][:12] if w.get('actor') else ''}")
+        text = client.head_request("worker_stacks",
+                                   worker_id=bytes.fromhex(w["worker_id"]))
+        print(text or "<unreachable>")
+
+
 def cmd_config(args) -> None:
     """The running head's full flag table (reference `ray_config_def.h`
     introspection): value, default, and where each value came from."""
@@ -369,6 +387,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("stack", help="dump live thread stacks of workers")
+    sp.add_argument("--worker", default=None, help="worker id hex prefix")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("config", help="show the cluster's config flags")
     sp.add_argument("--address", default=None)

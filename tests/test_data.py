@@ -321,3 +321,97 @@ def test_adaptive_streaming_window(cluster, monkeypatch):
         lambda b: {"x": np.zeros((len(b["id"]), 1 << 17), np.float64)})
     list(big._stream_blocks())
     assert big._last_window == ds_mod.MIN_WINDOW  # budget-bound: shrink
+
+
+def _encode_tf_example(features: dict) -> bytes:
+    """Independent tf.train.Example ENCODER (test-side, so the reader is
+    not checked against itself): standard protobuf wire format."""
+    def varint(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(field, payload):  # length-delimited
+        return varint((field << 3) | 2) + varint(len(payload)) + payload
+
+    feats = b""
+    for name, value in features.items():
+        if isinstance(value, bytes):
+            flist = ld(1, ld(1, value))                      # BytesList
+        elif isinstance(value, list) and value and isinstance(value[0], float):
+            import struct
+
+            packed = b"".join(struct.pack("<f", v) for v in value)
+            flist = ld(2, ld(1, packed))                     # FloatList
+        else:
+            packed = b"".join(varint(v) for v in value)
+            flist = ld(3, ld(1, packed))                     # Int64List
+        entry = ld(1, name.encode()) + ld(2, flist)
+        feats += ld(1, entry)
+    return ld(1, feats)  # Example{1: Features}
+
+
+def test_read_tfrecords(cluster, tmp_path):
+    import struct
+
+    path = tmp_path / "data.tfrecord"
+    with open(path, "wb") as f:
+        for i in range(3):
+            ex = _encode_tf_example({
+                "label": [i],
+                "weights": [0.5 * i, 1.5],
+                "name": f"row{i}".encode(),
+            })
+            f.write(struct.pack("<Q", len(ex)) + b"\x00" * 4
+                    + ex + b"\x00" * 4)
+    rows = rdata.read_tfrecords(str(path)).take_all()
+    assert len(rows) == 3
+    assert list(rows[1]["label"]) == [1]
+    np.testing.assert_allclose(rows[2]["weights"], [1.0, 1.5])
+    assert rows[0]["name"] == [b"row0"]
+
+
+def test_read_sql(cluster, tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE users (id INTEGER, score REAL)")
+    conn.executemany("INSERT INTO users VALUES (?, ?)",
+                     [(i, i * 0.5) for i in range(10)])
+    conn.commit()
+    conn.close()
+    ds = rdata.read_sql("SELECT id, score FROM users WHERE id >= 4",
+                        lambda: sqlite3.connect(db))
+    rows = ds.take_all()
+    assert len(rows) == 6
+    assert sorted(r["id"] for r in rows) == list(range(4, 10))
+
+
+def test_from_arrow_and_torch(cluster):
+    import pyarrow as pa
+    import torch
+    from torch.utils.data import TensorDataset
+
+    t = pa.table({"a": [1, 2, 3]})
+    assert rdata.from_arrow(t).count() == 3
+    td = TensorDataset(torch.arange(6))
+    rows = rdata.from_torch(td, parallelism=2).take_all()
+    assert len(rows) == 6
+    assert int(rows[5]["item"][0]) == 5
+
+
+def test_write_csv_json_roundtrip(cluster, tmp_path):
+    ds = rdata.range(10, parallelism=2).map_batches(
+        lambda b: {"id": b["id"], "x": b["id"] * 2.0})
+    ds.write_csv(str(tmp_path / "csv"))
+    back = rdata.read_csv(str(tmp_path / "csv"))
+    assert back.count() == 10
+    ds.write_json(str(tmp_path / "json"))
+    back = rdata.read_json(str(tmp_path / "json"))
+    rows = back.take_all()
+    assert len(rows) == 10 and rows[0]["x"] == rows[0]["id"] * 2.0

@@ -162,3 +162,39 @@ def test_timeline(cluster, tmp_path):
     complete = [e for e in events if e["ph"] == "X"]
     assert complete and all(e["dur"] > 0 for e in complete)
     assert json.load(open(out))
+
+
+def test_reporter_stats_and_stacks(cluster):
+    """Dashboard reporter analog (reference dashboard/modules/reporter):
+    per-process RSS/CPU/thread stats + cooperative py-spy stack dumps."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Busy:
+        def spin_marker_method(self, t):
+            time.sleep(t)
+            return 1
+
+    a = Busy.remote()
+    ray_tpu.get(a.spin_marker_method.remote(0.0), timeout=60)
+    from ray_tpu.core.api import _global_client
+
+    c = _global_client()
+    rows = c.head_request("reporter_stats")
+    live = [r for r in rows if r["alive"] and not r["is_driver"]]
+    assert live, rows
+    assert all(r["rss_bytes"] > 1 << 20 for r in live)   # real RSS
+    assert all(r["num_threads"] >= 1 for r in live)
+
+    # stack dump of the actor's worker while a method sleeps shows the
+    # method frame (the py-spy use case: where is this worker stuck?)
+    ref = a.spin_marker_method.remote(3.0)
+    time.sleep(0.5)
+    actor_row = next(r for r in rows if r["actor"])
+    text = c.head_request("worker_stacks",
+                          worker_id=bytes.fromhex(actor_row["worker_id"]))
+    assert text and "spin_marker_method" in text, text[:500]
+    assert ray_tpu.get(ref, timeout=60) == 1
+    ray_tpu.kill(a)
