@@ -631,7 +631,11 @@ class Dataset:
         _os.makedirs(path, exist_ok=True)
 
         def _py(v):
-            return v.item() if isinstance(v, np.generic) else v
+            if isinstance(v, np.generic):
+                return v.item()
+            if isinstance(v, np.ndarray):
+                return v.tolist()  # json-parseable, not a numpy repr
+            return v
 
         for i, block in enumerate(self._stream_blocks()):
             out = _os.path.join(path, f"part-{i:05d}.jsonl")

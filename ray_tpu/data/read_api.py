@@ -310,13 +310,19 @@ def _parse_tf_example(data: bytes) -> dict:
                             for f5, b, _ in parse_fields(lst, 0, len(lst)):
                                 if f5 != 1:
                                     continue
+                                def _signed(v):
+                                    # protobuf int64: negatives ride as
+                                    # 10-byte two's-complement varints
+                                    return v - (1 << 64) if v >= (1 << 63) \
+                                        else v
+
                                 if isinstance(b, int):
-                                    vals.append(b)
+                                    vals.append(_signed(b))
                                 else:
                                     p = 0
                                     while p < len(b):
                                         x, p = _read_varint(b, p)
-                                        vals.append(x)
+                                        vals.append(_signed(x))
                             value = np.asarray(vals, dtype=np.int64)
             if name is not None:
                 out[name] = value

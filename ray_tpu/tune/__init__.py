@@ -4,7 +4,7 @@ train run, matching the reference's Trainable/Train unification in v2)."""
 
 from ray_tpu.train.session import get_context, report  # noqa: F401
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
-                                     MedianStoppingRule,
+                                     MedianStoppingRule, PB2,
                                      PopulationBasedTraining)
 from ray_tpu.tune.search import (BasicVariantGenerator, choice, grid_search,
                                  loguniform, randint, uniform)
@@ -24,6 +24,6 @@ __all__ = [
     "report", "get_checkpoint", "get_context",
     "choice", "uniform", "loguniform", "randint", "grid_search",
     "BasicVariantGenerator", "FIFOScheduler", "ASHAScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "MedianStoppingRule", "PopulationBasedTraining", "PB2",
     "Searcher", "RandomSearcher", "OptunaSearch", "HyperOptSearch",
 ]

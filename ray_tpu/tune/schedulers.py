@@ -156,3 +156,112 @@ class PopulationBasedTraining(FIFOScheduler):
             elif key in cfg and isinstance(cfg[key], (int, float)):
                 cfg[key] = cfg[key] * self.rng.choice([0.8, 1.2])
         return cfg
+
+
+class PB2(PopulationBasedTraining):
+    """PB2: PBT whose exploit step picks new hyperparameters with a
+    GP-bandit (UCB) over observed (config -> score improvement) data,
+    instead of random perturbation. Parity: `python/ray/tune/schedulers/
+    pb2.py` (Parker-Holder et al., NeurIPS 2020) — re-implemented on a
+    small numpy Gaussian process (RBF kernel), no GPy dependency.
+
+    `hyperparam_bounds` maps each tuned key to (low, high); values are
+    optimized in normalized [0,1]^d space. Categorical keys stay with
+    PBT-style resampling via `hyperparam_mutations`.
+    """
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 2,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: Optional[int] = None,
+                 time_attr: str = "training_iteration", ucb_kappa: float = 2.0):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=hyperparam_mutations,
+                         quantile_fraction=quantile_fraction, seed=seed,
+                         time_attr=time_attr)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in (hyperparam_bounds or {}).items()}
+        self.kappa = ucb_kappa
+        # observations: (normalized config vector, score delta since the
+        # trial's previous window) — what the GP models
+        self._obs_x: list = []
+        self._obs_y: list = []
+        self._prev_score: Dict[str, float] = {}
+        self._trial_cfg: Dict[str, Dict[str, Any]] = {}
+
+    # the controller tells us each trial's live config via on_result's
+    # carried config when available; fall back to donor config at exploit
+    def record_config(self, trial_id: str, config: Dict[str, Any]) -> None:
+        self._trial_cfg[trial_id] = dict(config)
+
+    def _normalize(self, cfg: Dict[str, Any]):
+        import numpy as _np
+
+        return _np.asarray([
+            ((float(cfg.get(k, lo)) - lo) / (hi - lo) if hi > lo else 0.0)
+            for k, (lo, hi) in sorted(self.bounds.items())], dtype=float)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]):
+        cfg = result.get("config")
+        if isinstance(cfg, dict):
+            self.record_config(trial_id, cfg)
+        score = result.get(self.metric)
+        if score is not None and trial_id in self._trial_cfg and self.bounds:
+            sign = -1.0 if self.mode == "min" else 1.0
+            s = sign * float(score)
+            prev = self._prev_score.get(trial_id)
+            if prev is not None:
+                self._obs_x.append(self._normalize(self._trial_cfg[trial_id]))
+                self._obs_y.append(s - prev)
+            self._prev_score[trial_id] = s
+        decision = super().on_result(trial_id, result)
+        if isinstance(decision, tuple) and decision[0] == "EXPLOIT":
+            # the exploited trial restarts from the DONOR's checkpoint:
+            # its next score jump is inherited, not earned by the freshly
+            # GP-picked config — never feed it to the GP as improvement
+            self._prev_score.pop(trial_id, None)
+            self._trial_cfg.pop(trial_id, None)
+        return decision
+
+    # ------------------------------------------------------------- GP-UCB
+    def _gp_ucb_pick(self):
+        """Maximize UCB of predicted score-improvement over [0,1]^d via
+        random candidate search (d is small for hyperparams)."""
+        import numpy as _np
+
+        d = len(self.bounds)
+        rng = _np.random.default_rng(self.rng.randrange(1 << 30))
+        cands = rng.random((256, d))
+        if len(self._obs_y) < 3:
+            return cands[0]
+        X = _np.stack(self._obs_x[-64:])
+        y = _np.asarray(self._obs_y[-64:], dtype=float)
+        y_std = y.std() or 1.0
+        y = (y - y.mean()) / y_std
+        ls, noise = 0.3, 1e-3
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return _np.exp(-d2 / (2 * ls * ls))
+
+        K = k(X, X) + noise * _np.eye(len(X))
+        Kinv = _np.linalg.inv(K)
+        Ks = k(cands, X)
+        mu = Ks @ Kinv @ y
+        var = _np.clip(1.0 - (Ks @ Kinv * Ks).sum(-1), 1e-9, None)
+        ucb = mu + self.kappa * _np.sqrt(var)
+        return cands[int(_np.argmax(ucb))]
+
+    def _mutate(self, donor_config: Dict[str, Any]) -> Dict[str, Any]:
+        # categorical keys resample PBT-style (hyperparam_mutations);
+        # continuous bounded keys come from the GP-UCB pick
+        cfg = super()._mutate(donor_config) if self.mutations \
+            else dict(donor_config)
+        if not self.bounds:
+            return cfg
+        z = self._gp_ucb_pick()
+        for i, (key, (lo, hi)) in enumerate(sorted(self.bounds.items())):
+            cfg[key] = lo + float(z[i]) * (hi - lo)
+        return cfg

@@ -217,7 +217,10 @@ class Tuner:
                             searcher.on_trial_result(t.id, metrics)
                         except Exception:
                             pass
-                    d = scheduler.on_result(t.id, metrics)
+                    # schedulers see the live config too (PB2's GP models
+                    # config -> score improvement); user metrics stay clean
+                    d = scheduler.on_result(t.id,
+                                            {**metrics, "config": t.config})
                     if d != sched_lib.CONTINUE:
                         decision = d
                 if st["error"]:

@@ -348,7 +348,7 @@ def _encode_tf_example(features: dict) -> bytes:
             packed = b"".join(struct.pack("<f", v) for v in value)
             flist = ld(2, ld(1, packed))                     # FloatList
         else:
-            packed = b"".join(varint(v) for v in value)
+            packed = b"".join(varint(v & ((1 << 64) - 1)) for v in value)
             flist = ld(3, ld(1, packed))                     # Int64List
         entry = ld(1, name.encode()) + ld(2, flist)
         feats += ld(1, entry)
@@ -362,7 +362,7 @@ def test_read_tfrecords(cluster, tmp_path):
     with open(path, "wb") as f:
         for i in range(3):
             ex = _encode_tf_example({
-                "label": [i],
+                "label": [i - 1],  # includes -1: negative int64 wire case
                 "weights": [0.5 * i, 1.5],
                 "name": f"row{i}".encode(),
             })
@@ -370,7 +370,8 @@ def test_read_tfrecords(cluster, tmp_path):
                     + ex + b"\x00" * 4)
     rows = rdata.read_tfrecords(str(path)).take_all()
     assert len(rows) == 3
-    assert list(rows[1]["label"]) == [1]
+    assert list(rows[1]["label"]) == [0]
+    assert list(rows[0]["label"]) == [-1]  # two's-complement decode
     np.testing.assert_allclose(rows[2]["weights"], [1.0, 1.5])
     assert rows[0]["name"] == [b"row0"]
 

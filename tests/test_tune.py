@@ -199,3 +199,34 @@ def test_searcher_finished_and_grid_rejected(cluster, tmp_path):
                                    search_alg=tune.RandomSearcher(seed=0)),
             run_config=RunConfig(name="bad", storage_path=str(tmp_path)),
         ).fit()
+
+
+def test_pb2_exploits_with_gp(cluster, tmp_path):
+    """PB2 (reference schedulers/pb2.py): exploit configs come from a
+    GP-UCB over observed improvements and always stay inside
+    hyperparam_bounds — bad trials converge toward the good region."""
+
+    def objective(config):
+        import time as _t
+
+        for _ in range(12):
+            _t.sleep(0.03)
+            # quality peaks at lr ~ 0.5 within [0, 1]
+            tune.report({"score": 1.0 - (config["lr"] - 0.5) ** 2})
+
+    sched = tune.PB2(metric="score", mode="max", perturbation_interval=2,
+                     hyperparam_bounds={"lr": (0.0, 1.0)}, seed=0)
+    tuner = Tuner(
+        objective,
+        param_space={"lr": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=4,
+                               max_concurrent_trials=4, scheduler=sched),
+        run_config=RunConfig(name="pb2", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert 0.0 <= best.config["lr"] <= 1.0   # bounds respected
+    assert best.metrics["score"] > 0.6
+    # the GP actually accumulated observations across trials
+    assert len(sched._obs_y) >= 4
