@@ -253,6 +253,11 @@ def _read_varint(buf: memoryview, pos: int):
         shift += 7
 
 
+def _signed_int64(v: int) -> int:
+    """protobuf int64: negatives ride as 10-byte two's-complement varints."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 def _parse_tf_example(data: bytes) -> dict:
     """Minimal pure-python tf.train.Example parser (wire format only —
     no tensorflow/protobuf dependency; reference read_tfrecords has the
@@ -310,19 +315,13 @@ def _parse_tf_example(data: bytes) -> dict:
                             for f5, b, _ in parse_fields(lst, 0, len(lst)):
                                 if f5 != 1:
                                     continue
-                                def _signed(v):
-                                    # protobuf int64: negatives ride as
-                                    # 10-byte two's-complement varints
-                                    return v - (1 << 64) if v >= (1 << 63) \
-                                        else v
-
                                 if isinstance(b, int):
-                                    vals.append(_signed(b))
+                                    vals.append(_signed_int64(b))
                                 else:
                                     p = 0
                                     while p < len(b):
                                         x, p = _read_varint(b, p)
-                                        vals.append(_signed(x))
+                                        vals.append(_signed_int64(x))
                             value = np.asarray(vals, dtype=np.int64)
             if name is not None:
                 out[name] = value

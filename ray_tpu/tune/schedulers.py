@@ -211,11 +211,17 @@ class PB2(PopulationBasedTraining):
         if score is not None and trial_id in self._trial_cfg and self.bounds:
             sign = -1.0 if self.mode == "min" else 1.0
             s = sign * float(score)
+            import math
+
             prev = self._prev_score.get(trial_id)
-            if prev is not None:
+            if prev is not None and math.isfinite(s - prev):
+                # one diverged trial's nan would poison every UCB pick
                 self._obs_x.append(self._normalize(self._trial_cfg[trial_id]))
                 self._obs_y.append(s - prev)
-            self._prev_score[trial_id] = s
+                if len(self._obs_y) > 256:   # GP only reads the tail
+                    del self._obs_x[:-128], self._obs_y[:-128]
+            if math.isfinite(s):
+                self._prev_score[trial_id] = s
         decision = super().on_result(trial_id, result)
         if isinstance(decision, tuple) and decision[0] == "EXPLOIT":
             # the exploited trial restarts from the DONOR's checkpoint:

@@ -218,11 +218,16 @@ class Tuner:
                         except Exception:
                             pass
                     # schedulers see the live config too (PB2's GP models
-                    # config -> score improvement); user metrics stay clean
-                    d = scheduler.on_result(t.id,
-                                            {**metrics, "config": t.config})
-                    if d != sched_lib.CONTINUE:
-                        decision = d
+                    # config -> score improvement); user metrics stay clean.
+                    # Once a batch produced a decision, trailing reports
+                    # are NOT fed onward: the trial is about to stop or
+                    # restart, and PB2's exploit cleanup must not be
+                    # undone by stale same-batch reports.
+                    if decision == sched_lib.CONTINUE:
+                        d = scheduler.on_result(
+                            t.id, {**metrics, "config": t.config})
+                        if d != sched_lib.CONTINUE:
+                            decision = d
                 if st["error"]:
                     t.state = "ERRORED"
                     t.error = st["error"]

@@ -120,3 +120,35 @@ def test_node_death_actor_restart(cluster):
     b = Pin.options(num_cpus=1, max_restarts=2).remote()
     n1 = ray_tpu.get(b.node.remote(), timeout=60)
     assert n1 != victim
+
+
+def test_node_daemon_worker_logs_stream_to_head(cluster):
+    """Workers spawned by NODE DAEMONS (not the head) get fd-level log
+    capture in the node's subdir; the daemon's LogMonitor pushes lines
+    to the head (log_batch) so get_log works cluster-wide — the
+    multi-host half of the worker-log pipeline."""
+    import os as _os
+    import time as _time
+
+    marker = f"nodelog-marker-{_os.getpid()}"
+
+    @ray_tpu.remote(label_selector={"zone": "a"})
+    def speak():
+        print(marker, flush=True)
+        return 1
+
+    assert ray_tpu.get(speak.remote(), timeout=60) == 1
+    from ray_tpu.core.api import _global_client
+
+    cl = _global_client()
+    deadline = _time.monotonic() + 20
+    while _time.monotonic() < deadline:
+        hit = [row["file"] for row in cl.head_request("list_logs")
+               if row["file"].endswith(".out")
+               and any(marker in ln for ln in
+                       cl.head_request("get_log",
+                                       filename=row["file"]) or [])]
+        if hit:
+            return
+        _time.sleep(0.25)
+    raise AssertionError("node-daemon worker's print never reached the head")
