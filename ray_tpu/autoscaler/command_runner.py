@@ -56,8 +56,22 @@ class LocalCommandRunner(CommandRunner):
         return proc.returncode, proc.stdout + proc.stderr
 
     def rsync_up(self, source: str, target: str) -> None:
-        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
-        subprocess.run(["rsync", "-a", source, target], check=True)
+        import shutil
+
+        target_dir = (target.rstrip("/") if target.endswith("/")
+                      else os.path.dirname(target)) or "."
+        os.makedirs(target_dir, exist_ok=True)
+        if shutil.which("rsync"):
+            subprocess.run(["rsync", "-a", source, target], check=True)
+        elif os.path.isdir(source):
+            # minimal-image fallback: same trailing-slash semantics as
+            # rsync -a (src/ copies CONTENTS, src copies the directory);
+            # symlinks preserved as links, dangling ones included
+            dst = target if source.endswith("/") else os.path.join(
+                target, os.path.basename(source.rstrip("/")))
+            shutil.copytree(source, dst, dirs_exist_ok=True, symlinks=True)
+        else:
+            shutil.copy2(source, target)
 
     rsync_down = rsync_up
 

@@ -126,8 +126,12 @@ class PopulationBasedTraining(FIFOScheduler):
         self.counts: Dict[str, int] = defaultdict(int)
 
     def on_result(self, trial_id: str, result: Dict[str, Any]):
+        import math
+
         score = result.get(self.metric)
-        if score is None:
+        if score is None or not math.isfinite(float(score)):
+            # a diverged trial's nan would give it an arbitrary rank —
+            # possibly top-quantile, exploiting healthy trials onto it
             return CONTINUE
         sign = -1.0 if self.mode == "min" else 1.0
         self.latest[trial_id] = sign * float(score)

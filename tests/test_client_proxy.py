@@ -196,3 +196,49 @@ def test_proxy_session_cleanup_on_disconnect(cluster):
             return
         time.sleep(0.5)
     raise AssertionError(f"proxy drivers lingered: {drivers}")
+
+
+def test_two_concurrent_remote_drivers_are_isolated(cluster, tmp_path):
+    """Each remote client gets its OWN server-side driver process
+    (reference proxier model): two simultaneous drivers submit work
+    under the same proxy port without sharing refs or state."""
+    port = _proxy_port()
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import ray_tpu
+
+        tag = sys.argv[1]
+        ray_tpu.init(address="ray-tpu://127.0.0.1:{port}")
+
+        @ray_tpu.remote
+        def work(x):
+            return f"{{x}}-done"
+
+        @ray_tpu.remote
+        class Holder:
+            def __init__(self, t):
+                self.t = t
+
+            def get(self):
+                return self.t
+
+        h = Holder.options(name=f"holder-{{tag}}").remote(tag)
+        outs = ray_tpu.get([work.remote(f"{{tag}}-{{i}}") for i in range(8)])
+        assert outs == [f"{{tag}}-{{i}}-done" for i in range(8)], outs
+        assert ray_tpu.get(h.get.remote()) == tag
+        # the OTHER driver's named actor is visible cluster-wide (shared
+        # control plane), but this driver's objects are its own
+        print(f"DRIVER-{{tag}}-OK")
+        ray_tpu.shutdown()
+    """)
+    p = tmp_path / "cdrv.py"
+    p.write_text(script)
+    env = dict(os.environ)
+    env.pop("RAY_TPU_ADDRESS", None)
+    procs = [subprocess.Popen([sys.executable, str(p), t],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              text=True, env=env) for t in ("alpha", "beta")]
+    outs = [pr.communicate(timeout=300) for pr in procs]
+    for (stdout, stderr), tag in zip(outs, ("alpha", "beta")):
+        assert f"DRIVER-{tag}-OK" in stdout, stderr[-1500:]

@@ -196,3 +196,28 @@ def test_ssh_node_provider_pool(monkeypatch):
     assert len(prov.non_terminated_nodes()) == 2
     prov.shutdown()
     assert prov.non_terminated_nodes() == []
+
+
+def test_local_runner_rsync_and_launcher_rsync(tmp_path, monkeypatch):
+    """rsync file movement through the runner seam (reference `ray
+    rsync-up/down`): real rsync for the local runner, plus the
+    launcher-level helper resolving the head from cluster state."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.txt").write_text("payload-a")
+    dst = tmp_path / "dst"
+    r = LocalCommandRunner()
+    r.rsync_up(str(src) + "/", str(dst) + "/")
+    assert (dst / "a.txt").read_text() == "payload-a"
+
+    # launcher.rsync resolves the head node's runner from saved state
+    launcher._save_state("rsynctest", {
+        "cluster_name": "rsynctest", "head": {"host": "localhost"},
+        "workers": [], "auth": {}, "address": "x"})
+    try:
+        dst2 = tmp_path / "dst2"
+        launcher.rsync("rsynctest", str(src) + "/", str(dst2) + "/",
+                       up_=True)
+        assert (dst2 / "a.txt").read_text() == "payload-a"
+    finally:
+        os.unlink(launcher._state_path("rsynctest"))
