@@ -71,6 +71,9 @@ class _Request:
         self.generated: List[int] = []
         self.done = threading.Event()
         self.error: Optional[str] = None
+        self.finish_reason: str = "stop"
+        # streaming consumers: wakes on every appended token batch
+        self.progress = threading.Condition()
 
 
 class LLMEngine:
@@ -114,6 +117,7 @@ class LLMEngine:
         self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._streams: Dict[str, tuple] = {}   # sid -> (request, last_access)
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._slot_pos = [0] * max_batch
         self._slot_prefill: List[List[int]] = [[] for _ in range(max_batch)]
@@ -128,12 +132,9 @@ class LLMEngine:
                  max_tokens: int = 16, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0,
                  timeout: float = 120.0) -> Dict[str, Any]:
-        ids = prompt_ids if prompt_ids is not None else self.tokenizer.encode(prompt)
-        ids = ids or [self.tokenizer.eos_id]
-        ids = ids[-(self.max_seq_len - 2):]  # keep room to generate
-        budget = self.max_seq_len - len(ids) - 1
-        req = _Request(ids, max(0, min(max_tokens, budget)), temperature,
-                       top_k=top_k, top_p=top_p)
+        req = self._make_request(prompt, prompt_ids, max_tokens,
+                                 temperature, top_k, top_p)
+        ids = req.prompt_ids
         self._queue.put(req)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
@@ -143,6 +144,73 @@ class LLMEngine:
                 "text": self.tokenizer.decode(req.generated),
                 "prompt_tokens": len(ids),
                 "completion_tokens": len(req.generated)}
+
+    # ----------------------------------------------------------- streaming
+    def _make_request(self, prompt, prompt_ids, max_tokens, temperature,
+                      top_k, top_p) -> "_Request":
+        ids = prompt_ids if prompt_ids is not None else \
+            self.tokenizer.encode(prompt)
+        ids = ids or [self.tokenizer.eos_id]
+        ids = ids[-(self.max_seq_len - 2):]
+        budget = self.max_seq_len - len(ids) - 1
+        return _Request(ids, max(0, min(max_tokens, budget)), temperature,
+                        top_k=top_k, top_p=top_p)
+
+    def start_stream(self, prompt: str = "",
+                     prompt_ids: Optional[List[int]] = None,
+                     max_tokens: int = 16, temperature: float = 0.0,
+                     top_k: int = 0, top_p: float = 1.0) -> str:
+        """Admit a request for incremental consumption via stream_next
+        (the engine path behind OpenAI `stream: true`)."""
+        import uuid
+
+        req = self._make_request(prompt, prompt_ids, max_tokens,
+                                 temperature, top_k, top_p)
+        sid = uuid.uuid4().hex
+        self._streams[sid] = (req, time.time())
+        self._queue.put(req)
+        return sid
+
+    def stream_next(self, stream_id: str, cursor: int = 0,
+                    timeout: float = 1.0) -> Dict[str, Any]:
+        """Tokens generated beyond `cursor`. Waits briefly (bounded: a
+        long block would pin a replica actor thread per queued stream
+        and starve health checks); an empty delta means "poll again".
+        `text` is the CUMULATIVE decode — a per-batch decode would split
+        multi-byte characters across chunk boundaries; consumers diff
+        against their previous cumulative text. The stream entry is
+        dropped once the consumer has read to the end."""
+        ent = self._streams.get(stream_id)
+        if ent is None:
+            raise KeyError(f"unknown stream {stream_id}")
+        req, _ = ent
+        self._streams[stream_id] = (req, time.time())
+        deadline = time.time() + timeout
+        with req.progress:
+            while (len(req.generated) <= cursor and not req.done.is_set()
+                   and req.error is None):
+                left = deadline - time.time()
+                if left <= 0:
+                    break
+                req.progress.wait(left)
+        if req.error:
+            self._streams.pop(stream_id, None)
+            return {"error": req.error, "done": True, "token_ids": [],
+                    "text": "", "cursor": cursor}
+        new = req.generated[cursor:]
+        done = req.done.is_set() and cursor + len(new) >= len(req.generated)
+        if done:
+            self._streams.pop(stream_id, None)
+        # expire abandoned streams (client vanished mid-stream): their
+        # requests run to completion, the entries must not accumulate
+        now = time.time()
+        for sid, (r, ts) in list(self._streams.items()):
+            if r.done.is_set() and now - ts > 300:
+                self._streams.pop(sid, None)
+        return {"token_ids": new,
+                "text": self.tokenizer.decode(req.generated[:cursor + len(new)]),
+                "done": done, "cursor": cursor + len(new),
+                "finish_reason": req.finish_reason if done else None}
 
     def shutdown(self):
         self._stop.set()
@@ -217,11 +285,16 @@ class LLMEngine:
                     nxt = int(np.argmax(logits[i]))
                 req.generated.append(nxt)
                 self.total_generated += 1
-                if (len(req.generated) >= req.max_tokens
-                        or nxt == self.tokenizer.eos_id
-                        or self._slot_pos[i] >= self.max_seq_len - 1):
+                finished = (len(req.generated) >= req.max_tokens
+                            or nxt == self.tokenizer.eos_id
+                            or self._slot_pos[i] >= self.max_seq_len - 1)
+                if finished:
+                    req.finish_reason = ("stop" if nxt == self.tokenizer.eos_id
+                                         else "length")
                     self._slots[i] = None
                     req.done.set()
+                with req.progress:
+                    req.progress.notify_all()
 
 
 class LLMServer:
@@ -250,6 +323,10 @@ class LLMServer:
                          "finish_reason": "length"}],
             "usage": {"completion_tokens": len(out["token_ids"])},
         }
+
+    def stream_next(self, stream_id: str, cursor: int = 0) -> dict:
+        """Incremental tokens for an SSE stream (proxy-driven pull)."""
+        return self.engine.stream_next(stream_id, cursor=cursor)
 
     def stats(self) -> dict:
         return {"total_generated": self.engine.total_generated,
@@ -281,10 +358,18 @@ class OpenAIServer(LLMServer):
         temperature = float(body.get("temperature", 1.0))
         top_p = float(body.get("top_p", 1.0))
         top_k = int(body.get("top_k", 0))
+        stream = bool(body.get("stream"))
         if path.endswith("/chat/completions"):
             msgs = body.get("messages", [])
             prompt = "".join(f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
                              for m in msgs) + "<|assistant|>"
+            if stream:
+                sid = self.engine.start_stream(
+                    prompt=prompt, max_tokens=max_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p)
+                return {"__sse_stream__": {"stream_id": sid,
+                                           "model": self.model_id,
+                                           "mode": "chat"}}
             out = self.engine.generate(prompt=prompt, max_tokens=max_tokens,
                                        temperature=temperature, top_k=top_k,
                                        top_p=top_p)
@@ -306,6 +391,14 @@ class OpenAIServer(LLMServer):
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
+        if stream:
+            sid = self.engine.start_stream(
+                prompt=prompt, prompt_ids=body.get("prompt_ids"),
+                max_tokens=max_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p)
+            return {"__sse_stream__": {"stream_id": sid,
+                                       "model": self.model_id,
+                                       "mode": "completion"}}
         out = self.engine.generate(prompt=prompt,
                                    prompt_ids=body.get("prompt_ids"),
                                    max_tokens=max_tokens,

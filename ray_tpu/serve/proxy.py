@@ -68,7 +68,8 @@ class _AsyncRouter:
         self._ts = now
 
     async def submit(self, method: str, args: tuple, kwargs: dict,
-                     model_id: Optional[str] = None):
+                     model_id: Optional[str] = None,
+                     with_tag: bool = False):
         await self._refresh()
         deadline = time.monotonic() + 30
         while not self._table:
@@ -89,9 +90,18 @@ class _AsyncRouter:
             a, b = random.sample(tags, 2)
             tag = (a if self._inflight.get(a, 0) <= self._inflight.get(b, 0)
                    else b)
+        result = await self.submit_on(tag, method, args, kwargs)
+        return (result, tag) if with_tag else result
+
+    async def submit_on(self, tag: str, method: str, args: tuple,
+                        kwargs: dict):
+        """Call a SPECIFIC replica — SSE streams must pull follow-up
+        chunks from the replica that owns the stream state."""
+        handle = self._table.get(tag)
+        if handle is None:
+            raise RuntimeError(f"replica {tag} is gone")
         self._inflight[tag] = self._inflight.get(tag, 0) + 1
         try:
-            handle = self._table[tag]
             # .remote() can block on the head for large payloads (object
             # registration); keep it off the event loop
             loop = asyncio.get_running_loop()
@@ -176,10 +186,14 @@ class ProxyActor:
                       dict(request.headers), body, json_body)
         model_id = request.headers.get("serve_multiplexed_model_id")
         try:
-            result = await router.submit("__call__", (req,), {},
-                                         model_id=model_id)
+            result, tag = await router.submit("__call__", (req,), {},
+                                              model_id=model_id,
+                                              with_tag=True)
         except Exception as e:  # noqa: BLE001 - surface as HTTP 500
             return web.json_response({"error": repr(e)}, status=500)
+        if isinstance(result, dict) and "__sse_stream__" in result:
+            return await self._stream_sse(request, router, tag,
+                                          result["__sse_stream__"])
         if isinstance(result, web.Response):
             return result
         if isinstance(result, (dict, list)):
@@ -187,6 +201,91 @@ class ProxyActor:
         if isinstance(result, bytes):
             return web.Response(body=result)
         return web.Response(text=str(result))
+
+    async def _stream_sse(self, request, router: _AsyncRouter, tag: str,
+                          info: dict):
+        """OpenAI `stream: true` transport: pull incremental tokens from
+        the owning replica and relay them as server-sent events, ending
+        with `data: [DONE]` (reference serve.llm streaming router)."""
+        import json as _json
+
+        from aiohttp import web
+
+        import uuid
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive"})
+        await resp.prepare(request)
+        sid = info["stream_id"]
+        chat = info.get("mode") == "chat"
+        created = int(time.time())
+        # one id for every chunk of the stream (OpenAI SDKs require it)
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        cursor = 0
+        sent_text = ""
+        last_progress = time.monotonic()
+        try:
+            while True:
+                chunk = await router.submit_on(
+                    tag, "stream_next", (sid,), {"cursor": cursor})
+                if chunk.get("error"):
+                    await resp.write(
+                        f"data: {_json.dumps({'error': chunk['error']})}"
+                        f"\n\n".encode())
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
+                cursor = chunk.get("cursor", cursor)
+                done = chunk.get("done", False)
+                if not chunk["token_ids"] and not done:
+                    # queued behind a full slot batch: bounded patience,
+                    # then a clean error instead of an immortal stream
+                    if time.monotonic() - last_progress > 120:
+                        await resp.write(
+                            b'data: {"error": "generation stalled"}\n\n'
+                            b"data: [DONE]\n\n")
+                        break
+                    continue
+                last_progress = time.monotonic()
+                # chunk["text"] is CUMULATIVE (multi-byte chars must not
+                # split across batches); emit only the new suffix
+                delta_text = chunk["text"][len(sent_text):]
+                sent_text = chunk["text"]
+                finish = chunk.get("finish_reason") if done else None
+                if chat:
+                    payload = {
+                        "id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": info["model"],
+                        "choices": [{"index": 0,
+                                     "delta": ({"content": delta_text}
+                                               if delta_text else {}),
+                                     "finish_reason": finish}]}
+                else:
+                    payload = {
+                        "id": rid, "object": "text_completion",
+                        "created": created, "model": info["model"],
+                        "choices": [{"index": 0, "text": delta_text,
+                                     "finish_reason": finish}]}
+                await resp.write(
+                    f"data: {_json.dumps(payload)}\n\n".encode())
+                if done:
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away; the replica GC's the stream by TTL
+        except Exception as e:  # noqa: BLE001 - headers already sent:
+            # the failure must arrive as an SSE event, not a TCP reset
+            # (replica restarted mid-stream, stream id lost, ...)
+            try:
+                await resp.write(
+                    f"data: {_json.dumps({'error': repr(e)})}\n\n"
+                    f"data: [DONE]\n\n".encode())
+            except Exception:
+                pass
+        await resp.write_eof()
+        return resp
 
     async def ready(self) -> int:
         return self.port

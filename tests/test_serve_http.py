@@ -170,3 +170,63 @@ def test_grpc_ingress(cluster):
     out = json.loads(reply)
     assert out == {"got": "hello", "method": "GRPC"}
     channel.close()
+
+
+def test_openai_streaming_sse(cluster):
+    """OpenAI `stream: true` (reference serve.llm streaming router):
+    completions arrive as server-sent events — multiple data: chunks,
+    text deltas concatenating to the full completion, `[DONE]` last —
+    pulled incrementally from the owning replica."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.serve.llm import build_openai_app
+
+    app = build_openai_app(preset="gpt2-tiny", max_batch=2, max_seq_len=64,
+                           model_id="sse-model",
+                           model_overrides={"vocab_size": 512,
+                                            "attn_impl": "dense"})
+    serve.run(app, route_prefix="/v2")
+    port = serve.start()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v2/completions",
+        data=_json.dumps({"prompt": "stream me", "max_tokens": 12,
+                          "temperature": 0.0, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=180) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        raw = resp.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines()
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [_json.loads(e) for e in events[:-1]]
+    assert len(chunks) >= 2, "streaming must emit multiple chunks"
+    assert len({c["id"] for c in chunks}) == 1  # one id per stream
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    # max_tokens reached -> 'length', exactly like the non-stream path
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+    # the streamed text equals the non-streamed completion (greedy)
+    req2 = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v2/completions",
+        data=_json.dumps({"prompt": "stream me", "max_tokens": 12,
+                          "temperature": 0.0}).encode(),
+        headers={"Content-Type": "application/json"})
+    body = _json.loads(urllib.request.urlopen(req2, timeout=180).read())
+    assert text == body["choices"][0]["text"]
+
+    # chat variant emits chat.completion.chunk deltas
+    req3 = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v2/chat/completions",
+        data=_json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                          "max_tokens": 6, "temperature": 0.0,
+                          "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req3, timeout=180) as resp:
+        raw = resp.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines()
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    first = _json.loads(events[0])
+    assert first["object"] == "chat.completion.chunk"
+    assert "content" in first["choices"][0]["delta"]
