@@ -17,6 +17,8 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.env.envs import (Box, CartPole, Discrete, Env, Pendulum,
                                     VectorEnv, make_env, register_env)
+from ray_tpu.rllib.env.multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
+                                           TargetMatch)
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 from ray_tpu.rllib.core.rl_module import ModuleSpec, RLModule, spec_from_env
@@ -26,5 +28,6 @@ __all__ = [
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
     "Box", "CartPole", "Discrete", "Env", "Pendulum",
     "VectorEnv", "make_env", "register_env", "SingleAgentEnvRunner",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "TargetMatch",
     "EnvRunnerGroup", "ModuleSpec", "RLModule", "spec_from_env",
 ]

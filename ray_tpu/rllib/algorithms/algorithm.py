@@ -28,6 +28,12 @@ class Algorithm:
 
     def __init__(self, config: AlgorithmConfig):
         self.config = config
+        self._multi_agent = bool(config.policies)
+        if self._multi_agent:
+            self._init_multi_agent()
+            self.iteration = 0
+            self._timesteps = 0
+            return
         probe = make_env(config.env, **config.env_kwargs)
         self.module_spec = self._module_spec(probe)
         mesh = None
@@ -45,6 +51,68 @@ class Algorithm:
         self.env_runner_group.sync_weights(self.learner.get_weights())
         self.iteration = 0
         self._timesteps = 0
+
+    def _init_multi_agent(self) -> None:
+        """Per-policy learners + a policy-batched multi-agent runner
+        (reference MultiRLModule + MultiAgentEnvRunner)."""
+        import dataclasses as _dc
+
+        from ray_tpu.rllib.env.multi_agent import (MultiAgentEnvRunner,
+                                                   spec_for_agent)
+
+        config = self.config
+        if not hasattr(self, "_multi_agent_training_step"):
+            raise NotImplementedError(
+                f"multi-agent training is implemented for PPO; "
+                f"{type(self).__name__} does not support "
+                f"config.multi_agent() yet")
+        env_factory = (config.env if callable(config.env)
+                       else lambda: make_env(config.env,
+                                             **config.env_kwargs))
+        probe = env_factory()
+        mapping_fn = config.policy_mapping_fn
+        if mapping_fn is None:
+            if len(config.policies) == 1:
+                only = next(iter(config.policies))
+                mapping_fn = lambda agent_id: only  # parameter sharing
+            else:
+                raise ValueError("policy_mapping_fn is required with "
+                                 "more than one policy")
+        self.policy_mapping_fn = mapping_fn
+        self.module_specs = {}
+        for pid, spec in config.policies.items():
+            if spec is None:
+                rep = next((a for a in probe.agents
+                            if mapping_fn(a) == pid), None)
+                if rep is None:
+                    raise ValueError(
+                        f"policy {pid!r} has spec=None but no agent maps "
+                        f"to it (agents: {probe.agents}) — give it a "
+                        f"ModuleSpec or fix policy_mapping_fn")
+                spec = spec_for_agent(probe, rep,
+                                      hiddens=tuple(config.hiddens))
+            else:
+                spec = _dc.replace(spec, hiddens=tuple(config.hiddens))
+            self.module_specs[pid] = spec
+        self.learners = {pid: self._build_learner_for(spec)
+                         for pid, spec in self.module_specs.items()}
+        self.ma_runner = MultiAgentEnvRunner(
+            env_factory, self.module_specs, mapping_fn, seed=config.seed)
+        self.ma_runner.set_weights({p: l.get_weights()
+                                    for p, l in self.learners.items()})
+
+    def _build_learner_for(self, spec):
+        """Multi-agent hook: a learner for ONE policy's module spec
+        (honoring config.learners(mesh_devices=...) like single-agent)."""
+        mesh = None
+        if self.config.mesh_devices:
+            devs = jax.devices()[:self.config.mesh_devices]
+            mesh = jax.sharding.Mesh(np.array(devs), ("dp",))
+        saved, self.module_spec = getattr(self, "module_spec", None), spec
+        try:
+            return self._build_learner(mesh)
+        finally:
+            self.module_spec = saved
 
     # hooks -----------------------------------------------------------------
     def _module_spec(self, env) -> ModuleSpec:
@@ -71,30 +139,50 @@ class Algorithm:
         return result
 
     def evaluate(self) -> dict:
+        if self._multi_agent:
+            self.ma_runner.set_weights({p: l.get_weights()
+                                        for p, l in self.learners.items()})
+            return self.ma_runner.evaluate(
+                self.config.evaluation_num_episodes)
         self.env_runner_group.sync_weights(self.learner.get_weights())
         return self.env_runner_group.evaluate(self.config.evaluation_num_episodes)
 
     def save(self, checkpoint_dir: str) -> str:
         os.makedirs(checkpoint_dir, exist_ok=True)
         path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        state = {"iteration": self.iteration, "timesteps": self._timesteps}
+        if self._multi_agent:
+            state["learners"] = {p: l.get_state()
+                                 for p, l in self.learners.items()}
+        else:
+            state["learner"] = self.learner.get_state()
         with open(path, "wb") as f:
-            pickle.dump({"learner": self.learner.get_state(),
-                         "iteration": self.iteration,
-                         "timesteps": self._timesteps}, f)
+            pickle.dump(state, f)
         return checkpoint_dir
 
     def restore(self, checkpoint_dir: str) -> None:
         with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
             state = pickle.load(f)
-        self.learner.set_state(state["learner"])
+        if self._multi_agent:
+            for p, st in state["learners"].items():
+                self.learners[p].set_state(st)
+            self.ma_runner.set_weights({p: l.get_weights()
+                                        for p, l in self.learners.items()})
+        else:
+            self.learner.set_state(state["learner"])
+            self.env_runner_group.sync_weights(self.learner.get_weights())
         self.iteration = state["iteration"]
         self._timesteps = state["timesteps"]
-        self.env_runner_group.sync_weights(self.learner.get_weights())
 
     def stop(self) -> None:
-        self.env_runner_group.stop()
+        if not self._multi_agent:
+            self.env_runner_group.stop()
 
-    def get_policy_weights(self):
+    def get_policy_weights(self, policy_id: Optional[str] = None):
+        if self._multi_agent:
+            if policy_id is not None:
+                return self.learners[policy_id].get_weights()
+            return {p: l.get_weights() for p, l in self.learners.items()}
         return self.learner.get_weights()
 
     # ----------------------------------------------------- off-policy helper

@@ -33,6 +33,10 @@ class AlgorithmConfig:
         # evaluation
         self.evaluation_interval: int = 0
         self.evaluation_num_episodes: int = 5
+        # multi-agent (reference config.multi_agent()): policy id ->
+        # ModuleSpec (or None to derive from the env) + agent->policy map
+        self.policies: Optional[dict] = None
+        self.policy_mapping_fn: Optional[Any] = None
 
     # fluent setters — each returns self, mirroring the reference exactly
     def environment(self, env=None, *, env_config: Optional[dict] = None):
@@ -58,6 +62,19 @@ class AlgorithmConfig:
             if not hasattr(self, k):
                 raise AttributeError(f"unknown training option {k!r}")
             setattr(self, k, v)
+        return self
+
+    def multi_agent(self, *, policies: Optional[dict] = None,
+                    policy_mapping_fn=None):
+        """Enable multi-agent training: `policies` maps policy ids to
+        ModuleSpecs (None values derive the spec from the env's per-agent
+        spaces); `policy_mapping_fn(agent_id) -> policy_id` (default:
+        one shared policy when a single policy is given, else identity
+        prefix matching is the caller's job)."""
+        if policies is not None:
+            self.policies = policies
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def learners(self, *, mesh_devices: Optional[int] = None):

@@ -73,6 +73,8 @@ class PPO(Algorithm):
         return PPOLearner(self.module_spec, self.config, mesh=mesh)
 
     def training_step(self) -> dict:
+        if self._multi_agent:
+            return self._multi_agent_training_step()
         c = self.config
         self.env_runner_group.sync_weights(self.learner.get_weights())
         fragments = self.env_runner_group.sample(c.rollout_fragment_length)
@@ -85,33 +87,61 @@ class PPO(Algorithm):
         # concatenate runner fragments along the env axis, compute GAE, flatten
         cat = {k: np.concatenate([f[k] for f in fragments], axis=1)
                for k in fragments[0] if k not in ("next_obs", "last_values")}
-        last_v = np.concatenate([f["last_values"] for f in fragments])
-        # bootstrap through time-limit truncation: fold γV(final_obs) into the
-        # reward at truncated (non-terminated) steps, then treat the step as
-        # done — an exact rewrite of the truncation-aware GAE recursion
-        boot = cat["truncateds"] & ~cat["terminateds"]
-        rewards = cat["rewards"] + c.gamma * cat["final_values"] * boot
-        advs, targets = _jitted_gae(
-            rewards, cat["values"], cat["dones"].astype(np.float32),
-            last_v, c.gamma, c.lambda_)
-        T, N = cat["rewards"].shape
-        flat = lambda x: np.asarray(x).reshape(T * N, *x.shape[2:])
-        train_batch = {"obs": flat(cat["obs"]), "actions": flat(cat["actions"]),
-                       "logp": flat(cat["logp"]), "values": flat(cat["values"]),
-                       "advantages": flat(advs), "value_targets": flat(targets)}
-        self._timesteps += T * N
-
+        cat["last_values"] = np.concatenate(
+            [f["last_values"] for f in fragments])
         rng = np.random.default_rng(c.seed + self.iteration)
-        n = train_batch["obs"].shape[0]
+        metrics = self._ppo_update_on_fragment(self.learner, cat, rng)
+        metrics.update(self._episode_metrics(ep_metrics))
+        return metrics
+
+    def _ppo_update_on_fragment(self, learner, frag: dict, rng) -> dict:
+        """GAE (truncation-aware) + minibatch epochs on one [T, N]
+        fragment — shared by the single-agent and per-policy multi-agent
+        paths so the recursion can never silently diverge between them.
+        Bootstrap through time-limit truncation: fold γV(final_obs) into
+        the reward at truncated (non-terminated) steps, then treat the
+        step as done — an exact rewrite of the truncation-aware GAE."""
+        c = self.config
+        boot = frag["truncateds"] & ~frag["terminateds"]
+        rewards = frag["rewards"] + c.gamma * frag["final_values"] * boot
+        advs, targets = _jitted_gae(
+            rewards, frag["values"], frag["dones"].astype(np.float32),
+            frag["last_values"], c.gamma, c.lambda_)
+        T, N = frag["rewards"].shape
+        flat = lambda x: np.asarray(x).reshape(T * N, *x.shape[2:])
+        batch = {"obs": flat(frag["obs"]), "actions": flat(frag["actions"]),
+                 "logp": flat(frag["logp"]), "values": flat(frag["values"]),
+                 "advantages": flat(advs), "value_targets": flat(targets)}
+        self._timesteps += T * N
+        n = batch["obs"].shape[0]
         mb = min(c.minibatch_size, n)
         metrics: Dict[str, float] = {}
         for _ in range(c.num_epochs):
             perm = rng.permutation(n)
-            for s in range(0, n - mb + 1, mb):
-                idx = perm[s:s + mb]
-                metrics = self.learner.update({k: v[idx] for k, v in
-                                               train_batch.items()})
-        metrics.update(self._episode_metrics(ep_metrics))
+            for st in range(0, n - mb + 1, mb):
+                idx = perm[st:st + mb]
+                metrics = learner.update({k: v[idx]
+                                          for k, v in batch.items()})
+        return metrics
+
+    def _multi_agent_training_step(self) -> dict:
+        """Independent PPO per policy (reference multi-agent PPO with a
+        MultiRLModule): one shared rollout, per-policy GAE + minibatch
+        epochs on that policy's [T, N_agents] fragment."""
+        c = self.config
+        self.ma_runner.set_weights({p: l.get_weights()
+                                    for p, l in self.learners.items()})
+        frags = self.ma_runner.sample(c.rollout_fragment_length)
+        rng = np.random.default_rng(c.seed + self.iteration)
+        metrics: Dict[str, float] = {}
+        for pid, f in frags.items():
+            m = self._ppo_update_on_fragment(self.learners[pid], f, rng)
+            metrics.update({f"{pid}/{k}": v for k, v in m.items()})
+        em = self.ma_runner.episode_metrics()
+        if em["episodes"]:
+            # per-agent mean return over the window (all agents pooled)
+            metrics["episode_return_mean"] = em["return_sum"] / em["episodes"]
+        metrics["episodes_this_iter"] = em["episodes"]
         return metrics
 
 

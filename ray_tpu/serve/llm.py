@@ -74,6 +74,7 @@ class _Request:
         self.finish_reason: str = "stop"
         # streaming consumers: wakes on every appended token batch
         self.progress = threading.Condition()
+        self._sent_text = ""  # cumulative text already shipped to the consumer
 
 
 class LLMEngine:
@@ -201,14 +202,21 @@ class LLMEngine:
         done = req.done.is_set() and cursor + len(new) >= len(req.generated)
         if done:
             self._streams.pop(stream_id, None)
-        # expire abandoned streams (client vanished mid-stream): their
-        # requests run to completion, the entries must not accumulate
-        now = time.time()
-        for sid, (r, ts) in list(self._streams.items()):
-            if r.done.is_set() and now - ts > 300:
-                self._streams.pop(sid, None)
-        return {"token_ids": new,
-                "text": self.tokenizer.decode(req.generated[:cursor + len(new)]),
+        # delta computed HERE from the cumulative decode (multi-byte
+        # characters must not split across chunk boundaries), decoded
+        # only when tokens actually advanced — no per-poll O(L) work and
+        # no cumulative string shipped per RPC
+        delta = ""
+        if new or done:
+            full = self.tokenizer.decode(req.generated[:cursor + len(new)])
+            if not done and full.endswith("\ufffd"):
+                # trailing partial multi-byte sequence: hold it back until
+                # its continuation bytes arrive
+                full = full[:-1]
+            delta = (full[len(req._sent_text):]
+                     if full.startswith(req._sent_text) else full)
+            req._sent_text = full
+        return {"token_ids": new, "text": delta,
                 "done": done, "cursor": cursor + len(new),
                 "finish_reason": req.finish_reason if done else None}
 
@@ -227,12 +235,24 @@ class LLMEngine:
                 self._slot_pos[i] = 0
                 self._slot_prefill[i] = list(req.prompt_ids)
 
+    def _sweep_streams(self) -> None:
+        """Expire abandoned stream entries (client vanished): the sweep
+        must not depend on some OTHER stream being polled."""
+        now = time.time()
+        for sid, (r, ts) in list(self._streams.items()):
+            if r.done.is_set() and now - ts > 300:
+                self._streams.pop(sid, None)
+
     def _engine_loop(self):
         import numpy as np
 
         jnp = self.jnp
         rng = np.random.default_rng(0)
+        last_sweep = time.time()
         while not self._stop.is_set():
+            if time.time() - last_sweep > 60:
+                last_sweep = time.time()
+                self._sweep_streams()
             self._admit()
             live = [i for i, r in enumerate(self._slots) if r is not None]
             if not live:

@@ -225,7 +225,6 @@ class ProxyActor:
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")
         cursor = 0
-        sent_text = ""
         last_progress = time.monotonic()
         try:
             while True:
@@ -249,10 +248,9 @@ class ProxyActor:
                         break
                     continue
                 last_progress = time.monotonic()
-                # chunk["text"] is CUMULATIVE (multi-byte chars must not
-                # split across batches); emit only the new suffix
-                delta_text = chunk["text"][len(sent_text):]
-                sent_text = chunk["text"]
+                # chunk["text"] is the server-computed DELTA (derived from
+                # a cumulative decode, so multi-byte chars never split)
+                delta_text = chunk["text"]
                 finish = chunk.get("finish_reason") if done else None
                 if chat:
                     payload = {

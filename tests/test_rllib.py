@@ -370,3 +370,67 @@ def test_vtrace_reduces_to_gae_like_targets_on_policy():
         acc = deltas[t] + gamma * acc
         expect[t] = acc + values[t]
     np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-4, atol=1e-4)
+
+
+def test_multi_agent_ppo_two_policies():
+    """Two independent policies on the cooperative TargetMatch game
+    (reference multi-agent PPO): both learn to match the target — mean
+    per-agent return approaches the 1.5/step optimum."""
+    from ray_tpu.rllib import PPOConfig, TargetMatch
+
+    algo = (PPOConfig()
+            .environment(lambda: TargetMatch())
+            .env_runners(rollout_fragment_length=256)
+            .training(num_epochs=6, minibatch_size=128, lr=1e-2,
+                      entropy_coeff=0.0)
+            .multi_agent(
+                policies={"p0": None, "p1": None},
+                policy_mapping_fn=lambda a: "p0" if a == "agent_0" else "p1")
+            .debugging(seed=0)
+            .build())
+    first = algo.train()
+    for _ in range(11):
+        result = algo.train()
+    # optimum 1.5 * 16 = 24 per agent per episode; random ~ (1/4+...)
+    assert result["episode_return_mean"] > 15, result
+    assert result["episode_return_mean"] > first["episode_return_mean"]
+    assert "p0/total_loss" in result and "p1/total_loss" in result
+    w = algo.get_policy_weights()
+    assert set(w) == {"p0", "p1"}
+    algo.stop()
+
+
+def test_multi_agent_parameter_sharing_and_checkpoint(tmp_path):
+    """One shared policy across both agents (parameter sharing — the
+    default mapping for a single policy), plus save/restore."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.rllib import PPOConfig, TargetMatch
+
+    def build():
+        return (PPOConfig()
+                .environment(lambda: TargetMatch())
+                .env_runners(rollout_fragment_length=256)
+                .training(num_epochs=6, minibatch_size=128, lr=1e-2)
+                .multi_agent(policies={"shared": None})
+                .debugging(seed=1)
+                .build())
+
+    algo = build()
+    for _ in range(10):
+        result = algo.train()
+    assert result["episode_return_mean"] > 15, result
+    ev = algo.evaluate()
+    assert ev["episode_return_mean"] > 18, ev  # greedy: near-optimal
+    ckpt = algo.save(str(tmp_path / "ma"))
+    w0 = algo.get_policy_weights("shared")
+
+    algo2 = build()
+    algo2.restore(ckpt)
+    w1 = algo2.get_policy_weights("shared")
+    np.testing.assert_allclose(np.asarray(jax.tree.leaves(w0)[0]),
+                               np.asarray(jax.tree.leaves(w1)[0]))
+    ev2 = algo2.evaluate()
+    assert ev2["episode_return_mean"] > 18, ev2
+    algo.stop(); algo2.stop()
