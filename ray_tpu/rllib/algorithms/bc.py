@@ -32,32 +32,50 @@ class BCLearner(JaxLearner):
 
 
 class BC(Algorithm):
+    # offline-pipeline hooks (MARWIL etc. extend, never re-implement):
+    # the columns ingested, a post-load step, and the learner factory
+    offline_columns = ("obs", "actions")
+
+    def _load_offline(self, data) -> dict:
+        if isinstance(data, dict):
+            cols = {k: np.asarray(data[k]) for k in self.offline_columns}
+        else:  # ray_tpu.data.Dataset
+            acc = {k: [] for k in self.offline_columns}
+            for b in data.iter_batches(batch_size=4096):
+                for k in acc:
+                    acc[k].append(np.asarray(b[k]))
+            if not acc["obs"]:
+                raise ValueError("offline dataset is empty")
+            cols = {k: np.concatenate(v) for k, v in acc.items()}
+        if len(cols["obs"]) == 0:
+            raise ValueError("offline dataset is empty")
+        return cols
+
+    def _post_load(self, cols: dict) -> None:
+        """Subclass hook: derive extra per-sample training columns into
+        self._extras (sampled alongside obs/actions each minibatch)."""
+
+    def _make_learner(self, mesh):
+        return BCLearner(self.module_spec, self.config, mesh=mesh)
+
     def _build_learner(self, mesh):
         c = self.config
         data = c.offline_data
         if data is None:
-            raise ValueError("BCConfig.offline(offline_data=...) is required")
-        if isinstance(data, dict):
-            self._obs = np.asarray(data["obs"], np.float32)
-            self._acts = np.asarray(data["actions"])
-        else:  # ray_tpu.data.Dataset
-            obs, acts = [], []
-            for b in data.iter_batches(batch_size=4096):
-                obs.append(np.asarray(b["obs"], np.float32))
-                acts.append(np.asarray(b["actions"]))
-            if not obs:
-                raise ValueError("offline dataset is empty")
-            self._obs = np.concatenate(obs)
-            self._acts = np.concatenate(acts)
-        if len(self._obs) == 0:
-            raise ValueError("offline dataset is empty")
+            raise ValueError(
+                f"{type(c).__name__}.offline(offline_data=...) is required")
+        cols = self._load_offline(data)
+        self._obs = cols["obs"].astype(np.float32)
+        self._acts = cols["actions"]
         if not self.module_spec.discrete:
             # logged actions are in ENV space; the module (and the env
             # runner, which multiplies by action_scale on the way out)
             # work in module space [-1, 1]
             self._acts = self._acts / self.module_spec.action_scale
+        self._extras: dict = {}
+        self._post_load(cols)
         self._rng = np.random.default_rng(c.seed)
-        return BCLearner(self.module_spec, c, mesh=mesh)
+        return self._make_learner(mesh)
 
     def training_step(self) -> dict:
         c = self.config
@@ -66,8 +84,9 @@ class BC(Algorithm):
         metrics = {}
         for _ in range(c.num_updates_per_iteration):
             idx = self._rng.integers(0, n, size=bs)
-            metrics = self.learner.update({"obs": self._obs[idx],
-                                           "actions": self._acts[idx]})
+            batch = {"obs": self._obs[idx], "actions": self._acts[idx],
+                     **{k: v[idx] for k, v in self._extras.items()}}
+            metrics = self.learner.update(batch)
         self._timesteps += c.num_updates_per_iteration * bs
         return metrics
 

@@ -1,0 +1,93 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning.
+
+Parity: `rllib/algorithms/marwil/` (Wang et al., NeurIPS 2018 — the
+reference's recommended offline algorithm) — behavior cloning whose
+per-sample loss is weighted by exp(beta * advantage), so better-than-
+average logged behavior is imitated harder and the learned policy can
+EXCEED the data-collection policy. beta=0 reduces exactly to BC.
+
+Offline input reuses BC's pipeline (`obs`, `actions`, plus `rewards` +
+episode boundaries via `dones` for the return computation); advantages
+come from a jointly trained value baseline on the logged returns, with
+the reference's running-average advantage normalization (`moving average
+of squared advantages`, marwil_torch_learner.py) folded into the jitted
+update as a batch-local estimate.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.core.learner import JaxLearner
+
+
+def discounted_returns(rewards: np.ndarray, dones: np.ndarray,
+                       gamma: float) -> np.ndarray:
+    """Per-step discounted return-to-go within episodes (offline target
+    for the value baseline)."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        if dones[t]:
+            acc = 0.0
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out
+
+
+class MARWILLearner(JaxLearner):
+    def __init__(self, spec, cfg: "MARWILConfig", mesh=None):
+        self.cfg = cfg
+        super().__init__(spec, lr=cfg.lr, grad_clip=cfg.grad_clip,
+                         seed=cfg.seed, mesh=mesh)
+
+    def loss(self, params, batch, rng) -> Tuple[jnp.ndarray, dict]:
+        c = self.cfg
+        dist = self.module.dist(params, batch["obs"])
+        logp = dist.log_prob(batch["actions"])
+        v = self.module.value(params, batch["obs"])
+        adv = batch["returns"] - v
+        vf_loss = (adv ** 2).mean()
+        if c.beta > 0.0:
+            # exp(beta * normalized advantage), gradient-stopped: the
+            # weight ranks samples, it must not be a policy gradient path
+            sg_adv = jax.lax.stop_gradient(adv)
+            # the normalizer must be gradient-stopped too, or w leaks a
+            # path into the value tower through the policy loss
+            norm = jnp.sqrt((sg_adv ** 2).mean()) + 1e-8
+            w = jnp.exp(c.beta * jnp.clip(sg_adv / norm, -5.0, 5.0))
+        else:
+            w = jnp.ones_like(logp)  # beta=0: exact BC
+        pg = -(w * logp).mean()
+        total = pg + c.vf_coeff * vf_loss
+        return total, {"marwil_loss": pg, "vf_loss": vf_loss,
+                       "mean_weight": w.mean()}
+
+
+class MARWIL(BC):
+    """BC's offline pipeline (loading/scaling/minibatching inherited via
+    its hooks) + logged discounted returns as an extra column."""
+
+    offline_columns = ("obs", "actions", "rewards", "dones")
+
+    def _post_load(self, cols: dict) -> None:
+        self._extras["returns"] = discounted_returns(
+            np.asarray(cols["rewards"], np.float32),
+            np.asarray(cols["dones"], bool), self.config.gamma)
+
+    def _make_learner(self, mesh):
+        return MARWILLearner(self.module_spec, self.config, mesh=mesh)
+
+
+class MARWILConfig(BCConfig):
+    algo_class = MARWIL
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0       # 0 = plain BC (reference default 1.0)
+        self.vf_coeff = 1.0
