@@ -9,6 +9,7 @@ local provider a subshell, production SSH.
 from __future__ import annotations
 
 import os
+import shlex
 import subprocess
 import sys
 from typing import List, Optional, Tuple
@@ -100,9 +101,10 @@ class SSHCommandRunner(CommandRunner):
 
     def run(self, cmd: str, timeout: Optional[float] = None,
             env: Optional[dict] = None) -> Tuple[int, str]:
-        exports = "".join(f"export {k}={v!r}; " for k, v in (env or {}).items())
+        exports = "".join(
+            f"export {k}={shlex.quote(str(v))}; " for k, v in (env or {}).items())
         argv = self._ssh_base() + [self._target(),
-                                   f"bash -c {exports + cmd!r}"]
+                                   f"bash -c {shlex.quote(exports + cmd)}"]
         proc = subprocess.run(argv, capture_output=True, text=True,
                               timeout=timeout)
         return proc.returncode, proc.stdout + proc.stderr

@@ -160,8 +160,18 @@ class SSHNodeProvider(NodeProvider):
                 entry["failed"] = True  # host back to the pool next scan
                 self._nodes.pop(provider_id, None)
                 self._types.pop(provider_id, None)
-            else:
-                entry["pid"] = parse_daemon_pid(out)
+                return
+            entry["pid"] = parse_daemon_pid(out)
+            # terminate_node may have run while the SSH start was in
+            # flight (entry popped, pid still None then): it could not
+            # kill a pid it didn't know. Reap the daemon we just started
+            # so the host really is free when back in the pool.
+            if entry.get("terminating") and entry["pid"]:
+                try:
+                    runner.run(f"kill {entry['pid']} 2>/dev/null || true",
+                               timeout=30)
+                except Exception:
+                    pass
 
         threading.Thread(target=_start, daemon=True,
                          name=f"ssh-start-{provider_id}").start()
@@ -172,6 +182,9 @@ class SSHNodeProvider(NodeProvider):
         self._types.pop(provider_id, None)
         if cfg is None:
             return
+        # _start may still be mid-SSH with pid unknown; flag the entry so
+        # it kills the daemon it is about to create (see _start).
+        cfg["terminating"] = True
         runner = self._make_runner(cfg, self.auth)
         try:
             if cfg.get("pid"):

@@ -21,6 +21,30 @@ import time
 from typing import Optional, Tuple
 
 PUMP_CHUNK = 1 << 16
+# a client_hello frame is tiny; anything bigger before the handshake is
+# not our client
+HELLO_MAX_BYTES = 1 << 20
+HELLO_TIMEOUT_S = 15.0
+
+
+async def _read_raw_frame(reader: asyncio.StreamReader,
+                          max_bytes: int) -> bytes:
+    """Read one length-prefixed protocol frame as RAW bytes (header +
+    payload + out-of-band buffers) without unpickling anything — the
+    proxy must never deserialize pre-auth input."""
+    header = await reader.readexactly(12)
+    payload_len = int.from_bytes(header[:8], "little")
+    n_bufs = int.from_bytes(header[8:12], "little")
+    if payload_len > max_bytes or n_bufs > 16:
+        raise ValueError("oversized pre-handshake frame")
+    raw = header + await reader.readexactly(payload_len)
+    for _ in range(n_bufs):
+        ln_b = await reader.readexactly(8)
+        ln = int.from_bytes(ln_b, "little")
+        if len(raw) + ln > max_bytes:
+            raise ValueError("oversized pre-handshake frame")
+        raw += ln_b + await reader.readexactly(ln)
+    return raw
 
 
 async def _pump(reader: asyncio.StreamReader,
@@ -42,11 +66,19 @@ async def _pump(reader: asyncio.StreamReader,
 
 
 class ClientProxyServer:
-    def __init__(self, head_host: str, head_port: int):
+    def __init__(self, head_host: str, head_port: int,
+                 max_clients: Optional[int] = None):
+        from ray_tpu.core import config as _config
+
         self.head_host, self.head_port = head_host, head_port
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._procs: list = []
+        # each accepted client costs a full driver process; cap them so a
+        # port scan (or a misbehaving tenant) can't fork-bomb the head
+        self.max_clients = (max_clients if max_clients is not None
+                            else _config.get("client_proxy_max_clients"))
+        self._active = 0
 
     async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
         self._server = await asyncio.start_server(self._on_client, host, port)
@@ -91,6 +123,35 @@ class ClientProxyServer:
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
+        if self._active >= self.max_clients:
+            print(f"[ray_tpu] client proxy at capacity "
+                  f"({self.max_clients} clients); rejecting",
+                  file=sys.stderr, flush=True)
+            writer.close()
+            return
+        # reserve the slot BEFORE the first await: the capacity check and
+        # increment must be atomic w.r.t. other connections or N
+        # simultaneous pre-hello connects all pass the check at _active=0
+        self._active += 1
+        try:
+            # demand a plausible client_hello BEFORE paying for a worker
+            # process: bare connects (port scans) and garbage senders are
+            # dropped here. The frame is relayed verbatim, never unpickled.
+            try:
+                hello_raw = await asyncio.wait_for(
+                    _read_raw_frame(reader, HELLO_MAX_BYTES), HELLO_TIMEOUT_S)
+                if b"client_hello" not in hello_raw:
+                    raise ValueError("first frame is not client_hello")
+            except (Exception, asyncio.TimeoutError):
+                writer.close()
+                return
+            await self._serve_client(hello_raw, reader, writer)
+        finally:
+            self._active -= 1
+
+    async def _serve_client(self, hello_raw: bytes,
+                            reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
         proc = None
         try:
             port, proc = await self._spawn_worker()
@@ -116,6 +177,9 @@ class ClientProxyServer:
             except (OSError, AttributeError):
                 pass
         try:
+            # replay the buffered handshake frame to the worker first
+            w_writer.write(hello_raw)
+            await w_writer.drain()
             await asyncio.gather(_pump(reader, w_writer),
                                  _pump(w_reader, writer))
         finally:

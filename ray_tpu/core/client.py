@@ -394,7 +394,7 @@ class CoreClient:
     async def _start_async(self, direct_handlers: dict) -> None:
         self.direct_server = protocol.Server(direct_handlers, name="direct")
         self.direct_port = await self.direct_server.start(
-            host=os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"))
+            host=_config.get("bind_host"))
         self.conn = await protocol.connect(self.head_host, self.head_port,
                                            handlers=self._extra_handlers,
                                            name="head")

@@ -93,6 +93,9 @@ FLAGS: List[Flag] = [
          "on disconnect."),
     Flag("runtime_env_cache_bytes", "RAY_TPU_RUNTIME_ENV_CACHE_BYTES",
          int, 2 << 30, "Head-side cap for cached runtime_env packages."),
+    Flag("client_proxy_max_clients", "RAY_TPU_CLIENT_PROXY_MAX_CLIENTS",
+         int, 16, "Concurrent remote drivers the client proxy will host; "
+         "each costs a full driver process on the head node."),
     Flag("testing_rpc_failure", "RAY_TPU_TESTING_RPC_FAILURE", str, "",
          "Chaos injection: 'method:prob,...' (reference rpc_chaos)."),
     # ------------------------------------------------------------- memory

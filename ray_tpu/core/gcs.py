@@ -423,16 +423,28 @@ class Head:
         async def submit_task(spec):
             w = conn_state["worker"]
             rec = TaskRecord(spec, w)
-            if not spec.get("failover"):
-                for rid in spec["return_ids"]:
-                    # the submitter constructs ObjectRefs for every return
-                    # id; record it as holder NOW so a fast task's sealed
-                    # result can't be evicted before the submitter's inc
-                    # flush lands. Lease-failover resubmissions skip this:
-                    # their inc landed long ago (and may already have a
-                    # matching dec), so a re-added holder entry would never
-                    # be released and the sealed result would leak.
-                    self._add_holder(ObjectID(rid), w.worker_id)
+            for rid in spec["return_ids"]:
+                # the submitter constructs ObjectRefs for every return
+                # id; record it as holder NOW so a fast task's sealed
+                # result can't be evicted before the submitter's inc
+                # flush lands. A lease-failover resubmission only skips
+                # this when the head has provably seen AND released the
+                # submitter's ref (inc + dec both landed) — re-adding
+                # then would leak the sealed result forever. A
+                # connect-phase failover fires milliseconds after the
+                # original submit, when the inc can still be inside the
+                # refcount flush window, so "failover" alone is not
+                # evidence the holder exists.
+                oid = ObjectID(rid)
+                if (spec.get("failover")
+                        and (oid in self.obj_interest_seen
+                             or oid in self._tombstones)
+                        and oid not in self.worker_holds.get(w.worker_id, ())):
+                    # inc + dec both landed (live interest released, or the
+                    # dropped ref was already tombstoned): re-adding the
+                    # holder would never be released → sealed-result leak
+                    continue
+                self._add_holder(oid, w.worker_id)
             if spec["options"].get("num_returns") != "streaming":
                 entry = {"spec": spec, "produced": set(),
                          "recon_left": spec["options"].get("max_retries", 3),
@@ -2190,7 +2202,7 @@ class Head:
             conn.on_close = on_close
 
         # handlers installed per-connection (they close over conn_state)
-        bind = os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
+        bind = _config.get("bind_host")
         self._server = protocol.Server({}, on_connect=on_connect, name="head")
         self.port = await self._server.start(host=bind, port=port)
         # head-node object data server (worker nodes run theirs in the node

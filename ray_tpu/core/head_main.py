@@ -13,6 +13,7 @@ import json
 import os
 import sys
 
+from ray_tpu.core import config as _config
 from ray_tpu.core.gcs import Head
 
 
@@ -43,7 +44,7 @@ async def amain(args) -> None:
     restored = head.restore_snapshot() if args.restore else False
     if args.enable_snapshots:
         asyncio.ensure_future(head._snapshot_loop())
-    if os.environ.get("RAY_TPU_MEMORY_MONITOR", "1") != "0":
+    if _config.get("memory_monitor"):
         from ray_tpu.core.memory_monitor import MemoryMonitor
 
         asyncio.ensure_future(MemoryMonitor(head).run())
@@ -70,7 +71,7 @@ async def amain(args) -> None:
             # (any connecting client gets a full driver — RCE surface)
             cps = ClientProxyServer("127.0.0.1", port)
             cp_port = await cps.start(
-                host=os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"),
+                host=_config.get("bind_host"),
                 port=args.client_proxy_port)
             head.client_proxy_port = cp_port
             print(f"RAY_TPU_CLIENT_PROXY_PORT={cp_port}", flush=True)

@@ -20,6 +20,7 @@ import subprocess
 import sys
 from typing import Dict
 
+from ray_tpu.core import config as _config
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import NodeID
 
@@ -59,7 +60,7 @@ class NodeDaemon:
             object_transfer.make_data_handlers(lambda: self.store),
             name="node-data")
         self.data_port = await self._data_server.start(
-            host=os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"))
+            host=_config.get("bind_host"))
         self.conn = await protocol.connect(
             self.head_host, self.head_port,
             handlers={

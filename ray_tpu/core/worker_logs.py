@@ -215,7 +215,9 @@ def print_driver_entries(entries: List[dict]) -> None:
     RAY_TPU_LOG_TO_DRIVER opt-out must never diverge between them)."""
     import sys
 
-    if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") == "0":
+    from ray_tpu.core import config as _config
+
+    if not _config.get("log_to_driver"):
         return
     out = []
     for e in entries:
