@@ -84,9 +84,14 @@ class StandardAutoscaler:
         client = _global_client()
         demand = client.head_request("cluster_demand")
         nodes = client.head_request("list_state", kind="nodes")
-        by_provider_id = {
-            n["labels"].get("ray_tpu.io/provider-node-id"): n
-            for n in nodes if not n["is_head"]}
+        # one provider node may register SEVERAL head nodes (a TPU pod
+        # slice = one provider node, one daemon per host) — group them
+        by_provider_id: Dict[str, list] = {}
+        for n in nodes:
+            if not n["is_head"]:
+                by_provider_id.setdefault(
+                    n["labels"].get("ray_tpu.io/provider-node-id"),
+                    []).append(n)
 
         # a launched node is "booting" until it registers with the head
         # (or times out); its capacity absorbs demand so the same unmet ask
@@ -119,11 +124,11 @@ class StandardAutoscaler:
         # scale down: idle (all resources free, no workers busy) too long
         now = time.time()
         for pid in self.provider.non_terminated_nodes():
-            n = by_provider_id.get(pid)
-            if n is None:
+            ns = by_provider_id.get(pid)
+            if not ns:
                 continue  # still booting/registering
             busy = any(n["available"].get(r, 0) < v
-                       for r, v in n["resources"].items())
+                       for n in ns for r, v in n["resources"].items())
             if busy or demand:
                 self._idle_since.pop(pid, None)
                 continue

@@ -122,11 +122,48 @@ def _start_flags(node: dict) -> str:
     return flags
 
 
+def _make_gcp_provider(cfg: dict, head_address: str = ""):
+    """GCPNodeProvider from a cluster.yaml whose `provider.type == gcp`.
+    The head VM is node type `head` (spec from head_node.gcp)."""
+    from ray_tpu.autoscaler import gcp as gcp_mod
+
+    node_types = dict(cfg.get("worker_node_types", {}))
+    node_types["head"] = {
+        "resources": cfg["head_node"].get("resources", {}),
+        "gcp": cfg["head_node"].get("gcp", {}),
+        "max_nodes": 1,
+    }
+    return gcp_mod.GCPNodeProvider(
+        node_types, head_address, auth=cfg["auth"], python=_python(cfg),
+        project=cfg["provider"]["project"],
+        zone=cfg["provider"].get("zone")
+        or cfg["provider"].get("availability_zone"),
+        cluster_name=cfg["cluster_name"],
+        api=gcp_mod.api_from_config(cfg["provider"]),
+        use_internal_ips=cfg["provider"].get("use_internal_ips", False))
+
+
 def up(cfg: dict, log=print) -> dict:
     """Bring the cluster up: head first, then every worker joins it.
-    Returns the saved state dict (head address etc.)."""
+    Returns the saved state dict (head address etc.).
+
+    With `provider.type: gcp` the head VM (and `min_workers` workers per
+    `worker_node_types` entry) are CREATED on GCP first (reference
+    `ray up` + GCPNodeProvider); otherwise nodes are pre-existing hosts
+    reached over SSH."""
     name = cfg["cluster_name"]
     head = cfg["head_node"]
+    provider = None
+    gcp_instances: List[dict] = []
+    if cfg["provider"].get("type") == "gcp":
+        provider = _make_gcp_provider(cfg)
+        log(f"[{name}] creating head VM on GCP "
+            f"({cfg['provider']['project']})")
+        head_name, head_hosts = provider.create_raw_instance("head")
+        gcp_instances.append({"name": head_name,
+                              "is_tpu": provider._is_tpu("head")})
+        head = {**head, "host": head_hosts[0]["host"]}
+        log(f"[{name}] head VM {head_name} at {head['host']}")
     head_runner = make_runner(head, cfg["auth"])
     log(f"[{name}] preparing head {head.get('host', 'localhost')}")
     _prepare_node(cfg, head, head_runner, log)
@@ -155,6 +192,24 @@ def up(cfg: dict, log=print) -> dict:
              "address": join_addr,
              "auth": cfg["auth"], "workers": [], "env": cfg["env"],
              "python": _python(cfg)}
+    if provider is not None:
+        provider.head_address = join_addr
+        # min_workers per node type come up with the cluster (reference
+        # available_node_types[...].min_workers); further scale-up is the
+        # autoscaler's job against the same provider
+        for t, spec in cfg.get("worker_node_types", {}).items():
+            for i in range(int(spec.get("min_workers", 0))):
+                log(f"[{name}] creating {t} worker {i} on GCP")
+                pid = provider.create_node(t)
+                entry = provider.wait_ready(
+                    pid, timeout=cfg["provider"].get(
+                        "create_timeout_s", 600))
+                gcp_instances.append({"name": entry["name"],
+                                      "is_tpu": entry["is_tpu"]})
+                state["workers"].append(
+                    {"provider_id": pid, "hosts": entry["hosts"],
+                     "host": entry["hosts"][0]["host"]})
+        state["provider"] = {**cfg["provider"], "instances": gcp_instances}
     _save_state(name, state)
     for node in cfg["worker_nodes"]:
         runner = make_runner(node, cfg["auth"])
@@ -192,6 +247,27 @@ def down(name_or_cfg, log=print) -> None:
         raise RuntimeError(f"no cluster state for {name_or_cfg!r}; "
                            f"was it started with `ray-tpu up`?")
     name = state["cluster_name"]
+    if state.get("provider", {}).get("type") == "gcp":
+        # deleting the VMs IS the teardown (reference `ray down` via
+        # GCPNodeProvider.terminate_node)
+        from ray_tpu.autoscaler import gcp as gcp_mod
+
+        api = gcp_mod.api_from_config(state["provider"])
+        for inst in state["provider"].get("instances", []):
+            log(f"[{name}] deleting GCP instance {inst['name']}")
+            try:
+                if inst.get("is_tpu"):
+                    api.delete_tpu_node(inst["name"])
+                else:
+                    api.delete_instance(inst["name"])
+            except Exception as e:
+                log(f"  delete failed (continuing): {e!r}")
+        try:
+            os.unlink(_state_path(name))
+        except OSError:
+            pass
+        log(f"[{name}] down")
+        return
     for node in state["workers"]:
         runner = make_runner(node, state.get("auth", {}))
         log(f"[{name}] stopping worker {node.get('host', 'localhost')}")

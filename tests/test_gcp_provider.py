@@ -1,0 +1,446 @@
+"""GCP node provider tests against a fake in-process GCP API.
+
+Mirrors the reference's GCP provider tests (record/replay of
+googleapiclient calls); here the seam is `GCPApi.request_fn`, so the fake
+implements the two REST surfaces (compute v1 + tpu v2) in ~100 lines and
+the whole create → join → label-propagation → scale-down story runs with
+no cloud and no network.
+"""
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.command_runner import CommandRunner
+from ray_tpu.autoscaler.gcp import (GCPApi, GCPApiError, GCPNodeProvider,
+                                    TPUCommandRunner, _tpu_host_ips)
+
+
+class FakeGCP:
+    """In-memory GCE + TPU API. Operations complete after one extra poll
+    so the wait loops are exercised."""
+
+    def __init__(self):
+        self.instances = {}
+        self.tpu_nodes = {}
+        self.ops = {}          # op name -> polls remaining
+        self._ip = 0
+        self.lock = threading.Lock()
+        self.log = []
+
+    def _next_ip(self):
+        self._ip += 1
+        return f"10.0.0.{self._ip}"
+
+    def _op(self, kind):
+        with self.lock:
+            name = f"op-{len(self.ops)}"
+            self.ops[name] = 1
+        if kind == "tpu":
+            return {"name": f"projects/p/locations/z/operations/{name}",
+                    "done": False}
+        return {"name": name, "status": "RUNNING"}
+
+    def _poll(self, name, kind):
+        short = name.rsplit("/", 1)[-1]
+        with self.lock:
+            left = self.ops.get(short, 0)
+            self.ops[short] = left - 1
+        done = left <= 0
+        if kind == "tpu":
+            return {"name": f"projects/p/locations/z/operations/{short}",
+                    "done": done}
+        return {"name": short, "status": "DONE" if done else "RUNNING"}
+
+    # the request_fn seam
+    def __call__(self, method, url, body):
+        self.log.append((method, url))
+        # ---- compute
+        m = re.search(r"/zones/([^/]+)/instances$", url)
+        if m and method == "POST":
+            name = body["name"]
+            self.instances[name] = {
+                **body, "status": "RUNNING",
+                "labelFingerprint": "fp0",
+                "networkInterfaces": [
+                    {"networkIP": self._next_ip(),
+                     "accessConfigs": [{"natIP": self._next_ip()}]}]}
+            return 200, self._op("gce")
+        m = re.search(r"/instances/([^/]+)$", url)
+        if m:
+            name = m.group(1)
+            if method == "GET":
+                inst = self.instances.get(name)
+                return (200, inst) if inst else (404, {})
+            if method == "DELETE":
+                if self.instances.pop(name, None) is None:
+                    return 404, {}
+                return 200, self._op("gce")
+        m = re.search(r"/instances/([^/]+)/setLabels$", url)
+        if m and method == "POST":
+            self.instances[m.group(1)]["labels"] = body["labels"]
+            return 200, self._op("gce")
+        m = re.search(r"/zones/[^/]+/operations/([^/]+)$", url)
+        if m and method == "GET":
+            return 200, self._poll(m.group(1), "gce")
+        if url.endswith("/instances") and method == "GET":
+            return 200, {"items": list(self.instances.values())}
+        # ---- tpu
+        m = re.search(r"/nodes\?nodeId=([^&]+)$", url)
+        if m and method == "POST":
+            name = m.group(1)
+            accel = body.get("acceleratorType", "v4-8")
+            chips = int(accel.split("-")[-1])
+            n_hosts = max(1, chips // 8)
+            self.tpu_nodes[name] = {
+                **body, "name": name, "state": "READY",
+                "networkEndpoints": [
+                    {"ipAddress": self._next_ip(),
+                     "accessConfig": {"externalIp": self._next_ip()}}
+                    for _ in range(n_hosts)]}
+            return 200, self._op("tpu")
+        m = re.search(r"/nodes/([^/?]+)(\?updateMask=labels)?$", url)
+        if m:
+            name = m.group(1)
+            if method == "GET":
+                node = self.tpu_nodes.get(name)
+                return (200, node) if node else (404, {})
+            if method == "DELETE":
+                if self.tpu_nodes.pop(name, None) is None:
+                    return 404, {}
+                return 200, self._op("tpu")
+            if method == "PATCH":
+                self.tpu_nodes[name]["labels"] = body["labels"]
+                return 200, self._op("tpu")
+        if url.endswith("/nodes") and method == "GET":
+            return 200, {"nodes": list(self.tpu_nodes.values())}
+        m = re.search(r"/operations/([^/]+)$", url)
+        if m and method == "GET":
+            return 200, self._poll(m.group(1), "tpu")
+        return 400, {"error": f"unhandled {method} {url}"}
+
+
+class RecordingRunner(CommandRunner):
+    """Pretends every daemon start succeeds; records commands per host."""
+
+    def __init__(self, host):
+        self.host = host
+        self.commands = []
+
+    def run(self, cmd, timeout=None, env=None):
+        self.commands.append(cmd)
+        return 0, "node daemon started (pid 4242)"
+
+    def rsync_up(self, source, target):
+        self.commands.append(("rsync_up", source, target))
+
+
+def make_api(fake):
+    return GCPApi("proj", "us-central2-b", request_fn=fake,
+                  op_poll_s=0.001, op_max_polls=10)
+
+
+def make_provider(fake, node_types, runners=None):
+    prov = GCPNodeProvider(node_types, "127.0.0.1:7777",
+                           project="proj", zone="us-central2-b",
+                           cluster_name="t", api=make_api(fake))
+    if runners is not None:
+        prov._make_runner = lambda cfg, auth: runners.setdefault(
+            cfg["host"], RecordingRunner(cfg["host"]))
+    return prov
+
+
+NODE_TYPES = {
+    "cpu_worker": {"resources": {"CPU": 8}, "max_nodes": 4,
+                   "gcp": {"type": "compute",
+                           "machine_type": "n2-standard-8"}},
+    "tpu_slice": {"resources": {"TPU": 8}, "max_nodes": 2,
+                  "gcp": {"type": "tpu", "accelerator_type": "v4-16",
+                          "runtime_version": "tpu-ubuntu2204-base"}},
+}
+
+
+def wait_ready(prov, pid, timeout=10):
+    return prov.wait_ready(pid, timeout=timeout)
+
+
+def test_api_compute_crud():
+    fake = FakeGCP()
+    api = make_api(fake)
+    api.insert_instance({"name": "vm1", "labels": {"a": "b"}})
+    assert api.get_instance("vm1")["status"] == "RUNNING"
+    assert [i["name"] for i in api.list_instances()] == ["vm1"]
+    api.set_instance_labels("vm1", {"c": "d"})
+    assert api.get_instance("vm1")["labels"] == {"a": "b", "c": "d"}
+    api.delete_instance("vm1")
+    assert api.get_instance("vm1") is None
+    # deleting a missing instance is not an error (reference tolerates 404)
+    api.delete_instance("vm1")
+
+
+def test_api_tpu_crud_and_multihost_endpoints():
+    fake = FakeGCP()
+    api = make_api(fake)
+    api.create_tpu_node("s1", {"acceleratorType": "v4-32"})
+    node = api.get_tpu_node("s1")
+    assert node["state"] == "READY"
+    assert len(node["networkEndpoints"]) == 4          # 32 chips / 8
+    assert len(_tpu_host_ips(node)) == 4
+    api.patch_tpu_labels("s1", {"x": "y"})
+    assert api.get_tpu_node("s1")["labels"]["x"] == "y"
+    api.delete_tpu_node("s1")
+    assert api.get_tpu_node("s1") is None
+
+
+def test_api_error_surfaces():
+    fake = FakeGCP()
+    api = make_api(fake)
+    with pytest.raises(GCPApiError):
+        api._call("POST", "https://bogus.example/nope", {})
+
+
+def test_compute_node_create_starts_daemon(monkeypatch):
+    fake = FakeGCP()
+    runners = {}
+    prov = make_provider(fake, NODE_TYPES, runners)
+    pid = prov.create_node("cpu_worker")
+    entry = wait_ready(prov, pid)
+    assert len(entry["hosts"]) == 1
+    # cloud instance exists and carries the correlation labels
+    inst = list(fake.instances.values())[0]
+    assert inst["labels"]["ray-tpu-cluster"] == "t"
+    assert inst["labels"]["ray-tpu-node-type"] == "cpu-worker"
+    # one daemon start, joining the head, with the provider-node-id label
+    (runner,) = runners.values()
+    (cmd,) = runner.commands
+    assert "--address 127.0.0.1:7777" in cmd
+    assert "ray_tpu.io/provider-node-id" in cmd and pid in cmd
+
+
+def test_tpu_slice_fans_daemons_with_slice_labels():
+    """The flagship path: one provider node = a v4-16 slice = 2 hosts;
+    every host gets slice labels, worker 0 the TPU-head gang resource."""
+    fake = FakeGCP()
+    runners = {}
+    prov = make_provider(fake, NODE_TYPES, runners)
+    pid = prov.create_node("tpu_slice")
+    entry = wait_ready(prov, pid)
+    assert len(entry["hosts"]) == 2
+    assert len(runners) == 2
+    cmds = [r.commands[0] for r in runners.values()]
+    heads = 0
+    for cmd in cmds:
+        labels = json.loads(
+            re.search(r"--labels '({.*?})'", cmd).group(1))
+        assert labels["ray.io/tpu-slice-name"] == entry["name"]
+        assert labels["ray.io/tpu-pod-type"] == "v4-16"
+        assert labels["ray_tpu.io/provider-node-id"] == pid
+        assert labels["ray.io/tpu-worker-id"] in ("0", "1")
+        m = re.search(r"--resources '({.*?})'", cmd)
+        res = json.loads(m.group(1))
+        if "TPU-v4-16-head" in res:
+            heads += 1
+            assert labels["ray.io/tpu-worker-id"] == "0"
+    assert heads == 1, "exactly worker 0 must advertise the head resource"
+
+
+def test_terminate_deletes_cloud_instance():
+    fake = FakeGCP()
+    prov = make_provider(fake, NODE_TYPES, {})
+    pid = prov.create_node("tpu_slice")
+    wait_ready(prov, pid)
+    assert fake.tpu_nodes
+    prov.terminate_node(pid)
+    assert not fake.tpu_nodes, "TPU slice must be deleted on scale-down"
+    assert prov.non_terminated_nodes() == []
+
+
+def test_terminate_during_create_reaps(monkeypatch):
+    """terminate_node racing the background create must still delete the
+    instance once the create lands (no orphaned slices billing forever)."""
+    fake = FakeGCP()
+    runners = {}
+    gate = threading.Event()
+
+    class SlowRunner(RecordingRunner):
+        def run(self, cmd, timeout=None, env=None):
+            gate.wait(5)
+            return super().run(cmd, timeout=timeout, env=env)
+
+    prov = make_provider(fake, NODE_TYPES)
+    prov._make_runner = lambda cfg, auth: runners.setdefault(
+        cfg["host"], SlowRunner(cfg["host"]))
+    pid = prov.create_node("cpu_worker")
+    deadline = time.time() + 5
+    while not fake.instances and time.time() < deadline:
+        time.sleep(0.01)
+    prov.terminate_node(pid)      # mid-create: pid popped, not ready
+    gate.set()
+    deadline = time.time() + 5
+    while fake.instances and time.time() < deadline:
+        time.sleep(0.01)
+    assert not fake.instances, "raced create must reap its instance"
+
+
+def test_failed_create_releases_slot():
+    fake = FakeGCP()
+
+    def failing(method, url, body):
+        if method == "POST":
+            return 403, {"error": "quota"}
+        return fake(method, url, body)
+
+    prov = GCPNodeProvider(NODE_TYPES, "127.0.0.1:7777", project="p",
+                           zone="z", api=GCPApi("p", "z",
+                                                request_fn=failing,
+                                                op_poll_s=0.001))
+    pid = prov.create_node("cpu_worker")
+    deadline = time.time() + 5
+    while prov.non_terminated_nodes() and time.time() < deadline:
+        time.sleep(0.01)
+    assert prov.non_terminated_nodes() == []
+
+
+def test_tpu_command_runner_fans_out():
+    r1, r2 = RecordingRunner("a"), RecordingRunner("b")
+    fan = TPUCommandRunner([r1, r2])
+    rc, out = fan.run("echo hi")
+    assert rc == 0
+    assert r1.commands == ["echo hi"] and r2.commands == ["echo hi"]
+    assert "[worker 0]" in out and "[worker 1]" in out
+    fan.rsync_up("/src", "/dst")
+    assert ("rsync_up", "/src", "/dst") in r1.commands
+    assert ("rsync_up", "/src", "/dst") in r2.commands
+
+
+def test_launcher_up_down_gcp(monkeypatch, tmp_path):
+    """`ray-tpu up` with provider.type=gcp: creates the head VM, SSH-starts
+    the head, creates min_workers, records instances; `down` deletes them."""
+    from ray_tpu.autoscaler import gcp as gcp_mod
+    from ray_tpu.autoscaler import launcher
+
+    fake = FakeGCP()
+    monkeypatch.setattr(gcp_mod, "api_from_config",
+                        lambda cfg: make_api(fake))
+    monkeypatch.setattr(launcher, "CLUSTER_DIR", str(tmp_path))
+
+    runners = {}
+
+    class HeadAwareRunner(RecordingRunner):
+        def run(self, cmd, timeout=None, env=None):
+            self.commands.append(cmd)
+            if "--head" in cmd:
+                return 0, "started head at 127.0.0.1:7777 (pid 999)"
+            return 0, "node daemon started (pid 4242)"
+
+    def fake_make_runner(cfg, auth):
+        return runners.setdefault(cfg["host"],
+                                  HeadAwareRunner(cfg["host"]))
+
+    monkeypatch.setattr(launcher, "make_runner", fake_make_runner)
+    monkeypatch.setattr(
+        "ray_tpu.autoscaler.gcp.make_runner", fake_make_runner)
+
+    cfg = {
+        "cluster_name": "gcptest",
+        "provider": {"type": "gcp", "project": "proj",
+                     "zone": "us-central2-b", "create_timeout_s": 10},
+        "auth": {}, "env": {}, "setup_commands": [], "file_mounts": {},
+        "head_node": {"gcp": {"type": "compute",
+                              "machine_type": "n2-standard-4"}},
+        "worker_nodes": [],
+        "worker_node_types": {
+            "tpu_slice": {"resources": {"TPU": 8}, "max_nodes": 2,
+                          "min_workers": 1,
+                          "gcp": {"type": "tpu",
+                                  "accelerator_type": "v4-16"}}},
+    }
+    state = launcher.up(cfg, log=lambda *a: None)
+    # head VM + one TPU slice created on the fake cloud
+    assert len(fake.instances) == 1
+    assert len(fake.tpu_nodes) == 1
+    assert state["address"].endswith(":7777")
+    assert len(state["provider"]["instances"]) == 2
+    # the head got `start --head`, each slice host a join command
+    all_cmds = [c for r in runners.values() for c in r.commands]
+    assert any("--head" in c for c in all_cmds)
+    joins = [c for c in all_cmds if "--address" in c and "--head" not in c]
+    assert len(joins) == 2        # v4-16 -> 2 hosts
+    launcher.down("gcptest", log=lambda *a: None)
+    assert not fake.instances and not fake.tpu_nodes
+    assert launcher.load_state("gcptest") is None
+
+
+def test_autoscaler_gcp_scale_up_down_real_head():
+    """Full loop against a REAL head: demand → GCP create (fake cloud) →
+    daemon joins the cluster → task runs → idle → slice deleted from the
+    cloud. The command runner executes the daemon start locally, so the
+    'VM' is this machine."""
+    import subprocess
+
+    import ray_tpu
+    from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+    from ray_tpu.autoscaler.command_runner import LocalCommandRunner
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_tpu_chips=0, max_workers=4)
+    started_pids = []
+
+    class LocalVM(LocalCommandRunner):
+        def run(self, cmd, timeout=None, env=None):
+            rc, out = super().run(cmd, timeout=timeout, env=env)
+            from ray_tpu.autoscaler.launcher import parse_daemon_pid
+
+            dpid = parse_daemon_pid(out)
+            if dpid:
+                started_pids.append(dpid)
+            return rc, out
+
+    try:
+        fake = FakeGCP()
+        client = ray_tpu.core.api._global_client()
+        addr = f"127.0.0.1:{client.head_port}"
+        prov = GCPNodeProvider(
+            {"cpu4": {"resources": {"CPU": 4}, "max_nodes": 2,
+                      "gcp": {"type": "compute"}}},
+            addr, project="proj", zone="z", cluster_name="as",
+            api=make_api(fake))
+        prov._make_runner = lambda cfg, auth: LocalVM()
+        scaler = StandardAutoscaler(prov, idle_timeout_s=3.0,
+                                    poll_interval_s=0.5)
+        scaler.start()
+        try:
+            @ray_tpu.remote(num_cpus=4)
+            def big():
+                return "ran-on-gcp-node"
+
+            assert ray_tpu.get(big.remote(), timeout=90) == "ran-on-gcp-node"
+            assert scaler.num_launches >= 1
+            assert fake.instances or scaler.num_terminations, \
+                "instance should exist while task runs (or already reaped)"
+            deadline = time.time() + 60
+            while time.time() < deadline and prov.non_terminated_nodes():
+                time.sleep(0.5)
+            assert not prov.non_terminated_nodes(), "idle node not reclaimed"
+            assert not fake.instances, "cloud instance must be deleted"
+            assert scaler.num_terminations >= 1
+        finally:
+            scaler.stop()
+            prov.shutdown()
+    finally:
+        ray_tpu.shutdown()
+        for dpid in started_pids:   # the fake cloud can't kill real procs
+            subprocess.run(["kill", str(dpid)], capture_output=True)
+
+
+def test_provider_runner_for_slice_is_fanout():
+    fake = FakeGCP()
+    prov = make_provider(fake, NODE_TYPES, {})
+    pid = prov.create_node("tpu_slice")
+    wait_ready(prov, pid)
+    runner = prov.command_runner_for(pid)
+    assert isinstance(runner, TPUCommandRunner)
+    assert len(runner.runners) == 2
