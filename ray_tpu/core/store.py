@@ -30,6 +30,9 @@ from typing import Dict, Optional
 
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.serialization import SerializedObject
+from ray_tpu.utils import fs as _fs
+
+_fsopen = _fs.open  # spill files may live on fsspec storage (URIs)
 
 from ray_tpu.utils.platform import STATE_DIR
 
@@ -147,9 +150,15 @@ class SharedMemoryStore:
         self.isolated = bool(os.environ.get("RAY_TPU_STORE_ISOLATION"))
         tag = f"{self.namespace}_" if self.namespace else ""
         self._seg_prefix = f"rtpu_{tag}{session[:8]}_"
-        self.spill_dir = spill_dir or os.path.join(
-            STATE_DIR, session,
-            f"spill_{self.namespace}" if self.namespace else "spill")
+        # RAY_TPU_SPILL_DIR may be an fsspec URI (s3://..., memory://) —
+        # remote spill storage, reference external_storage.py:398
+        # ExternalStorageSmartOpenImpl
+        self.spill_dir = (spill_dir
+                          or os.environ.get("RAY_TPU_SPILL_DIR")
+                          or os.path.join(
+                              STATE_DIR, session,
+                              f"spill_{self.namespace}" if self.namespace
+                              else "spill"))
         self._segments: "OrderedDict[str, shared_memory.SharedMemory]" = OrderedDict()
         self._meta_by_segment: Dict[str, ObjectMeta] = {}
         self._pinned: Dict[str, int] = {}
@@ -295,7 +304,7 @@ class SharedMemoryStore:
             # so callers fall into the remote-pull path
             raise FileNotFoundError(meta.segment or meta.spill_path)
         if meta.kind == "spilled":
-            with open(meta.spill_path, "rb") as f:
+            with _fsopen(meta.spill_path, "rb") as f:
                 return SerializedObject.from_view(memoryview(f.read()))
         if meta.kind == "arena":
             arena = self._get_arena()
@@ -339,7 +348,7 @@ class SharedMemoryStore:
         if meta.kind == "spilled":
             # window read — a whole-file read per 4 MiB chunk would make
             # pulls of spilled objects O(size^2) in disk I/O
-            with open(meta.spill_path, "rb") as f:
+            with _fsopen(meta.spill_path, "rb") as f:
                 f.seek(offset)
                 return memoryview(f.read(end - offset)), None
         if meta.kind == "arena":
@@ -440,7 +449,7 @@ class SharedMemoryStore:
                 pass
         elif meta.kind == "spilled" and meta.spill_path:
             try:
-                os.remove(meta.spill_path)
+                _fs.rm(meta.spill_path)
             except OSError:
                 pass
 
@@ -456,7 +465,7 @@ class SharedMemoryStore:
         if used <= ARENA_HIGH_WATERMARK * cap:
             return
         needed = used - int(ARENA_LOW_WATERMARK * cap)
-        os.makedirs(self.spill_dir, exist_ok=True)
+        _fs.makedirs(self.spill_dir)
         for oid in arena.evict_candidates(needed):
             meta = self._arena_metas.pop(oid, None)
             if meta is None:
@@ -465,14 +474,14 @@ class SharedMemoryStore:
                 view = arena.get(oid, pin=False)
             except KeyError:
                 continue
-            path = os.path.join(self.spill_dir, oid.hex())
-            with open(path, "wb") as f:
+            path = _fs.join(self.spill_dir, oid.hex())
+            with _fsopen(path, "wb") as f:
                 f.write(view)
             del view
             if not arena.delete(oid, force=False):
                 # pinned between candidate selection and delete: keep it
                 try:
-                    os.remove(path)
+                    _fs.rm(path)
                 except OSError:
                     pass
                 self._arena_metas[oid] = meta
@@ -489,7 +498,7 @@ class SharedMemoryStore:
         """Spill LRU unpinned segments until `incoming` fits. Lock held."""
         if self.used + incoming <= self.capacity:
             return
-        os.makedirs(self.spill_dir, exist_ok=True)
+        _fs.makedirs(self.spill_dir)
         for name in list(self._segments):
             if self.used + incoming <= self.capacity:
                 break
@@ -497,8 +506,8 @@ class SharedMemoryStore:
                 continue
             shm = self._segments.pop(name)
             meta = self._meta_by_segment.pop(name, None)
-            path = os.path.join(self.spill_dir, name)
-            with open(path, "wb") as f:
+            path = _fs.join(self.spill_dir, name)
+            with _fsopen(path, "wb") as f:
                 f.write(shm.buf)
             self.used -= (meta.size if meta else shm.size)
             try:

@@ -585,26 +585,28 @@ class Dataset:
 
     # -------------------------------------------------------------- writers
     def write_parquet(self, path: str) -> None:
-        import os
-
-        import pyarrow as pa
         import pyarrow.parquet as pq
 
-        os.makedirs(path, exist_ok=True)
+        from ray_tpu.utils import fs as _fs
+
+        _fs.makedirs(path)
         for i, block in enumerate(self._stream_blocks()):
             table = block_to_batch(block, "pyarrow")
-            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+            with _fs.open(_fs.join(path, f"part-{i:05d}.parquet"),
+                          "wb") as f:
+                pq.write_table(table, f)
 
     def write_csv(self, path: str) -> None:
         """One CSV file per block (reference `Dataset.write_csv`)."""
         import csv
-        import os as _os
 
-        _os.makedirs(path, exist_ok=True)
+        from ray_tpu.utils import fs as _fs
+
+        _fs.makedirs(path)
         for i, block in enumerate(self._stream_blocks()):
             cols = to_numpy_columns(block)
-            out = _os.path.join(path, f"part-{i:05d}.csv")
-            with open(out, "w", newline="") as f:
+            out = _fs.join(path, f"part-{i:05d}.csv")
+            with _fs.open(out, "w", newline="") as f:
                 if isinstance(cols, dict):
                     w = csv.writer(f)
                     keys = list(cols)
@@ -626,9 +628,10 @@ class Dataset:
     def write_json(self, path: str) -> None:
         """One JSONL file per block (reference `Dataset.write_json`)."""
         import json as _json
-        import os as _os
 
-        _os.makedirs(path, exist_ok=True)
+        from ray_tpu.utils import fs as _fs
+
+        _fs.makedirs(path)
 
         def _py(v):
             if isinstance(v, np.generic):
@@ -638,8 +641,8 @@ class Dataset:
             return v
 
         for i, block in enumerate(self._stream_blocks()):
-            out = _os.path.join(path, f"part-{i:05d}.jsonl")
-            with open(out, "w") as f:
+            out = _fs.join(path, f"part-{i:05d}.jsonl")
+            with _fs.open(out, "w") as f:
                 for row in rows_of(block):
                     if isinstance(row, dict):
                         row = {k: _py(v) for k, v in row.items()}

@@ -2,37 +2,23 @@
 
 Parity (core subset) with `python/ray/data/read_api.py`: parquet/csv/json/
 text/binary/numpy readers produce one read thunk per file (or per range
-shard), executed lazily by the streaming executor.
+shard), executed lazily by the streaming executor. Paths resolve through
+`ray_tpu.utils.fs`, so every reader/writer accepts fsspec URIs
+(`gs://`, `s3://`, `memory://`) as well as local paths/globs.
 """
 
 from __future__ import annotations
 
 import functools
-import glob as glob_mod
 import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from ray_tpu.data.dataset import Dataset
+from ray_tpu.utils import fs as _fs
 
-
-def _expand_paths(paths) -> List[str]:
-    if isinstance(paths, str):
-        paths = [paths]
-    out: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            out.extend(sorted(
-                f for f in glob_mod.glob(os.path.join(p, "**"), recursive=True)
-                if os.path.isfile(f) and not os.path.basename(f).startswith(".")))
-        elif any(c in p for c in "*?["):
-            out.extend(sorted(glob_mod.glob(p)))
-        else:
-            out.append(p)
-    if not out:
-        raise FileNotFoundError(f"no files matched {paths}")
-    return out
+_expand_paths = _fs.expand_paths
 
 
 def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
@@ -80,7 +66,11 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
         import pyarrow.parquet as pq
 
         # arrow IS a block format: no eager numpy conversion — slices
-        # stay zero-copy views, consumers convert per-batch
+        # stay zero-copy views, consumers convert per-batch. Local paths
+        # go straight to pyarrow (memory-mapped); URIs via fsspec.
+        if _fs.is_uri(path):
+            with _fs.open(path, "rb") as f:
+                return pq.read_table(f, columns=columns)
         return pq.read_table(path, columns=columns)
 
     return Dataset([functools.partial(read_one, f) for f in files])
@@ -92,7 +82,8 @@ def read_csv(paths, **csv_kwargs) -> Dataset:
     def read_one(path):
         import pandas as pd
 
-        df = pd.read_csv(path, **csv_kwargs)
+        with _fs.open(path, "r") as f:
+            df = pd.read_csv(f, **csv_kwargs)
         return {c: df[c].to_numpy() for c in df.columns}
 
     return Dataset([functools.partial(read_one, f) for f in files])
@@ -105,7 +96,7 @@ def read_json(paths, *, lines: bool = True) -> Dataset:
         import json
 
         rows = []
-        with open(path) as f:
+        with _fs.open(path, "r") as f:
             if lines:
                 for line in f:
                     line = line.strip()
@@ -123,7 +114,7 @@ def read_text(paths) -> Dataset:
     files = _expand_paths(paths)
 
     def read_one(path):
-        with open(path) as f:
+        with _fs.open(path, "r") as f:
             return {"text": np.asarray([ln.rstrip("\n") for ln in f],
                                        dtype=object)}
 
@@ -134,7 +125,7 @@ def read_binary_files(paths) -> Dataset:
     files = _expand_paths(paths)
 
     def read_one(path):
-        with open(path, "rb") as f:
+        with _fs.open(path, "rb") as f:
             return [{"path": path, "bytes": f.read()}]
 
     return Dataset([functools.partial(read_one, f) for f in files])
@@ -144,7 +135,8 @@ def read_numpy(paths) -> Dataset:
     files = _expand_paths(paths)
 
     def read_one(path):
-        arr = np.load(path)
+        with _fs.open(path, "rb") as f:
+            arr = np.load(f)
         return {"data": arr}
 
     return Dataset([functools.partial(read_one, f) for f in files])
@@ -166,7 +158,8 @@ def read_images(paths, *, size=None, mode: str = "RGB") -> Dataset:
     def read_one(path):
         from PIL import Image
 
-        img = Image.open(path).convert(mode)
+        with _fs.open(path, "rb") as f:
+            img = Image.open(f).convert(mode)
         if size is not None:
             img = img.resize((size[1], size[0]))
         return [{"image": np.asarray(img), "path": path}]
@@ -338,7 +331,7 @@ def read_tfrecords(paths) -> Dataset:
 
     def read_one(path):
         rows = []
-        with open(path, "rb") as f:
+        with _fs.open(path, "rb") as f:
             while True:
                 header = f.read(12)
                 if len(header) < 12:

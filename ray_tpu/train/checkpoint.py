@@ -1,41 +1,52 @@
 """Checkpoint handle + top-K retention manager.
 
-Parity with `python/ray/train/_checkpoint.py` (directory-handle Checkpoint)
-and `train/v2/_internal/execution/checkpoint/checkpoint_manager.py` (top-K by
-metric per CheckpointConfig). Storage is a local/NFS path; TPU jobs write
-orbax/msgpack files into the directory — the framework only moves bytes.
+Parity with `python/ray/train/_checkpoint.py` (directory-handle Checkpoint
+over fsspec storage) and
+`train/v2/_internal/execution/checkpoint/checkpoint_manager.py` (top-K by
+metric per CheckpointConfig) + `v2/_internal/execution/storage.py`
+StorageContext (local→remote upload). `storage_path` may be a local/NFS
+path or any fsspec URI (`gs://bucket/run1`, `memory://...` in tests): the
+manager uploads worker-local checkpoint dirs and `as_directory()`
+materializes remote checkpoints back to a local temp dir on demand.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import shutil
 import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.train.config import CheckpointConfig
+from ray_tpu.utils import fs as _fs
 
 
 class Checkpoint:
-    """A handle to a directory of checkpoint files (reference Checkpoint)."""
+    """A handle to a directory of checkpoint files — local or remote
+    (reference Checkpoint)."""
 
     def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+        self.path = _fs.abspath(path)
+        self._local_cache: Optional[str] = None
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
 
     def as_directory(self) -> str:
-        return self.path
+        """A local directory with the checkpoint contents; remote
+        checkpoints download once per handle."""
+        if not _fs.is_uri(self.path):
+            return self.path
+        if self._local_cache is None or not os.path.isdir(self._local_cache):
+            self._local_cache = _fs.get_dir(
+                self.path, tempfile.mkdtemp(prefix="ckpt_dl_"))
+        return self._local_cache
 
     def to_directory(self, path: Optional[str] = None) -> str:
         dest = path or tempfile.mkdtemp(prefix="ckpt_")
-        if os.path.abspath(dest) != self.path:
-            shutil.copytree(self.path, dest, dirs_exist_ok=True)
-        return dest
+        return _fs.get_dir(self.path, dest)
 
     def __repr__(self):
         return f"Checkpoint({self.path})"
@@ -52,16 +63,16 @@ class CheckpointManager:
         self.config = config or CheckpointConfig()
         self.tracked: List[Dict[str, Any]] = []  # {path, metrics, index}
         self._index = 0
-        os.makedirs(storage_path, exist_ok=True)
+        _fs.makedirs(storage_path)
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Optional[Dict[str, Any]] = None) -> Checkpoint:
-        """Copy a worker-local checkpoint into durable storage; evict per
-        top-K policy. Returns the durable handle."""
+        """Copy/upload a worker-local checkpoint into durable storage;
+        evict per top-K policy. Returns the durable handle."""
         self._index += 1
-        dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
-        if os.path.abspath(checkpoint.path) != dest:
-            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        dest = _fs.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        if _fs.abspath(checkpoint.path) != dest:
+            _fs.put_dir(checkpoint.as_directory(), dest)
         entry = {"path": dest, "metrics": metrics or {}, "index": self._index,
                  "time": time.time()}
         self.tracked.append(entry)
@@ -84,7 +95,7 @@ class CheckpointManager:
             return
         self.tracked.sort(key=self._score, reverse=True)
         for entry in self.tracked[k:]:
-            shutil.rmtree(entry["path"], ignore_errors=True)
+            _fs.rmtree(entry["path"], ignore_errors=True)
         self.tracked = self.tracked[:k]
         self._write_manifest()
 
@@ -99,18 +110,18 @@ class CheckpointManager:
         return Checkpoint(max(self.tracked, key=lambda e: e["index"])["path"])
 
     def _write_manifest(self) -> None:
-        manifest = os.path.join(self.storage_path, "checkpoints.json")
-        with open(manifest, "w") as f:
+        manifest = _fs.join(self.storage_path, "checkpoints.json")
+        with _fs.open(manifest, "w") as f:
             json.dump([{k: v for k, v in e.items()} for e in self.tracked], f)
 
     @classmethod
     def restore(cls, storage_path: str,
                 config: Optional[CheckpointConfig] = None) -> "CheckpointManager":
         mgr = cls(storage_path, config)
-        manifest = os.path.join(storage_path, "checkpoints.json")
-        if os.path.exists(manifest):
-            with open(manifest) as f:
+        manifest = _fs.join(storage_path, "checkpoints.json")
+        if _fs.exists(manifest):
+            with _fs.open(manifest, "r") as f:
                 mgr.tracked = [e for e in json.load(f)
-                               if os.path.isdir(e["path"])]
+                               if _fs.isdir(e["path"])]
             mgr._index = max((e["index"] for e in mgr.tracked), default=0)
         return mgr

@@ -1,0 +1,168 @@
+"""Pluggable-filesystem tests: Data IO, checkpoints, and spill against an
+fsspec `memory://` filesystem (the offline stand-in for `gs://`).
+
+Mirrors the reference's fsspec/pyarrow storage tests
+(`python/ray/train/v2/tests/test_storage.py`, data read/write filesystem
+tests) — the point is that every path-taking surface accepts a URI.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.utils import fs as _fs
+
+
+@pytest.fixture(autouse=True)
+def clean_memory_fs():
+    import fsspec
+
+    fs = fsspec.filesystem("memory")
+    try:
+        fs.rm("/", recursive=True)
+    except Exception:
+        pass
+    yield
+    try:
+        fs.rm("/", recursive=True)
+    except Exception:
+        pass
+
+
+def test_fs_primitives_memory():
+    _fs.makedirs("memory://d/sub")
+    with _fs.open("memory://d/sub/a.txt", "w") as f:
+        f.write("hi")
+    assert _fs.exists("memory://d/sub/a.txt")
+    assert _fs.isfile("memory://d/sub/a.txt")
+    assert _fs.isdir("memory://d/sub")
+    with _fs.open("memory://d/sub/b.txt", "w") as f:
+        f.write("yo")
+    files = _fs.expand_paths("memory://d")
+    assert [f.rsplit("/", 1)[-1] for f in files] == ["a.txt", "b.txt"]
+    assert _fs.glob("memory://d/sub/*.txt")
+    _fs.rm("memory://d/sub/a.txt")
+    assert not _fs.exists("memory://d/sub/a.txt")
+    _fs.rmtree("memory://d")
+    assert not _fs.exists("memory://d/sub/b.txt")
+
+
+def test_fs_put_get_dir(tmp_path):
+    src = tmp_path / "src" / "nested"
+    src.mkdir(parents=True)
+    (src / "x.bin").write_bytes(b"abc")
+    (tmp_path / "src" / "top.txt").write_text("t")
+    _fs.put_dir(str(tmp_path / "src"), "memory://up")
+    assert _fs.exists("memory://up/top.txt")
+    assert _fs.exists("memory://up/nested/x.bin")
+    out = _fs.get_dir("memory://up", str(tmp_path / "back"))
+    assert (tmp_path / "back" / "nested" / "x.bin").read_bytes() == b"abc"
+    assert (tmp_path / "back" / "top.txt").read_text() == "t"
+    assert out == str(tmp_path / "back")
+
+
+def test_data_parquet_roundtrip_remote():
+    import ray_tpu.data as rd
+
+    ds = rd.range(100, parallelism=4).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    ds.write_parquet("memory://bucket/out")
+    files = _fs.expand_paths("memory://bucket/out")
+    assert len(files) == 4 and all(f.endswith(".parquet") for f in files)
+    back = rd.read_parquet("memory://bucket/out")
+    rows = sorted(back.take_all(), key=lambda r: r["id"])
+    assert [r["sq"] for r in rows[:4]] == [0, 1, 4, 9]
+    assert len(rows) == 100
+
+
+def test_data_csv_json_remote():
+    import ray_tpu.data as rd
+
+    rd.from_items([{"a": 1}, {"a": 2}]).write_json("memory://j")
+    rows = rd.read_json(_fs.expand_paths("memory://j")).take_all()
+    assert sorted(r["a"] for r in rows) == [1, 2]
+
+    rd.from_numpy({"x": np.arange(3)}).write_csv("memory://c")
+    rows = rd.read_csv(_fs.expand_paths("memory://c")).take_all()
+    assert sorted(r["x"] for r in rows) == [0, 1, 2]
+
+
+def test_checkpoint_upload_and_resume(tmp_path):
+    from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+    from ray_tpu.train.config import CheckpointConfig
+
+    local = tmp_path / "wk"
+    local.mkdir()
+    (local / "weights.bin").write_bytes(b"\x01\x02")
+    mgr = CheckpointManager("memory://ckpts/run1",
+                            CheckpointConfig(num_to_keep=2))
+    c1 = mgr.register(Checkpoint(str(local)), {"loss": 3.0})
+    assert c1.path.startswith("memory://ckpts/run1/checkpoint_")
+    (local / "weights.bin").write_bytes(b"\x03\x04")
+    mgr.register(Checkpoint(str(local)), {"loss": 2.0})
+    (local / "weights.bin").write_bytes(b"\x05\x06")
+    mgr.register(Checkpoint(str(local)), {"loss": 1.0})
+    # top-K eviction happened on REMOTE storage
+    assert len(mgr.tracked) == 2
+    dirs = [p for p in _fs.listdir("memory://ckpts/run1")
+            if "checkpoint_" in p]
+    assert len(dirs) == 2
+
+    # resume from the manifest (a fresh process restoring the run)
+    mgr2 = CheckpointManager.restore("memory://ckpts/run1")
+    assert len(mgr2.tracked) == 2
+    latest = mgr2.latest_checkpoint()
+    # remote checkpoint materializes locally on demand
+    ldir = latest.as_directory()
+    with open(f"{ldir}/weights.bin", "rb") as f:
+        assert f.read() == b"\x05\x06"
+
+
+def test_checkpoint_best_by_metric_remote(tmp_path):
+    from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+    from ray_tpu.train.config import CheckpointConfig
+
+    local = tmp_path / "wk"
+    local.mkdir()
+    mgr = CheckpointManager(
+        "memory://ckpts/run2",
+        CheckpointConfig(num_to_keep=3, checkpoint_score_attribute="acc",
+                         checkpoint_score_order="max"))
+    for acc in (0.1, 0.9, 0.5):
+        (local / "m.txt").write_text(str(acc))
+        mgr.register(Checkpoint(str(local)), {"acc": acc})
+    best = mgr.best_checkpoint()
+    with _fs.open(_fs.join(best.path, "m.txt"), "r") as f:
+        assert f.read() == "0.9"
+
+
+def test_spill_restore_remote_storage():
+    """Object-store spill to an fsspec URI: watermark spill writes to the
+    remote filesystem and reads restore from it (reference
+    ExternalStorageSmartOpenImpl)."""
+    from ray_tpu.core.store import SharedMemoryStore
+
+    store = SharedMemoryStore(session="fstest", capacity_bytes=1 << 20,
+                              spill_dir="memory://spill/n1")
+    try:
+        payload = np.random.default_rng(0).integers(
+            0, 255, 700_000, dtype=np.uint8).tobytes()
+        metas = []
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.serialization import serialize
+
+        for i in range(3):   # 2.1 MB into a 1 MB store → spills
+            oid = ObjectID.generate()
+            metas.append(store.put_serialized(oid, serialize(payload)))
+        spilled = [m for m in metas if m.kind == "spilled"]
+        assert spilled, "capacity pressure must spill to the URI"
+        assert spilled[0].spill_path.startswith("memory://spill/n1")
+        assert _fs.exists(spilled[0].spill_path)
+        from ray_tpu.core.serialization import deserialize
+
+        got = deserialize(store.get_serialized(spilled[0]))
+        assert got == payload
+        # window read (chunked cross-node pull path)
+        view, rel = store.get_raw(spilled[0], offset=10, length=100)
+        assert len(view) == 100
+    finally:
+        store.shutdown()
