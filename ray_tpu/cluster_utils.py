@@ -44,6 +44,7 @@ class Cluster:
         self.port = int(line.split("=", 1)[1])
         self.address = f"127.0.0.1:{self.port}"
         self._nodes: List[subprocess.Popen] = []
+        self._node_ids: List[str] = []
 
     def add_node(self, num_cpus: float = 1, num_tpu_chips: int = 0,
                  resources: Optional[Dict[str, float]] = None,
@@ -70,17 +71,32 @@ class Cluster:
                                 env=node_env)
         line = proc.stdout.readline()
         assert line.startswith("RAY_TPU_NODE_ID="), line
+        node_id = line.strip().split("=", 1)[1]
         self._nodes.append(proc)
-        return line.strip().split("=", 1)[1]
+        self._node_ids.append(node_id)
+        return node_id
 
     def kill_node(self, node_id_or_index) -> None:
-        """Simulate node failure (reference RayletKiller pattern)."""
+        """Simulate node failure, by index or by the node id `add_node`
+        returned (reference RayletKiller pattern / `Cluster.remove_node`).
+        Targeted kills are what the chaos suite needs: 'kill the node the
+        actor landed on', not 'kill some node'."""
         if isinstance(node_id_or_index, int):
-            proc = self._nodes[node_id_or_index]
+            idx = node_id_or_index
         else:
-            raise NotImplementedError("kill by index")
+            idx = self._node_ids.index(str(node_id_or_index))
+        proc = self._nodes[idx]
         proc.kill()
         proc.wait(timeout=10)
+
+    def stop_node(self, node_id_or_index) -> None:
+        """SIGSTOP (hang, don't kill) a node daemon — the hung-process
+        case TCP-disconnect detection can't see."""
+        import signal
+
+        idx = (node_id_or_index if isinstance(node_id_or_index, int)
+               else self._node_ids.index(str(node_id_or_index)))
+        self._nodes[idx].send_signal(signal.SIGSTOP)
 
     def connect(self):
         import ray_tpu

@@ -64,6 +64,10 @@ class CoreClient:
 
         self.device_store = DeviceObjectStore()
         self._extra_handlers = dict(handlers or {})
+        # head liveness probes (answered on the client's loop thread, so a
+        # blocked user thread doesn't read as dead)
+        self._extra_handlers.setdefault("health_ping", self._on_health_ping)
+        self._extra_handlers.setdefault("pubsub", self._on_pubsub)
         # head→process push when the directory drops one of our device
         # objects (refcount reached zero)
         self._extra_handlers.setdefault("free_device_object",
@@ -153,6 +157,27 @@ class CoreClient:
                 self.store.free(snap)  # staged host copy dies with the value
             except Exception:
                 pass
+        return True
+
+    async def _on_health_ping(self):
+        return True
+
+    async def _on_pubsub(self, channel, msg):
+        """Head pubsub fan-in. actor_state transitions poison stale direct
+        connections: when the head declares an actor's worker dead while
+        its SOCKET is still open (hung process reaped by health checks),
+        in-flight direct calls would otherwise wait on a frozen peer
+        forever — closing the connection fails them into the resend path,
+        which re-resolves the restarted actor's address (reference:
+        ActorTaskSubmitter's GCS actor-state subscription)."""
+        if channel == "actor_state" and msg.get("state") in ("RESTARTING",
+                                                             "DEAD"):
+            aid = ActorID(msg["actor_id"])
+            addr = self._actor_addr_cache.pop(aid, None)
+            if addr is not None:
+                conn = self._direct.pop(addr, None)
+                if conn is not None and not conn.closed:
+                    asyncio.ensure_future(conn.close())
         return True
 
     async def _on_dump_stacks(self):
@@ -405,6 +430,11 @@ class CoreClient:
             port=self.direct_port, is_driver=self.is_driver,
             node_id=bytes.fromhex(node_id_hex) if node_id_hex else None,
             log_tag=os.environ.get("RAY_TPU_LOG_TAG"))
+        # actor failover needs to hear about restarts it can't observe via
+        # its own sockets (hung-worker reaping) — fire-and-forget so
+        # registration latency doesn't grow
+        asyncio.ensure_future(self.conn.request("subscribe",
+                                                channel="actor_state"))
         self.node_id = NodeID(self.node_info["node_id"])
         # negotiated flags: the head's values are authoritative for
         # cluster-shared semantics (config.py registry)

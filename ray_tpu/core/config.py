@@ -99,6 +99,16 @@ FLAGS: List[Flag] = [
     Flag("testing_rpc_failure", "RAY_TPU_TESTING_RPC_FAILURE", str, "",
          "Chaos injection: 'method:prob,...' (reference rpc_chaos)."),
     # ------------------------------------------------------------- memory
+    # ------------------------------------------------------------- health
+    Flag("health_check_interval_s", "RAY_TPU_HEALTH_CHECK_INTERVAL_S",
+         float, 5.0, "Liveness-probe cadence for workers/node daemons; "
+         "0 disables probing (reference gcs_health_check_manager)."),
+    Flag("health_check_timeout_s", "RAY_TPU_HEALTH_CHECK_TIMEOUT_S",
+         float, 5.0, "Per-probe reply deadline."),
+    Flag("health_check_misses", "RAY_TPU_HEALTH_CHECK_MISSES", int, 3,
+         "Consecutive missed probes before a hung-but-connected process "
+         "is declared dead (its socket is closed, triggering the normal "
+         "failure path: actor restart, lease revoke, task retry)."),
     Flag("memory_monitor", "RAY_TPU_MEMORY_MONITOR", bool, True,
          "OOM monitor kills the newest task when node memory crosses "
          "the threshold."),

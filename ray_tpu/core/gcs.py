@@ -1842,9 +1842,14 @@ class Head:
         self._release_spec_borrows(rec.spec)
 
     def _publish(self, channel: str, msg: dict) -> None:
-        for conn in self.subscribers.get(channel, []):
-            if not conn.closed:
-                conn.push("pubsub", channel=channel, msg=msg)
+        conns = self.subscribers.get(channel)
+        if not conns:
+            return
+        live = [c for c in conns if not c.closed]
+        if len(live) != len(conns):
+            self.subscribers[channel] = live   # prune dead subscribers
+        for conn in live:
+            conn.push("pubsub", channel=channel, msg=msg)
 
     # ------------------------------------------------------------------ pgs
     def _retry_pending_pgs(self) -> None:
@@ -2216,6 +2221,7 @@ class Head:
         self.data_port = await self._data_server.start(host=bind)
         self.head_node.data_addr = (None, self.data_port)
         asyncio.ensure_future(self._evict_loop())
+        asyncio.ensure_future(self._health_loop())
         from ray_tpu.core.job_manager import JobManager
 
         self.job_manager = JobManager(self.session, self.port)
@@ -2230,6 +2236,53 @@ class Head:
                 self._on_log_batch, batch))
         self._log_monitor.start()
         return self.port
+
+    async def _health_loop(self) -> None:
+        """Application-level liveness probes (reference
+        `gcs_health_check_manager.h:45`): TCP-disconnect reaping misses a
+        hung-but-connected process (SIGSTOP, deadlocked GIL, wedged PJRT
+        call) — its socket stays open while callers stall forever. Probe
+        every worker and node daemon on a cadence; after
+        `health_check_misses` consecutive timeouts, close its socket,
+        which drives the NORMAL failure path (actor restart per
+        max_restarts, lease revocation, task retry)."""
+        interval = _config.get("health_check_interval_s")
+        timeout = _config.get("health_check_timeout_s")
+        budget = max(1, _config.get("health_check_misses"))
+        if interval <= 0:
+            return
+        misses: Dict[bytes, int] = {}
+
+        async def probe(key: bytes, conn) -> None:
+            try:
+                await asyncio.wait_for(conn.request("health_ping"), timeout)
+                misses.pop(key, None)
+            except asyncio.TimeoutError:
+                m = misses.get(key, 0) + 1
+                misses[key] = m
+                if m >= budget:
+                    misses.pop(key, None)
+                    print(f"[ray_tpu] health: {budget} missed probes, "
+                          f"declaring process dead", flush=True)
+                    await conn.close()   # reap via the on_close path
+            except Exception:
+                misses.pop(key, None)   # disconnects reap themselves
+
+        while not self._shutdown:
+            await asyncio.sleep(interval)
+            probes = []
+            for w in list(self.workers.values()):
+                # drivers are probed too — a wedged driver holds leases
+                # and refs; its reap path already handles driver death
+                if w.conn is not None and not w.conn.closed:
+                    probes.append(probe(w.worker_id.binary(), w.conn))
+            for node in list(self.nodes.values()):
+                if node is self.head_node:
+                    continue
+                if node.conn is not None and not node.conn.closed:
+                    probes.append(probe(node.node_id.binary(), node.conn))
+            if probes:
+                await asyncio.gather(*probes, return_exceptions=True)
 
     def notify_task_done(self, w: WorkerInfo) -> None:
         if w.current_record is not None:

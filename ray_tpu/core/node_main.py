@@ -69,6 +69,7 @@ class NodeDaemon:
                 "shutdown_node": self._shutdown_node,
                 "free_object": self._free_object,
                 "adopt_object": self._adopt_object,
+                "health_ping": self._health_ping,
             },
             name="node")
         self.conn.on_close = lambda c: self.stopping.set()
@@ -107,6 +108,9 @@ class NodeDaemon:
                 self.session, f"node-{self.node_id.hex()[:12]}"),
             emit=_emit)
         self._log_monitor.start()
+
+    async def _health_ping(self):
+        return True
 
     async def _spawn_worker(self):
         from ray_tpu.core.resources import strip_device_env

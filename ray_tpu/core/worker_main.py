@@ -47,6 +47,13 @@ class WorkerRuntime:
                                      "exec_task": self._on_exec_task,
                                      "start_actor": self._on_start_actor,
                                      "cancel_task": self._on_cancel_task,
+                                     # liveness probe: answered on the event
+                                     # loop, so it proves the PROCESS is
+                                     # scheduled (tasks run on executor
+                                     # threads) — a SIGSTOP/GIL-wedged
+                                     # worker times out (reference
+                                     # gcs_health_check_manager.h)
+                                     "health_ping": self._on_health_ping,
                                  })
         self.task_executor = ThreadPoolExecutor(max_workers=1,
                                                 thread_name_prefix="task")
@@ -186,6 +193,9 @@ class WorkerRuntime:
                     except Exception:
                         pass
         return {"meta": meta, "retired": self._retiring}
+
+    async def _on_health_ping(self):
+        return True
 
     async def _on_cancel_task(self, task_id):
         ident = self._task_threads.get(task_id)
