@@ -86,6 +86,10 @@ class CoreClient:
             self._extra_handlers.setdefault("log_lines", self._on_log_lines)
         self._direct: Dict[Tuple[str, int], protocol.Connection] = {}
         self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
+        # compiled-DAG channels hosted by THIS process (created via the
+        # dag_chan_create direct RPC); plus the serving-side read pool
+        self._dag_channels: Dict[str, Any] = {}
+        self._dag_read_pool = None
         self.loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(target=self._run_loop, daemon=True,
                                              name="ray_tpu-client-loop")
@@ -160,6 +164,62 @@ class CoreClient:
         return True
 
     async def _on_health_ping(self):
+        return True
+
+    # ------------------------------------------- compiled-DAG channel plane
+    # Reference: remote-reader mutable objects
+    # (`python/ray/experimental/channel/shared_memory_channel.py`,
+    # `src/ray/core_worker/experimental_mutable_object_provider.cc`) — a
+    # channel lives in its WRITER's process; cross-node readers read
+    # through these RPCs on the writer process's direct server.
+
+    async def _on_dag_chan_create(self, name, capacity, num_readers):
+        from ray_tpu.dag.channel import Channel
+
+        if name not in self._dag_channels:
+            ch = Channel(name=name, capacity=capacity,
+                         num_readers=num_readers)
+            ch._rlock = threading.Lock()
+            self._dag_channels[name] = ch
+        return True
+
+    async def _on_dag_chan_read(self, name, last_seq, max_wait):
+        from ray_tpu.dag.channel import Channel, ChannelClosedError
+
+        ch = self._dag_channels.get(name)
+        if ch is None:
+            # a reader of a channel another local process created (the
+            # driver co-located with a worker): serve from an attachment
+            try:
+                ch = Channel.attach(name)
+            except Exception:
+                return {"closed": True}
+            ch._rlock = threading.Lock()
+            self._dag_channels[name] = ch
+        if self._dag_read_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._dag_read_pool = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="dag-read")
+
+        def blocking():
+            # reads share the channel's scratch buffer — serialize them
+            with ch._rlock:
+                try:
+                    seq, data = ch.read_raw(last_seq, timeout=max_wait)
+                    return {"seq": seq, "data": data}
+                except TimeoutError:
+                    return {"seq": last_seq, "data": None}
+                except ChannelClosedError:
+                    return {"closed": True}
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._dag_read_pool, blocking)
+
+    async def _on_dag_chan_close(self, name, unlink):
+        ch = self._dag_channels.pop(name, None)
+        if ch is not None:
+            ch.close(unlink=unlink)
         return True
 
     async def _on_pubsub(self, channel, msg):
@@ -400,6 +460,11 @@ class CoreClient:
                                    self._on_fetch_device_object)
         direct_handlers.setdefault("fetch_device_ici",
                                    self._on_fetch_device_ici)
+        # compiled-DAG channel plane (process-level, independent of the
+        # actor executor — teardown works even while an exec loop runs)
+        direct_handlers.setdefault("dag_chan_create", self._on_dag_chan_create)
+        direct_handlers.setdefault("dag_chan_read", self._on_dag_chan_read)
+        direct_handlers.setdefault("dag_chan_close", self._on_dag_chan_close)
         # tracker active BEFORE the loop can dispatch anything: a task or
         # actor __init__ processed during registration may construct
         # ObjectRefs, and every one of them must be counted (else the head
@@ -609,6 +674,24 @@ class CoreClient:
     def head_request(self, method: str, **kwargs) -> Any:
         self._wait_connected()
         return self._call(self.conn.request(method, **kwargs))
+
+    def direct_request(self, addr, method: str, **kwargs) -> Any:
+        """Synchronous RPC to another process's direct server (connection
+        cached/shared with the actor-call path)."""
+        self._wait_connected()
+
+        async def go():
+            addr_t = (addr[0], int(addr[1]))
+            conn = self._direct.get(addr_t)
+            if conn is None or conn.closed:
+                reader_writer = await asyncio.open_connection(*addr_t)
+                conn = protocol.Connection(*reader_writer,
+                                           name=f"direct-{addr_t[1]}")
+                conn.start()
+                self._direct[addr_t] = conn
+            return await conn.request(method, **kwargs)
+
+        return self._call(go())
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any, owner: Optional[str] = None) -> ObjectRef:

@@ -496,7 +496,11 @@ class Head:
                 await info.ready_event.wait()
             if info.state == "DEAD":
                 return {"state": "DEAD", "death_cause": info.death_cause}
-            return {"state": info.state, "address": info.address}
+            return {"state": info.state, "address": info.address,
+                    # placement: compiled-DAG channel planning needs to
+                    # know which node each endpoint lives on
+                    "node_id": (info.worker.node_id.binary()
+                                if info.worker is not None else None)}
 
         async def get_named_actor(name, namespace):
             key = (namespace, name)

@@ -123,6 +123,31 @@ class Channel:
         # capacity-sized buffer first)
         return serialization.loads(ctypes.string_at(buf, ln.value))
 
+    def read_raw(self, last_seq: int, timeout: Optional[float] = None
+                 ) -> tuple:
+        """Stateless read: block for a value newer than `last_seq`, return
+        (seq, serialized bytes). The per-reader cursor lives with the
+        CALLER — this is what lets one attached channel serve any number
+        of remote readers through the dag_chan_read RPC (reference
+        remote-reader mutable objects,
+        `core_worker/experimental_mutable_object_provider.cc`)."""
+        buf = getattr(self, "_read_buf", None)
+        if buf is None:
+            cap = self._lib_ref.rtpu_chan_capacity(self._h)
+            buf = self._read_buf = ctypes.create_string_buffer(cap)
+        seq = ctypes.c_uint64()
+        ln = ctypes.c_uint64()
+        rc = self._lib_ref.rtpu_chan_read(
+            self._h, last_seq, buf, len(buf), ctypes.byref(seq),
+            ctypes.byref(ln), -1 if timeout is None else int(timeout * 1000))
+        if rc == -2:
+            raise ChannelClosedError(self.name)
+        if rc == -3:
+            raise TimeoutError(f"read from {self.name} timed out")
+        if rc != 0:
+            raise ChannelError(f"read failed rc={rc}")
+        return seq.value, ctypes.string_at(buf, ln.value)
+
     def close(self, unlink: bool = False) -> None:
         if self._h:
             self._lib_ref.rtpu_chan_close(self._h, 1 if unlink else 0)
@@ -131,3 +156,46 @@ class Channel:
     def __reduce__(self):
         # channels travel by name; receivers attach
         return (Channel.attach, (self.name,))
+
+
+class RemoteChannelReader:
+    """Read end of a channel hosted in ANOTHER node's process, over the
+    host process's direct server (`dag_chan_read`). Cross-node compiled
+    DAGs use these for every edge that spans nodes — the TPU payoff is
+    host-side PP stage pipelining across slices over DCN (SURVEY §3.7).
+
+    Per-reader state (the seq cursor) lives here; the serving side holds
+    one shared attachment, so N remote readers cost one channel."""
+
+    def __init__(self, name: str, addr):
+        self.name = name
+        self.addr = (addr[0], int(addr[1]))
+        self._last_seq = 0
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        import time as _time
+
+        from ray_tpu.core.api import _global_client
+
+        client = _global_client()
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            # bounded per-RPC wait keeps the serving side's reader threads
+            # from being parked indefinitely by an idle consumer
+            wait = 1.0
+            if deadline is not None:
+                wait = min(wait, deadline - _time.monotonic())
+                if wait <= 0:
+                    raise TimeoutError(f"read from {self.name} timed out")
+            reply = client.direct_request(
+                self.addr, "dag_chan_read", name=self.name,
+                last_seq=self._last_seq, max_wait=wait)
+            if reply.get("closed"):
+                raise ChannelClosedError(self.name)
+            if reply.get("data") is None:
+                continue   # server-side wait elapsed; retry until deadline
+            self._last_seq = reply["seq"]
+            return serialization.loads(reply["data"])
+
+    def close(self, unlink: bool = False) -> None:
+        pass   # the hosting process owns the channel's lifetime

@@ -1,19 +1,28 @@
-"""Compiled DAG execution: per-actor loops over native shm channels.
+"""Compiled DAG execution: per-actor loops over native shm channels,
+cross-node via remote-reader RPC channels.
 
 Lowering (reference `python/ray/dag/compiled_dag_node.py:809` CompiledDAG +
 `do_exec_tasks` :191): every ClassMethodNode becomes a READ→COMPUTE→WRITE
 step in a long-running loop pushed to its actor; edges become single-slot
-mutable shm channels (ray_tpu/_native/channel.cc). The driver writes input
-channels and blocks on output channels — per-iteration cost is condvar
-handoffs, bypassing the task RPC path entirely (SURVEY §3.7: µs-scale
-channel reads vs ~ms task overhead).
+mutable shm channels (ray_tpu/_native/channel.cc) living in the WRITER's
+process. The driver writes input channels and blocks on output channels —
+per-iteration cost is condvar handoffs, bypassing the task RPC path
+entirely (SURVEY §3.7: µs-scale channel reads vs ~ms task overhead).
+
+Cross-node edges (reference remote-reader mutable objects,
+`experimental/channel/shared_memory_channel.py` +
+`core_worker/experimental_mutable_object_provider.cc`): a consumer on a
+different node gets a `RemoteChannelReader` that reads through the writer
+process's direct server (`dag_chan_read`), so a compiled pipeline can span
+nodes — host-side PP stage pipelining across TPU slices over DCN.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.dag.channel import Channel, ChannelClosedError
+from ray_tpu.dag.channel import (Channel, ChannelClosedError,
+                                 RemoteChannelReader)
 from ray_tpu.dag.nodes import (ClassMethodNode, DAGNode, InputNode,
                                MultiOutputNode)
 
@@ -58,64 +67,132 @@ class CompiledDAG:
                 raise TypeError("compiled DAG outputs must be actor methods")
 
         # consumers per producing node: downstream method nodes + the driver
-        consumers: Dict[str, int] = {n.uuid: 0 for n in order}
+        self.consumers: Dict[str, int] = {n.uuid: 0 for n in order}
         for n in self.method_nodes:
             for up in n.upstream():
-                consumers[up.uuid] += 1
+                self.consumers[up.uuid] += 1
         for leaf in self.leaf_nodes:
-            consumers[leaf.uuid] += 1
+            self.consumers[leaf.uuid] += 1
 
-        # one channel per produced value (input node or method output)
-        self.channels: Dict[str, Channel] = {}
-        for n in self.input_nodes + self.method_nodes:
-            if consumers[n.uuid] == 0:
-                continue
-            self.channels[n.uuid] = Channel(
-                capacity=channel_capacity, num_readers=consumers[n.uuid])
-
-        # group steps by actor, preserving topo order
-        self.actor_schedules: Dict[Any, List[dict]] = {}
         self.actors: Dict[Any, Any] = {}
         for n in self.method_nodes:
-            handle = n.actor_handle
-            key = handle._actor_id
-            self.actors[key] = handle
-            arg_sources = []
-            for a in n.args:
-                if isinstance(a, DAGNode):
-                    arg_sources.append(("chan", self.channels[a.uuid].name))
-                else:
-                    arg_sources.append(("const", a))
-            kwarg_sources = {}
-            for k, v in n.kwargs.items():
-                if isinstance(v, DAGNode):
-                    kwarg_sources[k] = ("chan", self.channels[v.uuid].name)
-                else:
-                    kwarg_sources[k] = ("const", v)
-            out = self.channels.get(n.uuid)
-            self.actor_schedules.setdefault(key, []).append({
-                "method": n.method,
-                "args": arg_sources,
-                "kwargs": kwarg_sources,
-                "out_chan": out.name if out else None,
-            })
+            self.actors[n.actor_handle._actor_id] = n.actor_handle
 
+        # filled by _start (placement-dependent)
+        self.chan_names: Dict[str, str] = {}     # producing uuid -> name
+        self.input_channels: Dict[str, Channel] = {}
+        self.leaf_readers: List[Any] = []
+        self._remote_created: List[Tuple[Tuple[str, int], str]] = []
         self._loop_refs = []
         self._started = False
         self._torn_down = False
         self._pending: List[List[CompiledDAGRef]] = []
 
-    # ------------------------------------------------------------- control
+    # ------------------------------------------------------------ planning
     def _start(self) -> None:
+        import os
+
         from ray_tpu.core.api import _global_client
+        from ray_tpu.core.ids import NodeID
 
         client = _global_client()
+        my_node = client.node_id.binary()
+        my_addr = ("127.0.0.1", client.direct_port)
+
+        # placement of every endpoint
+        actor_node: Dict[Any, bytes] = {}
+        actor_addr: Dict[Any, Tuple[str, int]] = {}
+        for key in self.actors:
+            reply = client.head_request("get_actor_address",
+                                        actor_id=key.binary())
+            if reply["state"] == "DEAD":
+                raise RuntimeError(
+                    f"cannot compile over dead actor: "
+                    f"{reply.get('death_cause')}")
+            actor_node[key] = reply.get("node_id") or my_node
+            actor_addr[key] = tuple(reply["address"])
+
+        producer_key: Dict[str, Any] = {}       # uuid -> actor key | None
+        for n in self.method_nodes:
+            producer_key[n.uuid] = n.actor_handle._actor_id
+
+        def producer_node(uuid: str) -> bytes:
+            key = producer_key.get(uuid)
+            return my_node if key is None else actor_node[key]
+
+        def producer_addr(uuid: str) -> Tuple[str, int]:
+            key = producer_key.get(uuid)
+            return my_addr if key is None else actor_addr[key]
+
+        for n in self.input_nodes + self.method_nodes:
+            if self.consumers[n.uuid]:
+                self.chan_names[n.uuid] = f"rtpu_chan_{os.urandom(6).hex()}"
+
+        def chan_ref(up: DAGNode, consumer_node: bytes):
+            """How a consumer on `consumer_node` reads `up`'s output."""
+            name = self.chan_names[up.uuid]
+            if producer_node(up.uuid) == consumer_node:
+                return ("chan", name)
+            return ("rchan", (name, producer_addr(up.uuid)))
+
+        # create every channel IN ITS WRITER'S PROCESS before any loop
+        # starts (two-phase: no attach/create races)
+        for node in self.input_nodes:
+            if node.uuid not in self.chan_names:
+                continue
+            self.input_channels[node.uuid] = Channel(
+                name=self.chan_names[node.uuid], capacity=self.capacity,
+                num_readers=self.consumers[node.uuid])
+        for n in self.method_nodes:
+            if n.uuid not in self.chan_names:
+                continue
+            key = producer_key[n.uuid]
+            client.direct_request(
+                actor_addr[key], "dag_chan_create",
+                name=self.chan_names[n.uuid], capacity=self.capacity,
+                num_readers=self.consumers[n.uuid])
+            self._remote_created.append(
+                (actor_addr[key], self.chan_names[n.uuid]))
+
+        # per-actor schedules, channel refs resolved against placement
+        self.actor_schedules: Dict[Any, List[dict]] = {}
+        for n in self.method_nodes:
+            key = n.actor_handle._actor_id
+            node_of_actor = actor_node[key]
+            arg_sources = []
+            for a in n.args:
+                if isinstance(a, DAGNode):
+                    arg_sources.append(chan_ref(a, node_of_actor))
+                else:
+                    arg_sources.append(("const", a))
+            kwarg_sources = {}
+            for k, v in n.kwargs.items():
+                if isinstance(v, DAGNode):
+                    kwarg_sources[k] = chan_ref(v, node_of_actor)
+                else:
+                    kwarg_sources[k] = ("const", v)
+            self.actor_schedules.setdefault(key, []).append({
+                "method": n.method,
+                "args": arg_sources,
+                "kwargs": kwarg_sources,
+                "out_chan": self.chan_names.get(n.uuid),
+            })
+
+        # driver-side readers for the outputs
+        for leaf in self.leaf_nodes:
+            kind, val = chan_ref(leaf, my_node)
+            if kind == "chan":
+                self.leaf_readers.append(Channel.attach(val))
+            else:
+                self.leaf_readers.append(RemoteChannelReader(*val))
+
         for key, schedule in self.actor_schedules.items():
             ref = client.call_actor(key, "__rtpu_dag_exec_loop__",
                                     (schedule,), {})
             self._loop_refs.append(ref)
         self._started = True
 
+    # ------------------------------------------------------------- control
     def execute(self, *inputs) -> Any:
         """Write inputs; returns CompiledDAGRef(s) for the output value(s)."""
         if self._torn_down:
@@ -126,7 +203,7 @@ class CompiledDAG:
             raise ValueError(
                 f"need {len(self.input_nodes)} inputs, got {len(inputs)}")
         for node in self.input_nodes:
-            self.channels[node.uuid].write(inputs[node.index])
+            self.input_channels[node.uuid].write(inputs[node.index])
         refs = [CompiledDAGRef(self, i) for i in range(len(self.leaf_nodes))]
         self._pending.append(refs)
         return refs[0] if len(refs) == 1 else refs
@@ -136,10 +213,9 @@ class CompiledDAG:
         if not self._pending:
             raise RuntimeError("no execution in flight")
         refs = self._pending.pop(0)
-        for i, leaf in enumerate(self.leaf_nodes):
-            ch = self.channels[leaf.uuid]
+        for i, reader in enumerate(self.leaf_readers):
             try:
-                refs[i]._value = ch.read(timeout=timeout)
+                refs[i]._value = reader.read(timeout=timeout)
             except (ChannelClosedError, TimeoutError) as e:
                 refs[i]._value = e
             refs[i]._done = True
@@ -148,8 +224,21 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
-        for ch in self.channels.values():
+        for ch in self.input_channels.values():
             ch.close(unlink=True)
+        if self._started:
+            from ray_tpu.core.api import _global_client
+
+            client = _global_client()
+            # close writer-hosted channels THROUGH the process-level RPC:
+            # it runs on the worker's event loop, so it works even while
+            # the exec loop occupies the actor executor
+            for addr, name in self._remote_created:
+                try:
+                    client.direct_request(addr, "dag_chan_close",
+                                          name=name, unlink=True)
+                except Exception:
+                    pass
         if kill_actors:
             import ray_tpu
 

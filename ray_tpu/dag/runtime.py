@@ -1,45 +1,75 @@
 """Actor-side compiled-DAG loop (reference do_exec_tasks,
 compiled_dag_node.py:191): attach edge channels, then loop
-READ -> COMPUTE -> WRITE until the driver closes the channels."""
+READ -> COMPUTE -> WRITE until the driver closes the channels.
+
+Edge refs come in two flavors (resolved by the driver's placement pass):
+("chan", name)            — same-node: attach the shm channel directly
+("rchan", (name, addr))   — cross-node: RemoteChannelReader over the
+                            writer process's direct server
+"""
 
 from __future__ import annotations
 
 from typing import Any, Dict, List
 
-from ray_tpu.dag.channel import Channel, ChannelClosedError
+from ray_tpu.dag.channel import (Channel, ChannelClosedError,
+                                 RemoteChannelReader)
+
+
+def _ref_key(ref) -> tuple:
+    kind, val = ref
+    if kind == "chan":
+        return (kind, val)
+    name, addr = val
+    return (kind, name, (addr[0], int(addr[1])))
 
 
 def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
-    chans: Dict[str, Channel] = {}
+    readers: Dict[tuple, Any] = {}
+    writers: Dict[str, Channel] = {}
 
-    def chan(name: str) -> Channel:
-        if name not in chans:
-            chans[name] = Channel.attach(name)
-        return chans[name]
+    def reader(ref) -> Any:
+        key = _ref_key(ref)
+        if key not in readers:
+            if ref[0] == "chan":
+                readers[key] = Channel.attach(ref[1])
+            else:
+                name, addr = ref[1]
+                readers[key] = RemoteChannelReader(name, addr)
+        return readers[key]
 
-    # attach everything up front so the first iteration doesn't race creation
+    def writer(name: str) -> Channel:
+        if name not in writers:
+            writers[name] = Channel.attach(name)
+        return writers[name]
+
+    # attach everything up front so the first iteration doesn't race
+    # execution (the channels themselves were all created before any loop
+    # started — two-phase bring-up in CompiledDAG._start)
     for step in schedule:
-        for kind, val in list(step["args"]) + list(step["kwargs"].values()):
-            if kind == "chan":
-                chan(val)
+        for ref in list(step["args"]) + list(step["kwargs"].values()):
+            if ref[0] in ("chan", "rchan"):
+                reader(ref)
         if step["out_chan"]:
-            chan(step["out_chan"])
+            writer(step["out_chan"])
 
     iterations = 0
     try:
         while True:
             # one channel may feed several steps in an iteration: read once
-            read_cache: Dict[str, Any] = {}
+            read_cache: Dict[tuple, Any] = {}
 
-            def fetch(name: str) -> Any:
-                if name not in read_cache:
-                    read_cache[name] = chan(name).read()
-                return read_cache[name]
+            def fetch(ref) -> Any:
+                key = _ref_key(ref)
+                if key not in read_cache:
+                    read_cache[key] = reader(ref).read()
+                return read_cache[key]
 
             for step in schedule:
-                args = [fetch(v) if kind == "chan" else v
+                args = [fetch((kind, v)) if kind in ("chan", "rchan") else v
                         for kind, v in step["args"]]
-                kwargs = {k: (fetch(v) if kind == "chan" else v)
+                kwargs = {k: (fetch((kind, v)) if kind in ("chan", "rchan")
+                              else v)
                           for k, (kind, v) in step["kwargs"].items()}
                 result = getattr(instance, step["method"])(*args, **kwargs)
                 out = step["out_chan"]
@@ -47,7 +77,7 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
                     # same-actor downstream steps re-read the channel (their
                     # ack is counted in num_readers); single-slot channels
                     # support read-after-write in the same thread
-                    chan(out).write(result)
+                    writer(out).write(result)
             iterations += 1
     except ChannelClosedError:
         return iterations
