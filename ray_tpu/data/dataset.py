@@ -425,64 +425,47 @@ class Dataset:
             self._record_stats(len(self._partitions), nrows, _time.time() - t0)
             return
 
-        segs = self._segments()
-        exec_task = ray_tpu.remote(_exec_chain)
-        # one actor pool per actor-stage, shared across all blocks
-        pools: Dict[int, List[Any]] = {}
-        for i, (kind, op) in enumerate(segs):
-            if kind == "actor":
-                pools[i] = [_BlockActor.remote(op.fn)
-                            for _ in range(op.concurrency)]
-        rr: Dict[int, int] = {i: 0 for i in pools}
+        from ray_tpu.data.executor import (ActorStage, StreamingExecutor,
+                                           TaskStage)
 
-        def submit(partition_idx: int, src) -> Any:
-            """Chain every segment for one partition; returns final ref."""
-            ref = src
-            for i, (kind, seg_ops) in enumerate(segs):
-                if kind == "tasks":
-                    if seg_ops or i == 0:
-                        ref = exec_task.remote(ref, seg_ops)
-                else:
-                    pool = pools[i]
-                    actor = pool[rr[i] % len(pool)]
-                    rr[i] += 1
-                    op = seg_ops
-                    ref = actor.apply.remote(ref, op.batch_format)
-            return ref
+        # physical plan: fuse adjacent task ops into one TaskStage, one
+        # ActorStage per callable-class UDF (operator-graph Topology,
+        # reference streaming_executor.py:61)
+        stages: List[Any] = []
+        for i, (kind, seg) in enumerate(self._segments()):
+            if kind == "tasks":
+                if seg or i == 0:
+                    stages.append(TaskStage(seg))
+            else:
+                stages.append(ActorStage(seg))
 
         window = self._parallelism or DEFAULT_WINDOW
         # adaptive backpressure: unless the caller fixed parallelism, size
-        # the window by the byte budget as completed-block sizes come in —
-        # a fixed window of 8 is 8x too much memory for GB blocks and 8x
-        # too little parallelism for KB blocks
+        # the input window by the byte budget as completed-block sizes
+        # come in — a fixed window of 8 is 8x too much memory for GB
+        # blocks and 8x too little parallelism for KB blocks
         adapt = self._parallelism is None
-        bytes_seen, blocks_seen = 0, 0
-        pending: List[Any] = []
-        idx = 0
+        state = {"window": window, "bytes": 0, "blocks": 0}
+
+        def input_window() -> int:
+            if adapt and state["blocks"]:
+                avg = max(state["bytes"] // state["blocks"], 1)
+                state["window"] = min(MAX_WINDOW, max(
+                    MIN_WINDOW, int(DATA_MEMORY_BUDGET() // avg)))
+            self._last_window = state["window"]  # introspection
+            return state["window"]
+
+        executor = StreamingExecutor(stages, list(self._partitions),
+                                     input_window)
+        self._last_executor = executor   # per-op stats for stats()/tests
         emitted = 0
         results: Dict[int, Any] = {}
-        submitted = {}
         try:
-            while emitted < len(self._partitions):
-                if adapt and blocks_seen:
-                    avg = max(bytes_seen // blocks_seen, 1)
-                    window = min(MAX_WINDOW, max(
-                        MIN_WINDOW, int(DATA_MEMORY_BUDGET() // avg)))
-                self._last_window = window  # introspection (stats/tests)
-                while idx < len(self._partitions) and len(pending) < window:
-                    ref = submit(idx, self._partitions[idx])
-                    submitted[ref] = idx
-                    pending.append(ref)
-                    idx += 1
-                if not pending:
-                    break
-                ready, pending = ray_tpu.wait(pending, num_returns=1,
-                                              timeout=300)
-                for ref in ready:
-                    block = ray_tpu.get(ref)
-                    bytes_seen += block_nbytes(block)
-                    blocks_seen += 1
-                    results[submitted[ref]] = block
+            for idx, ref in executor.run():
+                block = ray_tpu.get(ref)
+                state["bytes"] += block_nbytes(block)
+                state["blocks"] += 1
+                results[idx] = block
                 # emit in order (deterministic, like ordered execution)
                 while emitted in results:
                     block = results.pop(emitted)
@@ -490,14 +473,9 @@ class Dataset:
                     yield block
                     emitted += 1
         finally:
-            # runs on GeneratorExit too: limit()/take() abandon the
-            # generator early and must not leak pool actors
-            for pool in pools.values():
-                for a in pool:
-                    try:
-                        ray_tpu.kill(a)
-                    except Exception:
-                        pass
+            # executor.run's finally kills pool actors on GeneratorExit
+            # (limit()/take() abandoning the stream must not leak them)
+            executor.close()
             self._record_stats(len(self._partitions), nrows,
                                _time.time() - t0)
 
@@ -506,13 +484,35 @@ class Dataset:
                             "wall_time_s": wall}
 
     def stats(self) -> str:
-        """Execution stats of the last run (reference `Dataset.stats()`)."""
+        """Execution stats of the last run (reference `Dataset.stats()`),
+        including per-operator rows when the operator-graph executor ran."""
         st = getattr(self, "_last_stats", None)
         if st is None:
             return "Dataset not executed yet"
-        return (f"{st['num_blocks']} blocks, {st['num_rows']} rows in "
-                f"{st['wall_time_s']:.3f}s "
-                f"({st['num_rows'] / max(st['wall_time_s'], 1e-9):.0f} rows/s)")
+        out = (f"{st['num_blocks']} blocks, {st['num_rows']} rows in "
+               f"{st['wall_time_s']:.3f}s "
+               f"({st['num_rows'] / max(st['wall_time_s'], 1e-9):.0f} rows/s)")
+        ex = getattr(self, "_last_executor", None)
+        if ex is not None:
+            for s in ex.per_op_stats():
+                out += f"\n  {s.summary()}"
+        return out
+
+    def explain(self) -> str:
+        """Logical op chain → physical stage plan (reference
+        `ExecutionPlan`/logical-plan repr): adjacent per-block ops fuse
+        into one task stage; callable-class UDFs become actor stages."""
+        logical = " -> ".join(["Read"] + [o.kind for o in self._ops])
+        phys = []
+        for i, (kind, seg) in enumerate(self._segments()):
+            if kind == "tasks":
+                if seg or i == 0:
+                    phys.append("TaskStage[" +
+                                (",".join(o.kind for o in seg) or "read") +
+                                "]")
+            else:
+                phys.append(f"ActorStage[{seg.kind} x{seg.concurrency}]")
+        return f"logical: {logical}\nphysical: {' -> '.join(phys)}"
 
     def _barrier_blocks(self) -> List[Block]:
         return list(self._stream_blocks())

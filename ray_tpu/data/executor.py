@@ -1,0 +1,206 @@
+"""Operator-graph streaming executor for Data pipelines.
+
+Behavioral parity with the reference's StreamingExecutor
+(`python/ray/data/_internal/execution/streaming_executor.py:61`,
+`streaming_executor_state.py` Topology/OpState,
+`backpressure_policy/concurrency_cap_backpressure_policy.py`): the op
+chain lowers to a Topology of physical stages, each with its own input
+queue, in-flight cap, and stats; the scheduling loop admits work to ANY
+stage with capacity, so a block can be in stage 3 while another is still
+in stage 1 — inter-operator concurrency, not a fused per-block chain.
+
+Differences from the reference are deliberate: stages run as cluster
+tasks/actor calls over ObjectRefs (blocks never pass through the driver),
+and the byte-budget backpressure from r4 governs INPUT admission (stage 0)
+— the equivalent of the reference's resource-budget policy with the
+budget measured from observed completed-block sizes.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class OpStats:
+    """Per-operator execution counters (reference OpState metrics +
+    `Dataset.stats()` per-op rows)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.submitted = 0
+        self.completed = 0
+        self.bytes_out = 0
+        self.first_submit_ts: Optional[float] = None
+        self.last_complete_ts: Optional[float] = None
+        # (submit_ts, complete_ts) per block — the overlap evidence
+        self.intervals: List[Tuple[float, float]] = []
+        self._open: Dict[Any, float] = {}
+
+    def on_submit(self, ref: Any) -> None:
+        now = time.monotonic()
+        self.submitted += 1
+        if self.first_submit_ts is None:
+            self.first_submit_ts = now
+        self._open[ref] = now
+
+    def on_complete(self, ref: Any, nbytes: int) -> None:
+        now = time.monotonic()
+        self.completed += 1
+        self.bytes_out += nbytes
+        self.last_complete_ts = now
+        start = self._open.pop(ref, now)
+        self.intervals.append((start, now))
+
+    def summary(self) -> str:
+        wall = ((self.last_complete_ts or 0) - (self.first_submit_ts or 0))
+        return (f"{self.name}: {self.completed} blocks, "
+                f"{self.bytes_out / 1e6:.2f} MB, {wall:.3f}s busy")
+
+
+class Stage:
+    """One physical operator: turns an upstream block ref into a
+    downstream block ref. `max_in_flight` is the per-op concurrency cap
+    (reference ConcurrencyCapBackpressurePolicy)."""
+
+    def __init__(self, name: str, max_in_flight: int = 16):
+        self.name = name
+        self.max_in_flight = max_in_flight
+        self.stats = OpStats(name)
+
+    def submit(self, ref: Any) -> Any:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class TaskStage(Stage):
+    """Fused chain of per-block task ops (reference TaskPoolMapOperator;
+    adjacent map/filter/flat_map fuse into ONE task — the physical-plan
+    fusion rule)."""
+
+    def __init__(self, ops: List[Any], max_in_flight: int = 16):
+        names = ",".join(o.kind for o in ops) or "read"
+        super().__init__(f"Map({names})", max_in_flight)
+        import ray_tpu
+        from ray_tpu.data.dataset import _exec_chain
+
+        self._task = ray_tpu.remote(_exec_chain)
+        self._ops = ops
+
+    def submit(self, ref: Any) -> Any:
+        return self._task.remote(ref, self._ops)
+
+
+class ActorStage(Stage):
+    """Callable-class UDF over a shared actor pool (reference
+    ActorPoolMapOperator). In-flight cap = pool size by default: one
+    outstanding call per actor keeps the pool busy without queue blowup."""
+
+    def __init__(self, op: Any):
+        super().__init__(f"ActorMap(x{op.concurrency})",
+                         max_in_flight=max(op.concurrency, 1))
+        from ray_tpu.data.dataset import _BlockActor
+
+        self._op = op
+        self.pool = [_BlockActor.remote(op.fn)
+                     for _ in range(max(op.concurrency, 1))]
+        self._rr = 0
+
+    def submit(self, ref: Any) -> Any:
+        actor = self.pool[self._rr % len(self.pool)]
+        self._rr += 1
+        return actor.apply.remote(ref, self._op.batch_format)
+
+    def close(self) -> None:
+        import ray_tpu
+
+        pool, self.pool = self.pool, []   # idempotent
+        for a in pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+class StreamingExecutor:
+    """Drives a Topology of stages over the input partitions.
+
+    Scheduling loop (reference streaming_executor.py:61): each tick,
+    admit queued blocks into every stage with spare in-flight capacity
+    (downstream-first, so finished work drains before new work enters),
+    then wait for ANY in-flight task across ALL stages and route its
+    output to the next stage's queue. Input admission (stage 0) is
+    additionally governed by the adaptive byte budget."""
+
+    def __init__(self, stages: List[Stage], partitions: List[Any],
+                 input_window: Callable[[], int]):
+        self.stages = stages
+        self.partitions = partitions
+        self.input_window = input_window
+        # per-stage input queues of (partition_idx, ref)
+        self.queues: List[deque] = [deque() for _ in stages]
+        self.in_flight: List[Dict[Any, int]] = [{} for _ in stages]
+        self.results: Dict[int, Any] = {}
+
+    def _admit(self) -> None:
+        for si in range(len(self.stages) - 1, -1, -1):
+            stage, q, fl = self.stages[si], self.queues[si], self.in_flight[si]
+            cap = stage.max_in_flight
+            if si == 0:
+                cap = min(cap, self.input_window())
+            while q and len(fl) < cap:
+                idx, ref = q.popleft()
+                out = stage.submit(ref)
+                stage.stats.on_submit(out)
+                fl[out] = idx
+
+    def run(self) -> Iterator[Tuple[int, Any]]:
+        """Yields (partition_idx, final block ref) as they complete —
+        UNORDERED; the caller handles ordered emission."""
+        import ray_tpu
+
+        next_input = 0
+        n = len(self.partitions)
+        emitted = 0
+        try:
+            while emitted < n:
+                # feed stage-0 queue lazily (partition thunks are cheap
+                # handles; real IO happens in the stage task)
+                while (next_input < n
+                       and len(self.queues[0]) + len(self.in_flight[0])
+                       < self.input_window()):
+                    self.queues[0].append(
+                        (next_input, self.partitions[next_input]))
+                    next_input += 1
+                self._admit()
+                all_refs = [r for fl in self.in_flight for r in fl]
+                if not all_refs:
+                    if next_input >= n:
+                        break
+                    continue
+                ready, _ = ray_tpu.wait(all_refs, num_returns=1, timeout=300)
+                for ref in ready:
+                    for si, fl in enumerate(self.in_flight):
+                        if ref in fl:
+                            idx = fl.pop(ref)
+                            # size probe rides the ref; fetching the block
+                            # is deferred to the consumer
+                            self.stages[si].stats.on_complete(ref, 0)
+                            if si + 1 < len(self.stages):
+                                self.queues[si + 1].append((idx, ref))
+                            else:
+                                emitted += 1
+                                yield idx, ref
+                            break
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        for s in self.stages:
+            s.close()
+
+    def per_op_stats(self) -> List[OpStats]:
+        return [s.stats for s in self.stages]
