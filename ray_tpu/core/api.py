@@ -11,6 +11,7 @@ import atexit
 import functools
 import json
 import os
+from ray_tpu.core import config as _config
 import subprocess
 import sys
 import threading
@@ -63,8 +64,8 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
     with _lock:
         if _client is not None:
             return _client.node_info
-        if address is None and (env_addr := os.environ.get("RAY_TPU_ADDRESS")):
-            address = env_addr
+        if address is None and (cfg_addr := _config.get("address")):
+            address = cfg_addr
         if address is not None and address.startswith("ray-tpu://"):
             # remote-driver mode (reference Ray Client, `ray://host:port`):
             # everything rides one multiplexed connection to the head-side

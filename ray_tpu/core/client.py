@@ -362,7 +362,7 @@ class CoreClient:
         skeleton = jax.tree_util.tree_unflatten(treedef, skeleton_leaves)
 
         def _send_all():
-            if os.environ.get("RAY_TPU_TESTING_ICI_DROP_SEND"):
+            if _config.get("testing_ici_drop_send"):
                 return  # chaos hook: reply sent, transfer never happens
             for leaf in dev_leaves:
                 group.send_device(leaf, dst_rank)
@@ -494,7 +494,8 @@ class CoreClient:
             "register_worker", worker_id=self.worker_id.binary(), pid=os.getpid(),
             port=self.direct_port, is_driver=self.is_driver,
             node_id=bytes.fromhex(node_id_hex) if node_id_hex else None,
-            log_tag=os.environ.get("RAY_TPU_LOG_TAG"))
+            log_tag=os.environ.get("RAY_TPU_LOG_TAG"),
+            venv_key=os.environ.get("RAY_TPU_VENV_KEY"))
         # actor failover needs to hear about restarts it can't observe via
         # its own sockets (hung-worker reaping) — fire-and-forget so
         # registration latency doesn't grow
@@ -505,7 +506,7 @@ class CoreClient:
         # cluster-shared semantics (config.py registry)
         _config.GLOBAL.adopt_head(self.node_info.get("config"))
         if (self.store.isolated and not self.store.namespace
-                and not os.environ.get("RAY_TPU_STORE_NAMESPACE")):
+                and not _config.get("store_namespace")):
             # isolation mode: our namespace is our node's — knowable only
             # after registration (no objects have been stored yet)
             self.store = SharedMemoryStore(
@@ -559,7 +560,8 @@ class CoreClient:
                     is_driver=self.is_driver,
                     node_id=(bytes.fromhex(node_id_hex)
                              if node_id_hex else None),
-                    log_tag=os.environ.get("RAY_TPU_LOG_TAG"))
+                    log_tag=os.environ.get("RAY_TPU_LOG_TAG"),
+                    venv_key=os.environ.get("RAY_TPU_VENV_KEY"))
             except Exception:
                 try:
                     await conn.close()

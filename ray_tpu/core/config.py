@@ -18,7 +18,12 @@ no introspection, no way to ask a running cluster what it's tuned to.
   `refcount` pioneered this in r3; the mechanism is now general.
 
 Adding a flag = one table row; reading env directly for a tunable is a
-review error.
+review error. NOT flags (deliberately): per-process identity the parent
+hands each child it spawns — RAY_TPU_{HEAD_PORT,SESSION,NODE_ID,LOG_TAG,
+VENV_KEY,JAX_COORDINATOR,JAX_NUM_PROCESSES,JAX_PROCESS_ID,NODE_IP} and
+the GKE-preset TPU_* facts. Those are arguments, not tunables: two
+processes on one host legitimately hold different values, so a shared
+registry would be wrong.
 """
 
 from __future__ import annotations
@@ -125,7 +130,7 @@ FLAGS: List[Flag] = [
     Flag("tracing", "RAY_TPU_TRACING", bool, False,
          "OpenTelemetry-style span export."),
     Flag("metrics_push_interval_s", "RAY_TPU_METRICS_PUSH_INTERVAL_S",
-         float, 5.0, "Worker metrics push cadence."),
+         float, 2.0, "Worker metrics push cadence."),
     # --------------------------------------------------------------- TPU
     Flag("num_chips", "RAY_TPU_NUM_CHIPS", int, -1,
          "Override TPU chip autodetection (-1 = autodetect)."),
@@ -144,8 +149,17 @@ FLAGS: List[Flag] = [
     # -------------------------------------------------------------- train
     Flag("torch_backend", "RAY_TPU_TORCH_BACKEND", str, "gloo",
          "torch.distributed backend for TorchTrainer."),
-    Flag("torch_timeout_s", "RAY_TPU_TORCH_TIMEOUT_S", float, 60.0,
+    Flag("torch_timeout_s", "RAY_TPU_TORCH_TIMEOUT_S", float, 120.0,
          "torch.distributed init timeout."),
+    # ------------------------------------------------------------ testing
+    Flag("testing_ici_drop_send", "RAY_TPU_TESTING_ICI_DROP_SEND", bool,
+         False, "Chaos: drop ICI device-object sends (transfer tests)."),
+    Flag("head_profile", "RAY_TPU_HEAD_PROFILE", str, "",
+         "Write a cProfile of the head event loop to this path on "
+         "SIGUSR1/exit."),
+    Flag("spill_dir", "RAY_TPU_SPILL_DIR", str, "",
+         "Object-spill directory; may be an fsspec URI (s3://, gs://) "
+         "for remote spill storage."),
 ]
 
 _BY_NAME: Dict[str, Flag] = {f.name: f for f in FLAGS}

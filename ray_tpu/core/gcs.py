@@ -95,6 +95,9 @@ class WorkerInfo:
         self.acquired_pg: Optional[PlacementGroupID] = None
         self.acquired_bundle: Optional[int] = None
         self.proc: Optional[subprocess.Popen] = None
+        # pip-isolated workers run a venv interpreter; tasks whose
+        # runtime_env carries the same pip_key route here exclusively
+        self.venv_key: Optional[str] = None
         self.current_record = None
         self.retiring = False  # max_calls reached; exiting after current task
         self.host: Optional[str] = None  # peer host of the registration conn
@@ -282,8 +285,8 @@ class Head:
         self.store = SharedMemoryStore(
             session, capacity_bytes=object_store_bytes, create_arena=True,
             namespace=(self.node_id.hex()[:8]
-                       if os.environ.get("RAY_TPU_STORE_ISOLATION")
-                       and not os.environ.get("RAY_TPU_STORE_NAMESPACE")
+                       if _config.get("store_isolation")
+                       and not _config.get("store_namespace")
                        else None))
         self.workers: Dict[WorkerID, WorkerInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
@@ -381,7 +384,7 @@ class Head:
                 return None
 
         async def register_worker(worker_id, pid, port, is_driver, node_id=None,
-                                  log_tag=None):
+                                  log_tag=None, venv_key=None):
             nid = NodeID(node_id) if node_id else self.node_id
             node = self.nodes.get(nid) or self.head_node
             w = WorkerInfo(WorkerID(worker_id), conn_state["conn"], pid, port,
@@ -389,6 +392,7 @@ class Head:
             w.host = _peer_host()  # reachable host for direct actor calls
             w.proc = self._spawned.pop(pid, None)
             w.log_tag = log_tag    # maps this worker to its log files
+            w.venv_key = venv_key
             self.workers[w.worker_id] = w
             conn_state["worker"] = w
             node.workers.add(w.worker_id)
@@ -1392,10 +1396,19 @@ class Head:
                 return b
         return None
 
-    def _idle_worker_on(self, node: NodeInfo) -> Optional[WorkerInfo]:
-        while node.idle:
-            w = node.idle.pop()
-            if not w.conn.closed:
+    def _idle_worker_on(self, node: NodeInfo,
+                        venv_key: Optional[str] = None
+                        ) -> Optional[WorkerInfo]:
+        # exact venv match both ways: plain tasks never land on a
+        # pip-isolated worker, pip tasks only on THEIR venv's workers
+        # (reference per-runtime-env worker pools, worker_pool.h:274)
+        for i in range(len(node.idle) - 1, -1, -1):
+            w = node.idle[i]
+            if w.conn.closed:
+                del node.idle[i]
+                continue
+            if w.venv_key == venv_key:
+                del node.idle[i]
                 return w
         return None
 
@@ -1439,6 +1452,8 @@ class Head:
         reason to stay queued ('resources' | 'worker') — or fails the task."""
         options = rec.spec["options"]
         resources = options.get("resources", {"CPU": 1})
+        renv = options.get("runtime_env") or {}
+        venv_key, pip = renv.get("pip_key"), renv.get("pip")
         if options.get("placement_group"):
             pg = self._pg_for(options)
             if pg is None:
@@ -1451,10 +1466,10 @@ class Head:
             node = self.nodes.get(bundle.node_id)
             if node is None or not node.alive:
                 return "resources"
-            w = self._idle_worker_on(node)
+            w = self._idle_worker_on(node, venv_key)
             if w is None:
                 for _ in range(max(1, want_workers)):
-                    self._request_worker(node)  # self-caps at max_workers
+                    self._request_worker(node, pip, venv_key)
                 return "worker"
             self._acquire(w, resources, pg, bundle)
         else:
@@ -1462,10 +1477,10 @@ class Head:
                                      options.get("scheduling_strategy", "hybrid"))
             if node is None:
                 return "resources"
-            w = self._idle_worker_on(node)
+            w = self._idle_worker_on(node, venv_key)
             if w is None:
                 for _ in range(max(1, want_workers)):
-                    self._request_worker(node)  # self-caps at max_workers
+                    self._request_worker(node, pip, venv_key)
                 return "worker"
             self._acquire(w, resources)
         w.running_task = rec.task_id
@@ -1507,6 +1522,8 @@ class Head:
     def _schedule_actor(self, info: ActorInfo) -> None:
         options = info.spec["options"]
         resources = options.get("resources", {"CPU": 0})
+        renv = options.get("runtime_env") or {}
+        venv_key, pip = renv.get("pip_key"), renv.get("pip")
         if options.get("placement_group"):
             pg = self._pg_for(options)
             if pg is None:
@@ -1519,9 +1536,9 @@ class Head:
             node = self.nodes.get(bundle.node_id)
             if node is None or not node.alive:
                 return
-            w = self._idle_worker_on(node)
+            w = self._idle_worker_on(node, venv_key)
             if w is None:
-                self._request_worker(node)
+                self._request_worker(node, pip, venv_key)
                 return
             self._acquire(w, resources, pg, bundle)
         else:
@@ -1529,9 +1546,9 @@ class Head:
                                      options.get("scheduling_strategy", "hybrid"))
             if node is None:
                 return
-            w = self._idle_worker_on(node)
+            w = self._idle_worker_on(node, venv_key)
             if w is None:
-                self._request_worker(node)
+                self._request_worker(node, pip, venv_key)
                 return
             self._acquire(w, resources)
         w.actor_id = info.actor_id
@@ -1539,15 +1556,16 @@ class Head:
         w.conn.push("start_actor", spec=info.spec)
 
     # -------------------------------------------------------------- workers
-    def _request_worker(self, node: NodeInfo) -> None:
+    def _request_worker(self, node: NodeInfo, pip=None,
+                        pip_key=None) -> None:
         alive = len(node.workers)
         if alive + node.starting_workers >= node.max_workers:
             return
         node.starting_workers += 1
         if node.conn is None:
-            self._spawn_local_worker()
+            self._spawn_local_worker(pip, pip_key)
         else:
-            node.conn.push("spawn_worker")
+            node.conn.push("spawn_worker", pip=pip, pip_key=pip_key)
 
     def _spawn_for_demand(self) -> None:
         # each queued-but-dispatchable task/actor has already issued a
@@ -1566,23 +1584,57 @@ class Head:
                                      worker_id=lw.worker_id.binary())
                     break
 
-    def _spawn_local_worker(self) -> None:
+    def _spawn_local_worker(self, pip=None, pip_key=None) -> None:
         from ray_tpu.core.resources import strip_device_env
-        from ray_tpu.core import worker_logs
 
         env = strip_device_env(dict(os.environ))
         env["RAY_TPU_HEAD_PORT"] = str(self.port)
         env["RAY_TPU_SESSION"] = self.session
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        if not pip:
+            self._popen_worker(sys.executable, env)
+            return
+        # venv materialization runs pip (seconds): NEVER on the head's
+        # event loop. Build on a thread, hop back to spawn.
+        from ray_tpu.core import runtime_env as _renv
+
+        env["RAY_TPU_VENV_KEY"] = pip_key or _renv.pip_env_key(pip)
+        loop = asyncio.get_event_loop()
+
+        def _build():
+            try:
+                python = _renv.materialize_venv(pip, pip_key)
+            except Exception as e:
+                print(f"[ray_tpu] venv materialization failed: {e!r}",
+                      flush=True)
+                # release the starting slot so the request can retry
+                loop.call_soon_threadsafe(self._venv_spawn_failed)
+                return
+            loop.call_soon_threadsafe(self._popen_worker, python, env)
+
+        import threading as _threading
+
+        _threading.Thread(target=_build, daemon=True,
+                          name="venv-build").start()
+
+    def _venv_spawn_failed(self) -> None:
+        self.head_node.starting_workers = max(
+            0, self.head_node.starting_workers - 1)
+        self._kick()
+
+    def _popen_worker(self, python: str, env: dict) -> None:
+        from ray_tpu.core import worker_logs
+
         # fd-level stdio capture into the session log dir (reference
         # node.py:1426 worker redirection); unbuffered so a task's print()
         # reaches the tailer (and the driver) promptly
         out, err, tag = worker_logs.open_worker_logs(self.session)
+        env = dict(env)
         env["RAY_TPU_LOG_TAG"] = tag
         env.setdefault("PYTHONUNBUFFERED", "1")
         with out, err:
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                [python, "-m", "ray_tpu.core.worker_main"],
                 env=env, stdout=out, stderr=err)
         self._spawned[proc.pid] = proc
 

@@ -93,7 +93,7 @@ async def amain(args) -> None:
 
 
 def main() -> None:
-    prof_path = os.environ.get("RAY_TPU_HEAD_PROFILE")
+    prof_path = _config.get("head_profile")
     if prof_path:
         import cProfile
         import signal as _signal

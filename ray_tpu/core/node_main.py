@@ -48,8 +48,8 @@ class NodeDaemon:
         self.store = None
         self.data_port: int = 0
         self._data_server: protocol.Server = None
-        isolation = bool(os.environ.get("RAY_TPU_STORE_ISOLATION"))
-        self.store_ns = os.environ.get("RAY_TPU_STORE_NAMESPACE") or (
+        isolation = _config.get("store_isolation")
+        self.store_ns = _config.get("store_namespace") or (
             self.node_id.hex()[:8] if isolation else "")
         self._create_arena = isolation
 
@@ -84,7 +84,7 @@ class NodeDaemon:
         self.store = SharedMemoryStore(
             self.session,
             capacity_bytes=(
-                int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", "0"))
+                int(_config.get("object_store_bytes"))
                 or _default_store_bytes()),
             create_arena=self._create_arena, namespace=self.store_ns)
         # spills retarget our local meta copy; the head owns the canonical
@@ -112,7 +112,7 @@ class NodeDaemon:
     async def _health_ping(self):
         return True
 
-    async def _spawn_worker(self):
+    async def _spawn_worker(self, pip=None, pip_key=None):
         from ray_tpu.core.resources import strip_device_env
         from ray_tpu.core import worker_logs
 
@@ -123,6 +123,18 @@ class NodeDaemon:
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         if self.store_ns:
             env["RAY_TPU_STORE_NAMESPACE"] = self.store_ns
+        python = sys.executable
+        if pip:
+            # pip-isolated worker: build/reuse the content-addressed venv
+            # OFF the daemon loop (first build runs pip install) and start
+            # the worker from its interpreter (reference
+            # runtime_env_agent.py:298 GetOrCreateRuntimeEnv + pip.py)
+            from ray_tpu.core import runtime_env as _renv
+
+            loop = asyncio.get_running_loop()
+            python = await loop.run_in_executor(
+                None, _renv.materialize_venv, pip, pip_key)
+            env["RAY_TPU_VENV_KEY"] = pip_key or _renv.pip_env_key(pip)
         # fd-level stdio capture; the daemon's LogMonitor tails these and
         # pushes appended lines to the head (reference log_monitor.py)
         out, err, tag = worker_logs.open_worker_logs(
@@ -132,7 +144,7 @@ class NodeDaemon:
         env.setdefault("PYTHONUNBUFFERED", "1")
         with out, err:
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                [python, "-m", "ray_tpu.core.worker_main"],
                 env=env, stdout=out, stderr=err)
         self.procs[proc.pid] = proc
         return proc.pid

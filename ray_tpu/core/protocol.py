@@ -24,7 +24,10 @@ _chaos: Dict[str, float] = {}
 
 def configure_chaos(spec: Optional[str] = None) -> None:
     _chaos.clear()
-    spec = spec if spec is not None else os.environ.get("RAY_TPU_TESTING_RPC_FAILURE", "")
+    if spec is None:
+        from ray_tpu.core import config as _config
+
+        spec = _config.get("testing_rpc_failure")
     for part in filter(None, (spec or "").split(",")):
         method, prob = part.rsplit(":", 1)
         _chaos[method] = float(prob)

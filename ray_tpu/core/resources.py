@@ -10,6 +10,7 @@ jax.devices / env rather than /dev/accel or GKE metadata).
 from __future__ import annotations
 
 import os
+from ray_tpu.core import config as _config
 from typing import Dict, Optional
 
 VALID_TPU_CHIP_COUNTS = (1, 2, 4, 8)
@@ -18,9 +19,9 @@ VALID_TPU_CHIP_COUNTS = (1, 2, 4, 8)
 def detect_num_tpu_chips() -> int:
     """Count locally attached TPU chips without initializing a backend when
     possible: explicit env override first, /dev scan next, jax last."""
-    env = os.environ.get("RAY_TPU_NUM_CHIPS")
-    if env is not None:
-        return int(env)
+    override = _config.get("num_chips")
+    if override >= 0:
+        return override
     try:
         import glob
 
@@ -60,8 +61,7 @@ def _gce_metadata(key: str) -> Optional[str]:
     global _gce_down
     if key in _gce_cache:
         return _gce_cache[key]
-    endpoint = os.environ.get("RAY_TPU_GCE_METADATA_ENDPOINT",
-                              GCE_METADATA_ENDPOINT)
+    endpoint = _config.get("gce_metadata_endpoint") or GCE_METADATA_ENDPOINT
     if _gce_down and endpoint == GCE_METADATA_ENDPOINT:
         return None
     import urllib.error
@@ -83,14 +83,14 @@ def _probe_metadata() -> bool:
     """Only touch the metadata server when this host plausibly has TPUs
     (or a test mock endpoint is set) — CPU-only nodes must not pay a
     resolve timeout at every bring-up."""
-    return (bool(os.environ.get("RAY_TPU_GCE_METADATA_ENDPOINT"))
+    return (bool(_config.get("gce_metadata_endpoint"))
             or detect_num_tpu_chips() > 0)
 
 
 def tpu_pod_type() -> Optional[str]:
     """Slice/pod type, e.g. 'v5e-64': env (GKE presets it) → GCE
     metadata `accelerator-type`."""
-    explicit = (os.environ.get("RAY_TPU_POD_TYPE")
+    explicit = (_config.get("pod_type")
                 or os.environ.get("TPU_ACCELERATOR_TYPE"))
     if explicit:
         return explicit
@@ -102,7 +102,7 @@ def tpu_pod_type() -> Optional[str]:
 def tpu_worker_id() -> int:
     # empty string == unset: lets a parent scrub inherited TPU identity
     # vars for child nodes without tripping int("")
-    env = (os.environ.get("RAY_TPU_WORKER_ID")
+    env = (_config.get("worker_id")
            or os.environ.get("TPU_WORKER_ID"))
     if env:
         return int(env)
@@ -117,7 +117,7 @@ def tpu_worker_id() -> int:
 
 
 def tpu_slice_name() -> Optional[str]:
-    explicit = (os.environ.get("RAY_TPU_SLICE_NAME")
+    explicit = (_config.get("slice_name")
                 or os.environ.get("TPU_NAME"))
     if explicit:
         return explicit

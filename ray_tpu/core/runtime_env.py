@@ -23,8 +23,8 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.utils.platform import STATE_DIR
 
 _EXTRACT_CACHE: Dict[str, str] = {}   # uri -> extracted dir (per process)
-_UNSUPPORTED = ("conda", "pip", "uv", "container", "image_uri", "java_jars")
-_SUPPORTED = ("env_vars", "working_dir", "py_modules")
+_UNSUPPORTED = ("conda", "uv", "container", "image_uri", "java_jars")
+_SUPPORTED = ("env_vars", "working_dir", "py_modules", "pip", "pip_key")
 
 
 def _zip_dir(path: str, prefix: str = "") -> bytes:
@@ -78,6 +78,13 @@ def package_runtime_env(client, renv: Optional[dict]) -> Optional[dict]:
                                 key=uri.encode(), value=data, overwrite=False)
         return uri
 
+    if renv.get("pip"):
+        pip = renv["pip"]
+        if isinstance(pip, dict):
+            pip = pip.get("packages", [])
+        pip = sorted(str(p) for p in pip)
+        out["pip"] = pip
+        out["pip_key"] = pip_env_key(pip)
     if renv.get("working_dir"):
         out["working_dir"] = upload(renv["working_dir"])
     if renv.get("py_modules"):
@@ -87,6 +94,77 @@ def package_runtime_env(client, renv: Optional[dict]) -> Optional[dict]:
             upload(p, prefix=os.path.basename(os.path.normpath(p)))
             for p in renv["py_modules"]]
     return out or None
+
+
+def pip_env_key(pip: List[str]) -> str:
+    """Content address of a pip requirement set — the worker-pool routing
+    key (reference: runtime env hash keying per-env worker pools,
+    `worker_pool.h` per-runtime-env pools)."""
+    import json
+
+    return hashlib.sha256(
+        json.dumps(sorted(pip)).encode()).hexdigest()[:16]
+
+
+def materialize_venv(pip: List[str], key: Optional[str] = None) -> str:
+    """Node side: build (or reuse) a content-addressed virtualenv with
+    `pip` installed; returns its python executable. Parity with the
+    reference's pip plugin (`python/ray/_private/runtime_env/pip.py` +
+    `agent/runtime_env_agent.py:298 GetOrCreateRuntimeEnv`).
+
+    The venv is created with --system-site-packages so the base image's
+    jax/numpy stay visible; installed requirements shadow them. Offline
+    clusters point pip at local wheels the standard way (PIP_NO_INDEX /
+    PIP_FIND_LINKS env vars, which pip reads natively).
+
+    Concurrency: first creator wins via atomic rename; losers reuse."""
+    import shutil
+    import subprocess
+
+    key = key or pip_env_key(pip)
+    root = os.path.join(STATE_DIR, "venvs")
+    dest = os.path.join(root, key)
+    python = os.path.join(dest, "bin", "python")
+    marker = os.path.join(dest, ".rtpu_ready")
+    if os.path.exists(marker):
+        return python
+    os.makedirs(root, exist_ok=True)
+    tmp = dest + f".tmp{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    subprocess.run([sys.executable, "-m", "venv", "--system-site-packages",
+                    tmp], check=True, capture_output=True)
+    # When THIS interpreter is itself a venv (common: /opt/venv images),
+    # --system-site-packages resolves to the BASE python's site dir, not
+    # ours — jax/numpy/cloudpickle would vanish. Graft our site-packages
+    # in via a .pth (processed after the venv's own dir, so installed
+    # requirements still shadow the parent's versions).
+    parent_sites = [p for p in sys.path
+                    if p.endswith(("site-packages", "dist-packages"))
+                    and os.path.isdir(p)]
+    if parent_sites:
+        vsite = os.path.join(
+            tmp, "lib", f"python{sys.version_info[0]}.{sys.version_info[1]}",
+            "site-packages")
+        with open(os.path.join(vsite, "_rtpu_parent_env.pth"), "w") as f:
+            f.write("\n".join(parent_sites) + "\n")
+    if pip:
+        proc = subprocess.run(
+            [os.path.join(tmp, "bin", "python"), "-m", "pip", "install",
+             "--no-input", "--disable-pip-version-check", *pip],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"pip install {pip} failed:\n{proc.stdout}{proc.stderr}")
+    with open(os.path.join(tmp, ".rtpu_ready"), "w") as f:
+        f.write(" ".join(pip))
+    try:
+        os.replace(tmp, dest)   # atomic publish; POSIX replaces empty only
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)
+        if not os.path.exists(marker):
+            raise
+    return python
 
 
 def _fetch_extract(client, uri: str) -> str:

@@ -28,6 +28,7 @@ from collections import OrderedDict
 from multiprocessing import shared_memory
 from typing import Dict, Optional
 
+from ray_tpu.core import config as _config
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.serialization import SerializedObject
 from ray_tpu.utils import fs as _fs
@@ -146,15 +147,15 @@ class SharedMemoryStore:
         # behave like real multi-host slices (object data must then travel
         # through the node data servers, reference object_manager.cc).
         self.namespace = (namespace if namespace is not None
-                          else os.environ.get("RAY_TPU_STORE_NAMESPACE", ""))
-        self.isolated = bool(os.environ.get("RAY_TPU_STORE_ISOLATION"))
+                          else _config.get("store_namespace"))
+        self.isolated = _config.get("store_isolation")
         tag = f"{self.namespace}_" if self.namespace else ""
         self._seg_prefix = f"rtpu_{tag}{session[:8]}_"
         # RAY_TPU_SPILL_DIR may be an fsspec URI (s3://..., memory://) —
         # remote spill storage, reference external_storage.py:398
         # ExternalStorageSmartOpenImpl
         self.spill_dir = (spill_dir
-                          or os.environ.get("RAY_TPU_SPILL_DIR")
+                          or _config.get("spill_dir")
                           or os.path.join(
                               STATE_DIR, session,
                               f"spill_{self.namespace}" if self.namespace
@@ -171,7 +172,7 @@ class SharedMemoryStore:
         self.owns_arena = create_arena
         self._arena = None
         self._arena_metas: Dict[bytes, ObjectMeta] = {}  # head-side, for spill
-        if create_arena and not os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
+        if create_arena and not _config.get("disable_native_store"):
             from ray_tpu.core import native_store
 
             try:
@@ -201,7 +202,7 @@ class SharedMemoryStore:
     def _get_arena(self):
         if self._arena is not None:
             return self._arena or None
-        if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
+        if _config.get("disable_native_store"):
             self._arena = False
             return None
         from ray_tpu.core import native_store

@@ -399,7 +399,9 @@ class WorkerRuntime:
 
 
 def main():
-    head_host = os.environ.get("RAY_TPU_HEAD_HOST", "127.0.0.1")
+    from ray_tpu.core import config as _config
+
+    head_host = _config.get("head_host")
     head_port = int(os.environ["RAY_TPU_HEAD_PORT"])
     session = os.environ["RAY_TPU_SESSION"]
     rt = WorkerRuntime(head_host, head_port, session)

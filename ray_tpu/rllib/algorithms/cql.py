@@ -1,0 +1,105 @@
+"""CQL: conservative Q-learning for offline RL.
+
+Parity: `rllib/algorithms/cql/` (cql.py + torch learner) — SAC machinery
+(twin Q, squashed-Gaussian actor, target nets, auto temperature) plus the
+CQL(H) conservative penalty: for each state, the critic is pushed DOWN on
+out-of-distribution actions (logsumexp over random + policy actions with
+importance correction) and UP on the dataset action, so the learned Q
+never over-values actions the behavior policy never took. Trains from the
+same offline-data seam as BC/MARWIL (`rllib/offline/` role).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.sac import SACLearner
+from ray_tpu.rllib.core.rl_module import ModuleSpec, spec_from_env
+
+
+class CQLLearner(SACLearner):
+    def loss(self, params, batch, rng) -> Tuple[jnp.ndarray, dict]:
+        sac_loss, metrics = super().loss(params, batch, rng)
+        c = self.cfg
+        n = c.cql_n_actions
+        obs = batch["obs"]
+        B = obs.shape[0]
+        A = self.module.spec.action_dim
+        k_rand, k_cur, k_next = jax.random.split(jax.random.fold_in(rng, 7), 3)
+
+        # candidate action sets (n, B, A): uniform + current-policy +
+        # next-state-policy samples (the CQL(H) estimator's proposal mix)
+        rand_a = jax.random.uniform(k_rand, (n, B, A), minval=-1.0,
+                                    maxval=1.0)
+        dist_cur = self.module.dist(params, obs)
+        dist_next = self.module.dist(params, batch["next_obs"])
+        cur_a, cur_logp = jax.vmap(dist_cur.sample_with_logp)(
+            jax.random.split(k_cur, n))
+        next_a, next_logp = jax.vmap(dist_next.sample_with_logp)(
+            jax.random.split(k_next, n))
+
+        def q_set(acts):
+            return jax.vmap(
+                lambda a: self.module.q_values(params, obs, a))(acts)
+
+        q1_r, q2_r = q_set(rand_a)
+        q1_c, q2_c = q_set(cur_a)
+        q1_n, q2_n = q_set(next_a)
+        # importance correction: uniform density (1/2)^A, policy densities
+        # exp(logp) — subtract log-density from each candidate's Q
+        log_unif = -A * jnp.log(2.0)
+        cat1 = jnp.concatenate(
+            [q1_r - log_unif, q1_c - cur_logp, q1_n - next_logp], axis=0)
+        cat2 = jnp.concatenate(
+            [q2_r - log_unif, q2_c - cur_logp, q2_n - next_logp], axis=0)
+        q1_data, q2_data = self.module.q_values(params, obs,
+                                                batch["actions"])
+        gap1 = (jax.scipy.special.logsumexp(cat1, axis=0)
+                - jnp.log(3 * n) - q1_data).mean()
+        gap2 = (jax.scipy.special.logsumexp(cat2, axis=0)
+                - jnp.log(3 * n) - q2_data).mean()
+        penalty = c.cql_alpha * (gap1 + gap2)
+        total = sac_loss + penalty
+        metrics = {**metrics, "cql_penalty": penalty,
+                   "cql_gap": 0.5 * (gap1 + gap2)}
+        return total, metrics
+
+
+class CQL(BC):
+    offline_columns = ("obs", "actions", "rewards", "next_obs", "dones")
+
+    def _module_spec(self, env) -> ModuleSpec:
+        spec = spec_from_env(env)
+        if spec.discrete:
+            raise ValueError("CQL targets Box action spaces (SAC-based)")
+        return ModuleSpec(**{**spec.__dict__, "squashed": True,
+                             "hiddens": tuple(self.config.hiddens)})
+
+    def _post_load(self, cols: dict) -> None:
+        self._extras = {
+            "rewards": cols["rewards"].astype(np.float32),
+            "next_obs": cols["next_obs"].astype(np.float32),
+            "dones": cols["dones"].astype(np.float32),
+        }
+
+    def _make_learner(self, mesh):
+        return CQLLearner(self.module_spec, self.config, mesh=mesh)
+
+
+class CQLConfig(BCConfig):
+    algo_class = CQL
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.tau = 0.005
+        self.train_batch_size = 256
+        self.num_updates_per_iteration = 32
+        self.cql_alpha = 1.0
+        self.cql_n_actions = 4

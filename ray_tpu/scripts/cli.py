@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+from ray_tpu.core import config as _cfg
 import signal
 import subprocess
 import sys
@@ -28,7 +29,7 @@ def _save_address(addr: str) -> None:
 
 
 def _resolve_address(args) -> str:
-    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
+    addr = getattr(args, "address", None) or _cfg.get("address") or None
     if not addr and os.path.exists(ADDR_FILE):
         addr = open(ADDR_FILE).read().strip()
     if not addr:

@@ -13,6 +13,7 @@ trainer here.
 from __future__ import annotations
 
 import os
+from ray_tpu.core import config as _config
 from typing import List, Optional
 
 from ray_tpu.train.trainer import DataParallelTrainer
@@ -61,11 +62,10 @@ def maybe_init_torch_distributed() -> bool:
     if dist.is_initialized():
         return True
     dist.init_process_group(
-        backend=os.environ["RAY_TPU_TORCH_BACKEND"],
+        backend=_config.get("torch_backend"),
         rank=int(os.environ["RANK"]),
         world_size=int(os.environ["WORLD_SIZE"]),
-        timeout=datetime.timedelta(seconds=float(
-            os.environ.get("RAY_TPU_TORCH_TIMEOUT_S", "120"))))
+        timeout=datetime.timedelta(seconds=_config.get("torch_timeout_s")))
     return True
 
 

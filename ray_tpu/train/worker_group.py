@@ -85,7 +85,9 @@ class TrainWorker:
         # Route toward the head when it is remote; head-spawned workers
         # have no RAY_TPU_HEAD_HOST (loopback), so fall back to the primary
         # outbound interface (UDP connect sends no packets).
-        for target in (os.environ.get("RAY_TPU_HEAD_HOST"), "8.8.8.8"):
+        from ray_tpu.core import config as _config
+
+        for target in (_config.get("head_host"), "8.8.8.8"):
             if not target or target.startswith("127."):
                 continue
             try:

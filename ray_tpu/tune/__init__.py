@@ -11,6 +11,8 @@ from ray_tpu.tune.search import (BasicVariantGenerator, choice, grid_search,
 from ray_tpu.tune.searcher import RandomSearcher, Searcher
 from ray_tpu.tune.optuna_search import OptunaSearch
 from ray_tpu.tune.hyperopt_search import HyperOptSearch
+from ray_tpu.tune.bayesopt_search import BayesOptSearch
+from ray_tpu.tune.bohb_search import BOHBSearch
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
                                 with_resources)
 
@@ -26,4 +28,5 @@ __all__ = [
     "BasicVariantGenerator", "FIFOScheduler", "ASHAScheduler",
     "MedianStoppingRule", "PopulationBasedTraining", "PB2",
     "Searcher", "RandomSearcher", "OptunaSearch", "HyperOptSearch",
+    "BayesOptSearch", "BOHBSearch",
 ]

@@ -18,7 +18,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 _REGISTRY: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "Metric"] = {}
 _LOCK = threading.Lock()
-_PUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_METRICS_PUSH_INTERVAL_S", "2.0"))
+def _PUSH_INTERVAL_S() -> float:
+    from ray_tpu.core import config as _config
+
+    return _config.get("metrics_push_interval_s")
 _pusher: Optional[threading.Thread] = None
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
@@ -144,7 +147,7 @@ def _ensure_pusher() -> None:
 
         def loop():
             while True:
-                time.sleep(_PUSH_INTERVAL_S)
+                time.sleep(_PUSH_INTERVAL_S())
                 _push_once()
 
         _pusher = threading.Thread(target=loop, daemon=True,

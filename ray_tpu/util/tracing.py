@@ -20,6 +20,7 @@ import contextlib
 import contextvars
 import dataclasses
 import os
+from ray_tpu.core import config as _config
 import secrets
 import threading
 import time
@@ -62,7 +63,7 @@ def enable_tracing(exporter=None) -> None:
 
 def is_enabled() -> bool:
     global _enabled
-    if not _enabled and os.environ.get("RAY_TPU_TRACING") == "1":
+    if not _enabled and _config.get("tracing"):
         _enabled = True
     return _enabled
 

@@ -74,7 +74,7 @@ def test_actor_runtime_env_for_life(cluster, tmp_path):
 
 def test_unsupported_keys_rejected(cluster):
     with pytest.raises(ValueError, match="not supported"):
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["x"]}})
         def f():
             return 1
 
@@ -89,3 +89,110 @@ def test_options_override(cluster, tmp_path):
     ref = read_env.options(
         runtime_env={"env_vars": {"VIA_OPTIONS": "yes"}}).remote()
     assert ray_tpu.get(ref) == "yes"
+
+
+# ----------------------------------------------------- pip/venv isolation
+def _make_wheel(dirpath, name="mypkg_rtpu_test", version="1.0",
+                body='MAGIC = "isolated-42"\n'):
+    """Hand-rolled minimal wheel (zip + dist-info) so the pip test stays
+    fully offline — mirrors the reference's use of local test wheels."""
+    import base64
+    import hashlib
+    import os
+    import zipfile
+
+    os.makedirs(dirpath, exist_ok=True)
+    whl = os.path.join(dirpath, f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": body,
+        f"{dist}/METADATA": (f"Metadata-Version: 2.1\nName: {name}\n"
+                             f"Version: {version}\n"),
+        f"{dist}/WHEEL": ("Wheel-Version: 1.0\nGenerator: test\n"
+                          "Root-Is-Purelib: true\nTag: py3-none-any\n"),
+    }
+    record_rows = []
+    for path, content in files.items():
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(content.encode()).digest()).rstrip(b"=").decode()
+        record_rows.append(f"{path},sha256={digest},{len(content)}")
+    record_rows.append(f"{dist}/RECORD,,")
+    files[f"{dist}/RECORD"] = "\n".join(record_rows) + "\n"
+    with zipfile.ZipFile(whl, "w") as zf:
+        for path, content in files.items():
+            zf.writestr(path, content)
+    return whl
+
+
+def test_materialize_venv_offline(tmp_path, monkeypatch):
+    from ray_tpu.core.runtime_env import materialize_venv, pip_env_key
+
+    _make_wheel(str(tmp_path / "wheels"))
+    monkeypatch.setenv("PIP_NO_INDEX", "1")
+    monkeypatch.setenv("PIP_FIND_LINKS", str(tmp_path / "wheels"))
+    import subprocess
+    import time as _time
+
+    pip = ["mypkg_rtpu_test"]
+    py = materialize_venv(pip)
+    out = subprocess.run(
+        [py, "-c", "import mypkg_rtpu_test; print(mypkg_rtpu_test.MAGIC)"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "isolated-42"
+    # the parent interpreter must NOT see the package (isolation)
+    import importlib.util
+
+    assert importlib.util.find_spec("mypkg_rtpu_test") is None
+    # content-addressed cache: second call is instant reuse
+    t0 = _time.monotonic()
+    py2 = materialize_venv(pip, pip_env_key(pip))
+    assert py2 == py and _time.monotonic() - t0 < 0.5
+
+
+def test_pip_runtime_env_isolated_worker(tmp_path, monkeypatch):
+    """End-to-end: a task with {"pip": [...]} runs on a venv worker that
+    can import the package; plain tasks run on workers that cannot
+    (reference runtime_env pip plugin + per-env worker pools)."""
+    import ray_tpu
+
+    _make_wheel(str(tmp_path / "wheels"))
+    monkeypatch.setenv("PIP_NO_INDEX", "1")
+    monkeypatch.setenv("PIP_FIND_LINKS", str(tmp_path / "wheels"))
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpu_chips=0, max_workers=6)
+    try:
+        @ray_tpu.remote(runtime_env={"pip": ["mypkg_rtpu_test"]})
+        def isolated():
+            import sys
+
+            import mypkg_rtpu_test
+
+            return mypkg_rtpu_test.MAGIC, sys.prefix
+
+        @ray_tpu.remote
+        def plain():
+            try:
+                import mypkg_rtpu_test  # noqa: F401
+
+                return "leaked"
+            except ImportError:
+                return "clean"
+
+        magic, prefix = ray_tpu.get(isolated.remote(), timeout=240)
+        assert magic == "isolated-42"
+        assert "venvs" in prefix, f"worker not in a venv: {prefix}"
+        assert ray_tpu.get(plain.remote(), timeout=60) == "clean"
+
+        # actors route to venv workers too
+        @ray_tpu.remote(runtime_env={"pip": ["mypkg_rtpu_test"]})
+        class Iso:
+            def magic(self):
+                import mypkg_rtpu_test
+
+                return mypkg_rtpu_test.MAGIC
+
+        a = Iso.remote()
+        assert ray_tpu.get(a.magic.remote(), timeout=120) == "isolated-42"
+    finally:
+        ray_tpu.shutdown()
