@@ -84,7 +84,9 @@ class LLMEngine:
                  max_seq_len: int = 128, seed: int = 0,
                  model_overrides: Optional[dict] = None,
                  checkpoint: Optional[str] = None,
-                 tokenizer: Any = None):
+                 tokenizer: Any = None,
+                 enable_prefix_caching: bool = True,
+                 kv_blocks: int = 64, kv_block_size: int = 16):
         import jax
         import jax.numpy as jnp
 
@@ -110,6 +112,16 @@ class LLMEngine:
         self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
         self.cache = gpt2.init_cache(self.cfg, max_batch, self.max_seq_len)
         cfg = self.cfg
+        # paged prefix cache: shared-prompt requests skip prefill for the
+        # cached span (reference: vLLM prefix caching behind serve.llm)
+        self.kv = None
+        if enable_prefix_caching:
+            from ray_tpu.serve.kv_cache import PagedKVCache
+
+            self.kv = PagedKVCache(cfg.n_layer, cfg.n_head, cfg.head_dim,
+                                   num_blocks=kv_blocks,
+                                   block_size=kv_block_size,
+                                   dtype=cfg.dtype)
 
         def _step(params, cache, tokens, pos, active):
             return gpt2.decode_step(params, cache, tokens, pos, active, cfg)
@@ -234,6 +246,17 @@ class LLMEngine:
                 self._slots[i] = req
                 self._slot_pos[i] = 0
                 self._slot_prefill[i] = list(req.prompt_ids)
+                if self.kv is not None and len(req.prompt_ids) > 1:
+                    # the last prompt token is always re-run (its logits
+                    # seed generation), so match against ids[:-1]
+                    n_hit, blocks = self.kv.match_prefix(
+                        req.prompt_ids[:-1])
+                    if n_hit:
+                        self.cache = self.kv.copy_into_slot(
+                            self.cache, i, blocks)
+                        self._slot_pos[i] = n_hit
+                        self._slot_prefill[i] = list(
+                            req.prompt_ids[n_hit:])
 
     def _sweep_streams(self) -> None:
         """Expire abandoned stream entries (client vanished): the sweep
@@ -280,6 +303,11 @@ class LLMEngine:
                     self._slot_prefill[i].pop(0)
                     if self._slot_prefill[i]:
                         continue  # still prefilling; ignore logits
+                    if self.kv is not None:
+                        # prompt fully resident in this slot's cache:
+                        # publish its full blocks for future prefix hits
+                        # (dedup'd: shared prefixes stored once)
+                        self.kv.store_prefix(req.prompt_ids, self.cache, i)
                 # sample the next token from this step's logits
                 if req.temperature > 0:
                     lg = logits[i] / req.temperature
@@ -349,8 +377,11 @@ class LLMServer:
         return self.engine.stream_next(stream_id, cursor=cursor)
 
     def stats(self) -> dict:
-        return {"total_generated": self.engine.total_generated,
-                "max_batch": self.engine.max_batch}
+        out = {"total_generated": self.engine.total_generated,
+               "max_batch": self.engine.max_batch}
+        if self.engine.kv is not None:
+            out["kv_cache"] = self.engine.kv.stats()
+        return out
 
     def check_health(self):
         if not self.engine._thread.is_alive():

@@ -43,8 +43,35 @@ class Request:
                           self.body, self.json))
 
 
+PREFIX_MAP_CAP = 2048       # remembered prompt-prefix -> replica pairs
+PREFIX_IMBALANCE_SLACK = 4  # cache affinity yields when this much busier
+
+
+def prompt_prefix_key(json_body) -> Optional[str]:
+    """Stable key for the prompt prefix of an LLM-shaped request body
+    (reference prefix_aware_router.py:39 — route requests sharing a
+    prefix to the replica whose KV cache already holds it)."""
+    if not isinstance(json_body, dict):
+        return None
+    text = None
+    if isinstance(json_body.get("prompt"), str):
+        text = json_body["prompt"]
+    elif isinstance(json_body.get("messages"), list):
+        try:
+            text = "".join(str(m.get("content", ""))
+                           for m in json_body["messages"])
+        except AttributeError:
+            return None
+    if not text:
+        return None
+    import hashlib
+
+    return hashlib.blake2b(text[:256].encode(), digest_size=8).hexdigest()
+
+
 class _AsyncRouter:
-    """Pow-2 replica choice with local in-flight counts, all-async."""
+    """Pow-2 replica choice with local in-flight counts, all-async;
+    optional prompt-prefix affinity (prefix-aware routing)."""
 
     def __init__(self, controller, deployment: str):
         self._controller = controller
@@ -53,6 +80,9 @@ class _AsyncRouter:
         self._model_map: Dict[str, list] = {}
         self._ts = 0.0
         self._inflight: Dict[str, int] = {}
+        from collections import OrderedDict
+
+        self._prefix_map: "OrderedDict[str, str]" = OrderedDict()
 
     async def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -69,7 +99,8 @@ class _AsyncRouter:
 
     async def submit(self, method: str, args: tuple, kwargs: dict,
                      model_id: Optional[str] = None,
-                     with_tag: bool = False):
+                     with_tag: bool = False,
+                     prefix_key: Optional[str] = None):
         await self._refresh()
         deadline = time.monotonic() + 30
         while not self._table:
@@ -84,12 +115,30 @@ class _AsyncRouter:
             if warm:
                 tags = warm
             kwargs = {**kwargs, "_multiplexed_model_id": model_id}
-        if len(tags) == 1:
-            tag = tags[0]
-        else:
-            a, b = random.sample(tags, 2)
-            tag = (a if self._inflight.get(a, 0) <= self._inflight.get(b, 0)
-                   else b)
+        tag = None
+        if prefix_key is not None and len(tags) > 1:
+            # cache affinity: a replica that served this prefix holds its
+            # KV blocks — prefer it unless clearly busier than the rest
+            # (reference PrefixAwareRequestRouter's imbalance threshold)
+            mapped = self._prefix_map.get(prefix_key)
+            if mapped in self._table and mapped in tags:
+                floor = min(self._inflight.get(t, 0) for t in tags)
+                if (self._inflight.get(mapped, 0)
+                        <= floor + PREFIX_IMBALANCE_SLACK):
+                    self._prefix_map.move_to_end(prefix_key)
+                    tag = mapped
+        if tag is None:
+            if len(tags) == 1:
+                tag = tags[0]
+            else:
+                a, b = random.sample(tags, 2)
+                tag = (a if self._inflight.get(a, 0)
+                       <= self._inflight.get(b, 0) else b)
+            if prefix_key is not None:
+                self._prefix_map[prefix_key] = tag
+                self._prefix_map.move_to_end(prefix_key)
+                while len(self._prefix_map) > PREFIX_MAP_CAP:
+                    self._prefix_map.popitem(last=False)
         result = await self.submit_on(tag, method, args, kwargs)
         return (result, tag) if with_tag else result
 
@@ -186,9 +235,9 @@ class ProxyActor:
                       dict(request.headers), body, json_body)
         model_id = request.headers.get("serve_multiplexed_model_id")
         try:
-            result, tag = await router.submit("__call__", (req,), {},
-                                              model_id=model_id,
-                                              with_tag=True)
+            result, tag = await router.submit(
+                "__call__", (req,), {}, model_id=model_id, with_tag=True,
+                prefix_key=prompt_prefix_key(json_body))
         except Exception as e:  # noqa: BLE001 - surface as HTTP 500
             return web.json_response({"error": repr(e)}, status=500)
         if isinstance(result, dict) and "__sse_stream__" in result:

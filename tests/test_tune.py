@@ -230,3 +230,76 @@ def test_pb2_exploits_with_gp(cluster, tmp_path):
     assert best.metrics["score"] > 0.6
     # the GP actually accumulated observations across trials
     assert len(sched._obs_y) >= 4
+
+
+def test_bayesopt_searcher_converges(cluster, tmp_path):
+    """Pure-numpy GP-EI searcher: later suggestions concentrate near the
+    optimum of a smooth 1-D objective (reference bayesopt_search.py
+    behavior, no bayesian-optimization dependency)."""
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.uniform(0.0, 5.0)},
+        tune_config=TuneConfig(metric="score", mode="min", num_samples=14,
+                               search_alg=tune.BayesOptSearch(
+                                   n_initial_points=4, seed=3)),
+        run_config=RunConfig(name="bayesopt", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 14
+    # _objective's score = (x - 3)^2; the GP concentrates near x=3
+    best = grid.get_best_result().config["x"]
+    assert abs(best - 3.0) < 0.8, f"GP-EI did not converge: best x={best}"
+
+
+def test_bayesopt_unit_math():
+    from ray_tpu.tune.bayesopt_search import BayesOptSearch
+
+    s = tune.BayesOptSearch(n_initial_points=2, seed=0)
+    s.set_search_properties("score", "max", {
+        "lr": tune.loguniform(1e-5, 1e-1),
+        "layers": tune.randint(1, 9),
+        "act": tune.choice(["relu", "gelu"]),
+        "fixed": 42})
+    for i in range(6):
+        cfg = s.suggest(f"t{i}")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        assert 1 <= cfg["layers"] <= 8
+        assert cfg["act"] in ("relu", "gelu")
+        assert cfg["fixed"] == 42
+        s.on_trial_complete(f"t{i}", {"score": -i}, error=False)
+    assert len(s._X) == 6
+
+
+def test_bohb_searcher_with_asha(cluster, tmp_path):
+    """KDE density-ratio searcher paired with ASHA early stopping — the
+    BOHB combination (reference TuneBOHB + HyperBandForBOHB)."""
+    from ray_tpu.tune.schedulers import ASHAScheduler
+
+    tuner = Tuner(
+        _long_objective,
+        param_space={"quality": tune.uniform(0.0, 5.0)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=10,
+            search_alg=tune.BOHBSearch(min_points_in_model=3, seed=5),
+            scheduler=ASHAScheduler(metric="loss", mode="min",
+                                    grace_period=2, max_t=8)),
+        run_config=RunConfig(name="bohb", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 10
+    best = grid.get_best_result().config["quality"]
+    assert best < 2.2, f"BOHB did not concentrate low: quality={best}"
+
+
+def test_bohb_model_phase_samples_from_good_region():
+    s = tune.BOHBSearch(min_points_in_model=4, random_fraction=0.0, seed=1)
+    s.set_search_properties("score", "max", {"x": tune.uniform(0.0, 1.0)})
+    # seed the model: good points cluster at 0.8
+    for i, (x, sc) in enumerate([(0.1, 0.0), (0.2, 0.1), (0.8, 10.0),
+                                 (0.82, 11.0), (0.78, 9.0)]):
+        tid = f"s{i}"
+        s._open[tid] = __import__("numpy").asarray([x])
+        s.on_trial_complete(tid, {"score": sc}, error=False)
+    xs = [s.suggest(f"m{i}")["x"] for i in range(8)]
+    near_good = sum(1 for x in xs if 0.6 <= x <= 1.0)
+    assert near_good >= 6, f"model-phase samples not concentrated: {xs}"
