@@ -160,6 +160,9 @@ FLAGS: List[Flag] = [
     Flag("spill_dir", "RAY_TPU_SPILL_DIR", str, "",
          "Object-spill directory; may be an fsspec URI (s3://, gs://) "
          "for remote spill storage."),
+    Flag("usage_stats", "RAY_TPU_USAGE_STATS", bool, False,
+         "Periodic usage-stats reporting (JSON lines under the state "
+         "dir by default; reference usage_lib — opt-IN here)."),
 ]
 
 _BY_NAME: Dict[str, Flag] = {f.name: f for f in FLAGS}

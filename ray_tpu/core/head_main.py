@@ -48,6 +48,9 @@ async def amain(args) -> None:
         from ray_tpu.core.memory_monitor import MemoryMonitor
 
         asyncio.ensure_future(MemoryMonitor(head).run())
+    from ray_tpu.util.usage_stats import start_usage_stats_heartbeat
+
+    start_usage_stats_heartbeat(args.session)  # no-op unless opted in
     # the head-port line must come first: init() parses it from stdout
     print(f"RAY_TPU_HEAD_PORT={port}", flush=True)
     if args.restore:

@@ -25,9 +25,19 @@ def resolve_attn_impl(attn_impl: str, seq_len: int) -> str:
     """Shared auto attention-implementation policy for all model families.
 
     auto → ring when the active mesh shards the sequence axis; else flash
-    only where it measured faster than XLA's fused dense attention on TPU
-    (v5e sweep 2026-07: dense wins through seq 1024; flash needs the T²
-    score matrix to dominate) — dense otherwise.
+    where it MEASURED faster than XLA's fused dense attention on real
+    TPU hardware — dense otherwise.
+
+    Measured on v5e (axon relay, 2026-07 r5), both levels:
+    - kernel fwd+bwd (B=4 H=12 Dh=64 bf16, benchmarks/
+      FLASH_CROSSOVER.json): dense wins at 1024 (flash 0.93x), tie at
+      2048 (0.99x), flash wins at 4096 (1.36x).
+    - FULL 125M train step (bench.py sweep): flash 44.9k vs dense 42.9k
+      tok/s/chip at T=2048 (+4.6%), 27.9k vs 16.9k at T=4096 (1.65x) —
+      in-model, skipping the T² score materialization also relieves
+      remat/HBM pressure, so flash breaks even EARLIER than the
+      isolated kernel suggests.
+    Crossover: flash from T >= 2048.
     """
     if attn_impl != "auto":
         return attn_impl

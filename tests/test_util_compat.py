@@ -223,3 +223,77 @@ def test_queue_graceful_shutdown(cluster):
 
     with _pytest.raises(Exception):
         q.get_nowait()
+
+
+# ------------------------------------------------------------- dask shim
+def test_ray_dask_get_plain_graph(cluster):
+    """ray_dask_get executes a hand-built dask-protocol graph over
+    cluster tasks (reference ray.util.dask scheduler)."""
+    from operator import add, mul
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    dsk = {
+        "a": 2,
+        "b": (mul, "a", 3),             # 6
+        "c": (add, "a", "b"),           # 8
+        "d": (sum, ["a", "b", "c"]),    # 16 (list-nested deps)
+    }
+    assert ray_dask_get(dsk, ["d", "c"]) == [16, 8]
+    assert ray_dask_get(dsk, "b") == 6
+
+
+def test_ray_dask_get_with_dask_if_available(cluster):
+    try:
+        import dask
+    except ImportError:
+        import pytest
+
+        pytest.skip("dask not installed")
+    import dask.delayed
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    @dask.delayed
+    def inc(x):
+        return x + 1
+
+    total = inc(1) + inc(2)
+    assert total.compute(scheduler=ray_dask_get) == 5
+
+
+def test_ray_dask_cycle_detection(cluster):
+    import pytest
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (len, "b"), "b": (len, "a")}, "a")
+
+
+# ----------------------------------------------------------- usage stats
+def test_usage_stats_opt_in_file_reporter(cluster, monkeypatch, tmp_path):
+    from ray_tpu.core import config as _config
+    from ray_tpu.util import usage_stats as us
+
+    # disabled by default: no thread
+    assert not us.start_usage_stats_heartbeat("s1", interval_s=0.1)
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS", "1")
+    got = []
+    us.record_library_usage("train")
+    us.record_library_usage("serve")
+    us.record_extra_usage_tag("test", "yes")
+    assert us.start_usage_stats_heartbeat("s1", interval_s=0.05,
+                                          reporter=got.append)
+    import time as _time
+
+    deadline = _time.time() + 5
+    while not got and _time.time() < deadline:
+        _time.sleep(0.05)
+    us.stop_usage_stats_heartbeat()
+    assert got, "reporter never fired"
+    payload = got[0]
+    assert payload["source"] == "ray_tpu"
+    assert "train" in payload["library_usages"]
+    assert payload["extra_usage_tags"]["test"] == "yes"
+    assert payload["session_id"] == "s1"
