@@ -328,6 +328,8 @@ class Head:
         # a short grace window that absorbs in-flight handoffs.
         self.refcount_enabled = _config.get("refcount")
         self.obj_holders: Dict[ObjectID, Set[WorkerID]] = {}
+        # bounded-wait lease requests served as workers free up
+        self._lease_waiters: list = []
         self.obj_pins: Dict[ObjectID, int] = {}
         self.worker_holds: Dict[WorkerID, Set[ObjectID]] = {}
         self.lineage_dep_pins: Dict[ObjectID, int] = {}
@@ -399,6 +401,7 @@ class Head:
             if not is_driver:
                 node.idle.append(w)
                 node.starting_workers = max(0, node.starting_workers - 1)
+                self._grant_lease_waiters(node)
                 self._kick()
             return {"node_id": node.node_id.binary(), "session": self.session,
                     "resources": node.resources, "labels": node.labels,
@@ -897,7 +900,13 @@ class Head:
             task pushes — the reference's lease protocol
             (`normal_task_submitter.cc:328` RequestWorkerLease + `:515`
             PushNormalTask): once granted, same-shape submissions bypass
-            this head entirely until the lease is released/revoked."""
+            this head entirely until the lease is released/revoked.
+
+            With no idle worker, the request WAITS (bounded) for the next
+            one instead of failing: under multi-client load the head-path
+            queue would otherwise swallow every freed worker before any
+            client could re-ask, starving leases exactly when they matter
+            most (the r4 multi-client throughput inversion)."""
             w = conn_state.get("worker")
             if w is None:
                 return None
@@ -906,13 +915,37 @@ class Head:
                                      options.get("scheduling_strategy",
                                                  "hybrid"))
             if node is None:
-                return None
+                # no node has the resources FREE right now — but a node
+                # whose total capacity covers the ask will free up; wait
+                # there instead of failing (under full load availability
+                # is zero by definition, yet that's exactly when a lease
+                # pays the most)
+                sel = options.get("label_selector")
+                feasible = [n for n in self._alive_nodes()
+                            if n.matches_labels(sel)
+                            and all(n.resources.get(r, 0) >= v
+                                    for r, v in resources.items())]
+                if not feasible:
+                    return None
+                node = min(feasible, key=lambda n: n.utilization())
             lw = self._idle_worker_on(node)
             if lw is None:
-                self._request_worker(node)  # warm the pool for a retry
-                return None
-            self._acquire(lw, resources)
+                self._request_worker(node)  # warm the pool
+                fut = asyncio.get_running_loop().create_future()
+                self._lease_waiters.append((resources, fut))
+                try:
+                    lw = await asyncio.wait_for(fut, timeout=1.0)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    try:
+                        self._lease_waiters.remove((resources, fut))
+                    except ValueError:
+                        pass
+                    return None
+                # granted pre-acquired by _grant_lease_waiters
+            else:
+                self._acquire(lw, resources)
             lw.leased_to = w.worker_id
+            self._last_dispatch_ts = time.monotonic()
             return {"worker_id": lw.worker_id.binary(),
                     "addr": (lw.host or "127.0.0.1", lw.port)}
 
@@ -1486,6 +1519,7 @@ class Head:
         w.running_task = rec.task_id
         w.current_record = rec
         rec.dispatch_ts = time.time()
+        self._last_dispatch_ts = time.monotonic()
         self._task_event(rec.task_id, rec.spec["options"].get("name", "task"),
                          "RUNNING", worker=w)
         w.conn.push("exec_task", spec=rec.spec)
@@ -1573,8 +1607,14 @@ class Head:
         # further to do here beyond a safety valve for empty pools
         if not self.queue:
             return
-        # fairness: queued work + leased-out workers → ask one holder to
-        # give its worker back (reference lease stealing/cancellation)
+        # fairness valve: reclaim a leased worker ONLY on a genuine
+        # dispatch stall (no task dispatched and no lease granted for a
+        # while with work queued). Revoking on every transient queue
+        # blip cancels leases the instant they're granted, and the
+        # resulting all-head-path traffic was the r4 multi-client
+        # throughput inversion.
+        if time.monotonic() - getattr(self, "_last_dispatch_ts", 0.0) < 0.5:
+            return
         for lw in self.workers.values():
             if lw.leased_to is not None:
                 holder = self.workers.get(lw.leased_to)
@@ -1582,6 +1622,7 @@ class Head:
                         and not holder.conn.closed):
                     holder.conn.push("lease_revoke",
                                      worker_id=lw.worker_id.binary())
+                    self._last_dispatch_ts = time.monotonic()  # one at a time
                     break
 
     def _spawn_local_worker(self, pip=None, pip_key=None) -> None:
@@ -2351,7 +2392,27 @@ class Head:
                 and w.leased_to is None
                 and node is not None and w not in node.idle):
             node.idle.append(w)
+            # waiting lease requests outrank the head-path queue: the
+            # lease turns EVERY future same-shape task of that client
+            # into a direct push, draining the queue's source
+            self._grant_lease_waiters(node)
         self._kick()
+
+    def _grant_lease_waiters(self, node: "NodeInfo") -> None:
+        while self._lease_waiters and node.idle:
+            resources, fut = self._lease_waiters[0]
+            if fut.done():
+                self._lease_waiters.pop(0)   # timed out / cancelled
+                continue
+            if any(node.available.get(r, 0) < v
+                   for r, v in resources.items()):
+                return
+            lw = self._idle_worker_on(node)
+            if lw is None:
+                return
+            self._lease_waiters.pop(0)
+            self._acquire(lw, resources)
+            fut.set_result(lw)
 
     def notify_actor_ready(self, info: ActorInfo, address) -> None:
         info.state = "ALIVE"
