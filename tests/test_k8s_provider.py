@@ -1,0 +1,154 @@
+"""Kubernetes node provider tests against a fake in-process API server
+(reference KubeRay-side scaling, tested the fake-API way the GCP
+provider is)."""
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.k8s import (K8sApi, K8sApiError, K8sNodeProvider,
+                                    LABEL_CLUSTER)
+
+
+class FakeK8s:
+    def __init__(self):
+        self.pods = {}
+        self.lock = threading.Lock()
+
+    def __call__(self, method, path, body):
+        m = re.search(r"/pods/([^/?]+)$", path)
+        if m:
+            name = m.group(1)
+            with self.lock:
+                if method == "GET":
+                    pod = self.pods.get(name)
+                    return (200, pod) if pod else (404, {})
+                if method == "DELETE":
+                    if self.pods.pop(name, None) is None:
+                        return 404, {}
+                    return 200, {"status": "Success"}
+        if "/pods" in path and method == "POST":
+            name = body["metadata"]["name"]
+            with self.lock:
+                if name in self.pods:
+                    return 409, {"reason": "AlreadyExists"}
+                pod = dict(body)
+                pod["status"] = {"phase": "Running",
+                                 "podIP": f"10.1.0.{len(self.pods) + 1}"}
+                self.pods[name] = pod
+            return 201, pod
+        if "/pods" in path and method == "GET":
+            sel = None
+            if "labelSelector=" in path:
+                from urllib.parse import unquote
+
+                sel = unquote(path.split("labelSelector=")[1])
+            with self.lock:
+                items = list(self.pods.values())
+            if sel:
+                k, v = sel.split("=", 1)
+                items = [p for p in items
+                         if p["metadata"]["labels"].get(k) == v]
+            return 200, {"items": items}
+        return 400, {"error": f"unhandled {method} {path}"}
+
+
+NODE_TYPES = {
+    "cpu_worker": {"resources": {"CPU": 4}, "max_nodes": 4,
+                   "k8s": {"image": "rt:test", "cpu": "4",
+                           "memory": "8Gi"}},
+    "tpu_worker": {"resources": {"TPU": 4}, "max_nodes": 2,
+                   "k8s": {"image": "rt:test", "tpu": "4",
+                           "node_selector": {
+                               "cloud.google.com/gke-tpu-topology": "2x2"}}},
+}
+
+
+def make_provider(fake):
+    return K8sNodeProvider(NODE_TYPES, "head.svc:7777",
+                           namespace="rtpu", cluster_name="kt",
+                           api=K8sApi("rtpu", request_fn=fake))
+
+
+def test_create_pod_manifest_shape():
+    fake = FakeK8s()
+    prov = make_provider(fake)
+    pid = prov.create_node("cpu_worker")
+    pod = fake.pods[pid]
+    assert pod["metadata"]["labels"][LABEL_CLUSTER] == "kt"
+    c = pod["spec"]["containers"][0]
+    assert c["image"] == "rt:test"
+    assert "--address" in c["command"]
+    assert c["command"][c["command"].index("--address") + 1] == \
+        "head.svc:7777"
+    assert "--block" in c["command"]
+    assert c["resources"]["requests"] == {"cpu": "4", "memory": "8Gi"}
+    # the provider-node-id label rides to the daemon for autoscaler
+    # correlation
+    labels = json.loads(c["command"][c["command"].index("--labels") + 1])
+    assert labels["ray_tpu.io/provider-node-id"] == pid
+    prov.wait_running(pid, timeout=5)
+
+
+def test_tpu_pod_resources_and_selector():
+    fake = FakeK8s()
+    prov = make_provider(fake)
+    pid = prov.create_node("tpu_worker")
+    pod = fake.pods[pid]
+    c = pod["spec"]["containers"][0]
+    assert c["resources"]["requests"]["google.com/tpu"] == "4"
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    assert pod["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-topology"] == "2x2"
+
+
+def test_terminate_and_reconcile():
+    fake = FakeK8s()
+    prov = make_provider(fake)
+    a = prov.create_node("cpu_worker")
+    b = prov.create_node("cpu_worker")
+    assert sorted(prov.non_terminated_nodes()) == sorted([a, b])
+    prov.terminate_node(a)
+    assert not fake.pods.get(a)
+    assert prov.non_terminated_nodes() == [b]
+    # a pod killed OUTSIDE the provider (eviction) reconciles away
+    fake.pods.pop(b)
+    assert prov.non_terminated_nodes() == []
+
+
+def test_create_failure_releases_slot():
+    fake = FakeK8s()
+
+    def failing(method, path, body):
+        if method == "POST":
+            return 403, {"reason": "quota"}
+        return fake(method, path, body)
+
+    prov = K8sNodeProvider(NODE_TYPES, "h:1", cluster_name="kt",
+                           api=K8sApi("d", request_fn=failing))
+    with pytest.raises(K8sApiError):
+        prov.create_node("cpu_worker")
+    assert prov.non_terminated_nodes() == []
+
+
+def test_autoscaler_loop_with_k8s_provider():
+    """bin-pack scale-up + idle scale-down drive pod create/delete
+    against the fake API (no real cluster: provider-level loop)."""
+    from ray_tpu.autoscaler.autoscaler import bin_pack
+
+    fake = FakeK8s()
+    prov = make_provider(fake)
+    plan = bin_pack([{"CPU": 4}, {"CPU": 4}, {"TPU": 4}],
+                    prov.node_types)
+    for t, count in plan.items():
+        for _ in range(count):
+            prov.create_node(t)
+    assert len(fake.pods) == 3
+    kinds = [p["metadata"]["labels"]["ray-tpu/node-type"]
+             for p in fake.pods.values()]
+    assert kinds.count("cpu_worker") == 2 and kinds.count("tpu_worker") == 1
+    prov.shutdown()
+    assert not fake.pods
