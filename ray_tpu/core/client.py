@@ -90,6 +90,8 @@ class CoreClient:
         # dag_chan_create direct RPC); plus the serving-side read pool
         self._dag_channels: Dict[str, Any] = {}
         self._dag_read_pool = None
+        # user pubsub subscriptions: channel -> [callback]
+        self._pubsub_callbacks: Dict[str, list] = {}
         self.loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(target=self._run_loop, daemon=True,
                                              name="ray_tpu-client-loop")
@@ -238,7 +240,23 @@ class CoreClient:
                 conn = self._direct.pop(addr, None)
                 if conn is not None and not conn.closed:
                     asyncio.ensure_future(conn.close())
+        for cb in self._pubsub_callbacks.get(channel, []):
+            try:
+                cb(msg)
+            except Exception:
+                pass   # a user callback must never break the loop
         return True
+
+    def subscribe_channel(self, channel: str, callback) -> None:
+        """Public pubsub: `callback(msg_dict)` for every event the head
+        publishes on `channel` (node_state / actor_state / object_state;
+        reference `src/ray/pubsub/` channels). Callbacks run on the
+        client's loop thread — hand off, don't block."""
+        first = channel not in self._pubsub_callbacks
+        self._pubsub_callbacks.setdefault(channel, []).append(callback)
+        if first and channel != "actor_state":   # actor_state: always subbed
+            self._wait_connected()
+            self._call(self.conn.request("subscribe", channel=channel))
 
     async def _on_dump_stacks(self):
         """Formatted stacks of every thread in this process (reference:

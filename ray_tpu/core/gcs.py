@@ -1232,6 +1232,9 @@ class Head:
                 try:
                     self._drop_object(oid)
                     self.objects_evicted += 1
+                    self._publish("object_state",
+                                  {"object_id": oid.binary(),
+                                   "state": "EVICTED"})
                 except Exception as e:
                     # one failing free (e.g. BufferError on an exported shm
                     # mapping) must not kill the eviction loop for the
@@ -1361,6 +1364,11 @@ class Head:
                 self._free_meta(meta)  # a genuinely distinct duplicate copy
             return
         self.objects[meta.object_id] = meta
+        self._publish("object_state", {"object_id": meta.object_id.binary(),
+                                       "state": "SEALED",
+                                       "size": meta.size,
+                                       "node_id": (meta.node_id.binary()
+                                                   if meta.node_id else None)})
         for b in (meta.contained or []):
             self._pin(ObjectID(b))  # nested refs live while container does
         if meta.object_id in self._tombstones:

@@ -109,3 +109,19 @@ def summarize_actors() -> dict:
 
 def summarize_objects() -> dict:
     return summarize_object_rows(_list("objects"))
+
+
+def subscribe(channel: str):
+    """Subscribe to a head pubsub channel; returns a queue.Queue of event
+    dicts (reference pubsub channels: node/actor/object state). Usage:
+
+        q = state.subscribe("object_state")
+        evt = q.get(timeout=5)   # {"object_id": ..., "state": "SEALED"}
+    """
+    import queue as _q
+
+    from ray_tpu.core.api import _global_client
+
+    out: "_q.Queue" = _q.Queue()
+    _global_client().subscribe_channel(channel, out.put)
+    return out

@@ -198,3 +198,48 @@ def test_reporter_stats_and_stacks(cluster):
     assert text and "spin_marker_method" in text, text[:500]
     assert ray_tpu.get(ref, timeout=60) == 1
     ray_tpu.kill(a)
+
+
+def test_pubsub_public_subscribe(cluster):
+    """Public pubsub surface: node/actor/object state events reach
+    subscribers (reference src/ray/pubsub channels)."""
+    import numpy as np
+
+    from ray_tpu.util import state
+
+    obj_q = state.subscribe("object_state")
+    actor_q = state.subscribe("actor_state")
+
+    ref = ray_tpu.put(np.zeros(200_000, np.uint8))  # > inline threshold
+    evt = obj_q.get(timeout=15)
+    assert evt["state"] == "SEALED" and evt["size"] > 0
+
+    @ray_tpu.remote
+    class A:
+        def hi(self):
+            return "hi"
+
+    a = A.remote()
+    assert ray_tpu.get(a.hi.remote()) == "hi"
+    deadline = time.time() + 15
+    states = []
+    while time.time() < deadline:
+        try:
+            states.append(actor_q.get(timeout=1)["state"])
+        except Exception:
+            pass
+        if "ALIVE" in states:
+            break
+    assert "ALIVE" in states, states
+
+    # eviction event when the ref is dropped (zero-grace refcounting)
+    del ref
+    deadline = time.time() + 20
+    got_evict = False
+    while time.time() < deadline and not got_evict:
+        try:
+            got_evict = obj_q.get(timeout=1)["state"] == "EVICTED"
+        except Exception:
+            pass
+    assert got_evict, "eviction event never published"
+    ray_tpu.kill(a)
