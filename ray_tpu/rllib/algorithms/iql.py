@@ -1,0 +1,150 @@
+"""IQL: implicit Q-learning for offline RL.
+
+Parity: `rllib/algorithms/iql/` — offline RL WITHOUT querying Q on
+out-of-distribution actions (the CQL failure mode is avoided rather than
+penalized): a state-value net V is fit to expectile tau of Q (upper
+expectile ~ max over DATASET actions), Q regresses to r + gamma*V(s'),
+and the policy is extracted by advantage-weighted regression
+exp(beta * (Q - V)) on logged actions. Rides the BC/MARWIL/CQL offline
+seam; the V head is a small extra pytree owned by the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL
+from ray_tpu.rllib.core.learner import JaxLearner
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        params.append({"w": jax.random.normal(sub, (m, n))
+                       * jnp.sqrt(2.0 / m), "b": jnp.zeros(n)})
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+class IQLLearner(JaxLearner):
+    """Actor (squashed Gaussian) + twin Q from the shared module; V net
+    owned here. Three optimized parts per update."""
+
+    def __init__(self, spec, cfg: "IQLConfig", mesh=None):
+        self.cfg = cfg
+        super().__init__(spec, lr=cfg.lr, grad_clip=cfg.grad_clip,
+                         seed=cfg.seed, mesh=mesh)
+        self.target_params = jax.tree.map(jnp.asarray, self.params)
+        key = jax.random.key(cfg.seed + 101)
+        self.v_params = _mlp_init(
+            key, [spec.obs_dim, *cfg.hiddens, 1])
+        self.v_opt = optax.adam(cfg.lr)
+        self.v_opt_state = self.v_opt.init(self.v_params)
+
+        tau, gamma, beta = cfg.expectile_tau, cfg.gamma, cfg.awr_beta
+
+        @jax.jit
+        def _v_update(v_params, v_opt_state, target_q_params, obs, acts):
+            q1, q2 = self.module.q_values(target_q_params, obs, acts)
+            q = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+
+            def v_loss(vp):
+                v = _mlp_apply(vp, obs)[:, 0]
+                diff = q - v
+                w = jnp.where(diff > 0, tau, 1 - tau)
+                return (w * diff ** 2).mean(), v
+
+            (loss, v), g = jax.value_and_grad(v_loss, has_aux=True)(v_params)
+            upd, v_opt_state = self.v_opt.update(g, v_opt_state)
+            return optax.apply_updates(v_params, upd), v_opt_state, loss
+
+        self._v_update = _v_update
+        self._beta = beta
+        self._gamma = gamma
+
+    def loss(self, params, batch, rng) -> Tuple[jnp.ndarray, dict]:
+        c = self.cfg
+        # critic: Q(s, a_data) -> r + gamma (1-d) V(s')   (no policy
+        # actions anywhere — the IQL point)
+        v_next = jax.lax.stop_gradient(
+            _mlp_apply(batch["_v_params"], batch["next_obs"])[:, 0])
+        y = batch["rewards"] + c.gamma * (1 - batch["dones"]) * v_next
+        q1, q2 = self.module.q_values(params, batch["obs"],
+                                      batch["actions"])
+        critic_loss = ((q1 - y) ** 2).mean() + ((q2 - y) ** 2).mean()
+        # actor: advantage-weighted regression on LOGGED actions
+        v = jax.lax.stop_gradient(
+            _mlp_apply(batch["_v_params"], batch["obs"])[:, 0])
+        q1_t, q2_t = self.module.q_values(batch["_target"], batch["obs"],
+                                          batch["actions"])
+        adv = jax.lax.stop_gradient(jnp.minimum(q1_t, q2_t) - v)
+        w = jnp.exp(jnp.clip(self._beta * adv, -5.0, 5.0))
+        dist = self.module.dist(params, batch["obs"])
+        logp = dist.log_prob(batch["actions"])
+        actor_loss = -(w * logp).mean()
+        total = critic_loss + actor_loss
+        return total, {"critic_loss": critic_loss, "actor_loss": actor_loss,
+                       "adv_mean": adv.mean(), "v_mean": v.mean()}
+
+    def update(self, batch) -> dict:
+        batch = dict(batch)
+        obs = jnp.asarray(batch["obs"])
+        acts = jnp.asarray(batch["actions"])
+        self.v_params, self.v_opt_state, v_loss = self._v_update(
+            self.v_params, self.v_opt_state, self.target_params, obs, acts)
+        batch["_v_params"] = self.v_params
+        batch["_target"] = self.target_params
+        out = super().update(batch)
+        tau = self.cfg.polyak_tau
+        self.target_params = jax.tree.map(
+            lambda t, p: (1 - tau) * t + tau * p,
+            self.target_params, self.params)
+        out["v_loss"] = float(v_loss)
+        return out
+
+    def get_state(self) -> dict:
+        s = super().get_state()
+        s["target_params"] = jax.tree.map(np.asarray, self.target_params)
+        s["v_params"] = jax.tree.map(np.asarray, self.v_params)
+        return s
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = jax.tree.map(jnp.asarray,
+                                          state["target_params"])
+        self.v_params = jax.tree.map(jnp.asarray, state["v_params"])
+
+
+class IQL(CQL):
+    """Same offline columns/spec as CQL (continuous, squashed actor +
+    twin Q); only the learner differs."""
+
+    def _make_learner(self, mesh):
+        return IQLLearner(self.module_spec, self.config, mesh=mesh)
+
+
+class IQLConfig(BCConfig):
+    algo_class = IQL
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.num_updates_per_iteration = 32
+        self.expectile_tau = 0.8
+        self.awr_beta = 3.0
+        self.polyak_tau = 0.005

@@ -86,13 +86,15 @@ class LLMEngine:
                  checkpoint: Optional[str] = None,
                  tokenizer: Any = None,
                  enable_prefix_caching: bool = True,
-                 kv_blocks: int = 64, kv_block_size: int = 16):
+                 kv_blocks: int = 64, kv_block_size: int = 16,
+                 tensor_parallel_size: int = 1):
         import jax
         import jax.numpy as jnp
 
         from ray_tpu.models import gpt2
 
         self.jax, self.jnp, self.gpt2 = jax, jnp, gpt2
+        self.tensor_parallel_size = tensor_parallel_size
         overrides = dict(model_overrides or {})
         overrides.setdefault("max_seq_len", max_seq_len)
         if checkpoint:
@@ -126,7 +128,40 @@ class LLMEngine:
         def _step(params, cache, tokens, pos, active):
             return gpt2.decode_step(params, cache, tokens, pos, active, cfg)
 
-        self._step = jax.jit(_step, donate_argnums=(1,))
+        if tensor_parallel_size > 1:
+            # TP-sharded engine (reference: vLLM TP workers in a
+            # STRICT_PACK PG, `server_models.py:443-461`) — here TP is a
+            # mesh axis: params shard by their logical axes, the KV cache
+            # shards over heads, XLA inserts the ICI collectives. One
+            # process drives all chips (single-controller SPMD).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.parallel.mesh import (MeshConfig, build_mesh,
+                                               use_mesh)
+
+            mesh = build_mesh(
+                MeshConfig(tp=tensor_parallel_size),
+                devices=jax.devices()[:tensor_parallel_size])
+            self.mesh = mesh
+            with use_mesh(mesh):
+                pspecs = gpt2.param_specs(cfg)
+            param_sh = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), pspecs)
+            self.params = jax.tree.map(jax.device_put, self.params,
+                                       param_sh)
+            # KV cache [L, B, H, T, Dh]: shard attention heads over tp
+            cache_sh = NamedSharding(mesh, P(None, None, "tp", None, None))
+            self.cache = jax.tree.map(
+                lambda a: jax.device_put(a, cache_sh), self.cache)
+            rep = NamedSharding(mesh, P())
+            self._step = jax.jit(
+                _step, donate_argnums=(1,),
+                in_shardings=(param_sh, {"k": cache_sh, "v": cache_sh},
+                              rep, rep, rep),
+                out_shardings=(rep, {"k": cache_sh, "v": cache_sh}))
+        else:
+            self.mesh = None
+            self._step = jax.jit(_step, donate_argnums=(1,))
         self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()

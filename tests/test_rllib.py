@@ -530,3 +530,43 @@ def test_cql_offline_pendulum():
     assert np.isfinite(last["cql_penalty"])
     assert last["cql_gap"] > 0, "conservative gap should be positive early"
     algo.stop()
+
+
+def test_iql_offline_pendulum():
+    """IQL: expectile V + AWR policy extraction on logged transitions —
+    no OOD action queries (reference rllib/algorithms/iql)."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import IQLConfig
+
+    env = gym.make("Pendulum-v1")
+    rng = np.random.default_rng(1)
+    cols = {k: [] for k in ("obs", "actions", "rewards", "next_obs",
+                            "dones")}
+    obs, _ = env.reset(seed=1)
+    for _ in range(600):
+        a = env.action_space.sample()
+        nxt, r, term, trunc, _ = env.step(a)
+        cols["obs"].append(obs); cols["actions"].append(a)
+        cols["rewards"].append(r); cols["next_obs"].append(nxt)
+        cols["dones"].append(float(term))
+        obs = nxt
+        if term or trunc:
+            obs, _ = env.reset()
+    data = {k: np.asarray(v, np.float32) for k, v in cols.items()}
+    algo = (IQLConfig().environment("Pendulum-v1")
+            .offline(offline_data=data)
+            .training(train_batch_size=64, num_updates_per_iteration=4)
+            .build())
+    losses = []
+    for _ in range(4):
+        r = algo.train()
+        losses.append(r["critic_loss"])
+    assert all(np.isfinite(l) for l in losses)
+    assert np.isfinite(r["v_loss"]) and np.isfinite(r["adv_mean"])
+    # critic regression makes progress on fixed data
+    assert losses[-1] < losses[0] * 2
+    # checkpoint roundtrip carries the V net
+    st = algo.learner.get_state()
+    algo.learner.set_state(st)
+    algo.stop()
