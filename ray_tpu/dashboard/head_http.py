@@ -50,6 +50,52 @@ refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
 
 
+def _core_metrics_snapshot(head) -> list:
+    """Head-computed core gauges at scrape time (reference
+    `src/ray/stats/metric_defs.cc`: tasks by state, object store usage,
+    scheduler/actor/node counts — the dashboard's Grafana panels)."""
+    def g(name, desc, value, tags=None):
+        return {"name": name, "kind": "gauge", "description": desc,
+                "series": [{"tags": tags or {}, "value": float(value)}]}
+
+    out = [
+        g("nodes_alive", "Alive nodes",
+          sum(1 for n in head.nodes.values() if n.alive)),
+        g("workers_total", "Registered worker processes",
+          sum(1 for w in head.workers.values() if not w.is_driver)),
+        g("drivers_total", "Connected drivers",
+          sum(1 for w in head.workers.values() if w.is_driver)),
+        g("tasks_queued", "Tasks waiting for dispatch", len(head.queue)),
+        g("objects_total", "Objects in the cluster directory",
+          len(head.objects)),
+        g("objects_bytes", "Directory object bytes",
+          sum(m.size for m in head.objects.values())),
+        g("objects_evicted_total", "Objects evicted since head start",
+          getattr(head, "objects_evicted", 0)),
+        g("placement_groups", "Placement groups", len(head.pgs)),
+    ]
+    by_state: dict = {}
+    for a in head.actors.values():
+        by_state[a.state] = by_state.get(a.state, 0) + 1
+    for state, n in sorted(by_state.items()):
+        out.append(g("actors", "Actors by state", n, {"state": state}))
+    total: dict = {}
+    avail: dict = {}
+    for node in head.nodes.values():
+        if not node.alive:
+            continue
+        for r, v in node.resources.items():
+            total[r] = total.get(r, 0) + v
+        for r, v in node.available.items():
+            avail[r] = avail.get(r, 0) + v
+    for r in sorted(total):
+        out.append(g("resource_total", "Cluster resource capacity",
+                     total[r], {"resource": r}))
+        out.append(g("resource_available", "Cluster resource available",
+                     avail.get(r, 0), {"resource": r}))
+    return out
+
+
 def _json(data) -> web.Response:
     return web.Response(text=json.dumps(data, default=str),
                         content_type="application/json")
@@ -95,6 +141,7 @@ def build_app(head) -> web.Application:
                     snapshots[key.decode()] = json.loads(value)
                 except Exception:
                     continue
+        snapshots["head"] = _core_metrics_snapshot(head)
         return web.Response(text=render_prometheus(snapshots),
                             content_type="text/plain")
 
