@@ -15,3 +15,6 @@ __all__ = [
     "read_binary_files", "read_numpy", "read_images", "read_tfrecords",
     "read_sql", "from_arrow", "from_torch", "from_huggingface",
 ]
+from ray_tpu.data.read_api import read_webdataset  # noqa: E402,F401
+
+__all__.append("read_webdataset")

@@ -343,3 +343,115 @@ def read_tfrecords(paths) -> Dataset:
         return rows
 
     return Dataset([functools.partial(read_one, f) for f in files])
+
+
+def read_webdataset(paths) -> Dataset:
+    """WebDataset tar shards → one row per sample (reference
+    `ray.data.read_webdataset`): files sharing a basename group into a
+    dict keyed by extension, e.g. {"__key__", "jpg", "cls", "json"}.
+    Pure tarfile — no webdataset dependency; image/json/cls payloads
+    decode to arrays/objects, the rest stay bytes."""
+    import io as _io
+    import json as _json
+    import tarfile
+
+    files = _expand_paths(paths)
+
+    def _decode(ext: str, data: bytes):
+        if ext in ("json",):
+            return _json.loads(data)
+        if ext in ("cls", "id", "index"):
+            try:
+                return int(data.decode().strip())
+            except ValueError:
+                return data.decode().strip()
+        if ext in ("txt", "text"):
+            return data.decode()
+        if ext in ("jpg", "jpeg", "png", "bmp", "webp"):
+            try:
+                from PIL import Image
+
+                return np.asarray(Image.open(_io.BytesIO(data)))
+            except Exception:
+                return data
+        if ext == "npy":
+            return np.load(_io.BytesIO(data))
+        return data
+
+    def read_one(path):
+        rows = []
+        current_key, sample = None, {}
+        with _fs.open(path, "rb") as f:
+            with tarfile.open(fileobj=f, mode="r|*") as tar:
+                for member in tar:
+                    if not member.isfile():
+                        continue
+                    base, _, ext = member.name.partition(".")
+                    if base != current_key:
+                        if sample:
+                            rows.append(sample)
+                        current_key = base
+                        sample = {"__key__": base}
+                    payload = tar.extractfile(member).read()
+                    sample[ext] = _decode(ext.lower(), payload)
+        if sample:
+            rows.append(sample)
+        return rows
+
+    return Dataset([functools.partial(read_one, f) for f in files])
+
+
+def _tf_feature_bytes(value) -> bytes:
+    """Encode one feature as a tf.train.Feature message (wire format)."""
+    import struct
+
+    def varint(n: int) -> bytes:
+        out = b""
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out += bytes([b | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def field(num: int, wire: int, payload: bytes) -> bytes:
+        return varint((num << 3) | wire) + payload
+
+    def length_delim(num: int, payload: bytes) -> bytes:
+        return field(num, 2, varint(len(payload)) + payload)
+
+    if isinstance(value, bytes):
+        inner = length_delim(1, value)          # bytes_list.value
+        return length_delim(1, inner)           # Feature.bytes_list
+    if isinstance(value, str):
+        return _tf_feature_bytes(value.encode())
+    arr = np.asarray(value)
+    if arr.dtype.kind == "f":
+        packed = arr.astype("<f4").tobytes()
+        inner = length_delim(1, packed)         # float_list.value packed
+        return length_delim(2, inner)           # Feature.float_list
+    vals = b"".join(varint(int(v) & ((1 << 64) - 1))
+                    for v in arr.reshape(-1))
+    inner = length_delim(1, vals)               # int64_list.value packed
+    return length_delim(3, inner)               # Feature.int64_list
+
+
+def _row_to_tf_example(row: dict) -> bytes:
+    def varint(n: int) -> bytes:
+        out = b""
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out += bytes([b | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    entries = b""
+    for name, value in row.items():
+        key = name.encode()
+        kv = (bytes([0x0A, len(key)]) + key           # map key (field 1)
+              + bytes([0x12]) + varint(len(_tf_feature_bytes(value)))
+              + _tf_feature_bytes(value))             # map value (field 2)
+        entries += bytes([0x0A]) + varint(len(kv)) + kv
+    features = bytes([0x0A]) + varint(len(entries)) + entries
+    return features

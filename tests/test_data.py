@@ -496,3 +496,70 @@ def test_executor_respects_per_stage_caps(cluster):
         cur += d
         peak = max(peak, cur)
     assert peak <= 2, f"in-flight peaked at {peak} with cap 2"
+
+
+# --------------------------------------------------------- new datasources
+def test_webdataset_roundtrip(cluster, tmp_path):
+    """Tar-sharded samples group by basename into rows (reference
+    read_webdataset), decoded per extension — stdlib tarfile only."""
+    import io
+    import json as _json
+    import tarfile
+
+    import ray_tpu.data as rd
+
+    shard = tmp_path / "shard-000000.tar"
+    with tarfile.open(shard, "w") as tar:
+        for i in range(5):
+            for ext, payload in (
+                    ("cls", str(i % 2).encode()),
+                    ("json", _json.dumps({"idx": i}).encode()),
+                    ("txt", f"sample {i}".encode())):
+                data = payload
+                info = tarfile.TarInfo(f"sample{i:04d}.{ext}")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+    ds = rd.read_webdataset(str(shard))
+    rows = sorted(ds.take_all(), key=lambda r: r["__key__"])
+    assert len(rows) == 5
+    assert rows[0]["cls"] == 0 and rows[1]["cls"] == 1
+    assert rows[2]["json"]["idx"] == 2
+    assert rows[3]["txt"] == "sample 3"
+
+
+def test_write_read_tfrecords_roundtrip(cluster, tmp_path):
+    """write_tfrecords -> read_tfrecords roundtrip through the built-in
+    protobuf wire writer/parser (no tensorflow)."""
+    import ray_tpu.data as rd
+
+    src = rd.from_items([
+        {"id": i, "score": float(i) / 2, "name": f"row{i}".encode()}
+        for i in range(10)])
+    out = tmp_path / "tfr"
+    src.write_tfrecords(str(out))
+    back = rd.read_tfrecords(str(out))
+    rows = sorted(back.take_all(), key=lambda r: int(r["id"][0]))
+    assert len(rows) == 10
+    assert int(rows[3]["id"][0]) == 3
+    assert abs(float(rows[4]["score"][0]) - 2.0) < 1e-6
+    assert rows[5]["name"][0] == b"row5"
+
+
+def test_tfrecords_crc_is_valid(cluster, tmp_path):
+    """The framing CRCs are real masked CRC-32C (TF readers validate
+    them), not zero padding."""
+    import struct
+
+    import ray_tpu.data as rd
+    from ray_tpu.data.dataset import _masked_crc
+
+    rd.from_items([{"a": 1}]).write_tfrecords(str(tmp_path / "t"))
+    files = list((tmp_path / "t").glob("*.tfrecords"))
+    assert files
+    raw = files[0].read_bytes()
+    (length,) = struct.unpack("<Q", raw[:8])
+    (hdr_crc,) = struct.unpack("<I", raw[8:12])
+    assert hdr_crc == _masked_crc(raw[:8])
+    data = raw[12:12 + length]
+    (data_crc,) = struct.unpack("<I", raw[12 + length:16 + length])
+    assert data_crc == _masked_crc(data)
