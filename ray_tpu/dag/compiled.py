@@ -176,6 +176,12 @@ class CompiledDAG:
                 "args": arg_sources,
                 "kwargs": kwarg_sources,
                 "out_chan": self.chan_names.get(n.uuid),
+                # device edges (reference torch_tensor_accelerator_channel):
+                # a @method(tensor_transport="device") output stays in the
+                # producer's device store; only a descriptor rides the shm
+                # channel, consumers fetch via the device-object plane
+                "transport": (n.actor_handle._methods.get(n.method)
+                              or {}).get("tensor_transport"),
             })
 
         # driver-side readers for the outputs
@@ -212,10 +218,13 @@ class CompiledDAG:
         """Read one iteration's outputs into the oldest pending ref set."""
         if not self._pending:
             raise RuntimeError("no execution in flight")
+        from ray_tpu.dag.runtime import materialize_channel_value
+
         refs = self._pending.pop(0)
         for i, reader in enumerate(self.leaf_readers):
             try:
-                refs[i]._value = reader.read(timeout=timeout)
+                refs[i]._value = materialize_channel_value(
+                    reader.read(timeout=timeout))
             except (ChannelClosedError, TimeoutError) as e:
                 refs[i]._value = e
             refs[i]._done = True

@@ -15,6 +15,25 @@ from typing import Any, Dict, List
 from ray_tpu.dag.channel import (Channel, ChannelClosedError,
                                  RemoteChannelReader)
 
+# device-edge descriptor: the channel carries this tiny dict; the tensor
+# stays in the producer's device store (reference
+# torch_tensor_accelerator_channel.py: metadata via shm, payload
+# out-of-band)
+DEVICE_DESC = "__rtpu_device_oid__"
+
+
+def materialize_channel_value(value):
+    """Resolve a channel payload: device descriptors fetch the living
+    tensor through the device-object plane (same-process zero-copy, ICI
+    between gang members, snapshot otherwise)."""
+    if isinstance(value, dict) and DEVICE_DESC in value:
+        import ray_tpu
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        return ray_tpu.get(ObjectRef(ObjectID(value[DEVICE_DESC])))
+    return value
+
 
 def _ref_key(ref) -> tuple:
     kind, val = ref
@@ -54,6 +73,13 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
             writer(step["out_chan"])
 
     iterations = 0
+    # device-edge lifetime: the producer holds the ONLY refs to its
+    # device outputs. Two generations stay alive — the value a reader may
+    # still be fetching and the value just written — released as newer
+    # writes land (single-slot backpressure bounds reader lag to one).
+    from collections import deque as _deque
+
+    dev_refs: Dict[str, "_deque"] = {}
     try:
         while True:
             # one channel may feed several steps in an iteration: read once
@@ -62,7 +88,8 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
             def fetch(ref) -> Any:
                 key = _ref_key(ref)
                 if key not in read_cache:
-                    read_cache[key] = reader(ref).read()
+                    read_cache[key] = materialize_channel_value(
+                        reader(ref).read())
                 return read_cache[key]
 
             for step in schedule:
@@ -74,10 +101,20 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
                 result = getattr(instance, step["method"])(*args, **kwargs)
                 out = step["out_chan"]
                 if out:
+                    if step.get("transport") == "device":
+                        from ray_tpu.core.api import _global_client
+
+                        oref = _global_client().put_device(result)
+                        gens = dev_refs.setdefault(out, _deque())
+                        gens.append(oref)
+                        while len(gens) > 2:
+                            gens.popleft()   # GC -> dec -> device free
+                        result = {DEVICE_DESC: oref.binary()}
                     # same-actor downstream steps re-read the channel (their
                     # ack is counted in num_readers); single-slot channels
                     # support read-after-write in the same thread
                     writer(out).write(result)
             iterations += 1
     except ChannelClosedError:
+        dev_refs.clear()   # release held device outputs
         return iterations

@@ -188,3 +188,57 @@ def test_compiled_throughput_beats_actor_calls(cluster):
         cdag.teardown(kill_actors=True)
     assert compiled_dt < actor_call_dt, (
         f"compiled {compiled_dt:.4f}s not faster than RPC {actor_call_dt:.4f}s")
+
+
+def test_compiled_dag_device_channel(cluster):
+    """Device edges (reference torch_tensor_accelerator_channel): a
+    @method(tensor_transport='device') output stays in the producer's
+    device store — the shm channel carries only a descriptor — and the
+    consumer receives a living jax.Array. The producer's HBM footprint
+    stays bounded across iterations (2-generation window)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Producer:
+        @ray_tpu.method(tensor_transport="device")
+        def fwd(self, x):
+            import jax.numpy as jnp
+
+            return jnp.full((64, 64), float(x))
+
+        def store_len(self):
+            from ray_tpu.core.api import _global_client
+
+            return len(_global_client().device_store)
+
+    @ray_tpu.remote
+    class Consumer:
+        def reduce(self, arr):
+            import jax
+
+            assert isinstance(arr, jax.Array), type(arr)
+            return float(arr.sum())
+
+    p, c = Producer.remote(), Consumer.remote()
+    with InputNode() as inp:
+        dag = c.reduce.bind(p.fwd.bind(inp))
+    cdag = dag.experimental_compile()
+    try:
+        for i in range(6):
+            assert cdag.execute(i).get(timeout=60) == 64 * 64 * i
+    finally:
+        cdag.teardown()   # loops exit; the actor becomes callable again
+    # bounded producer-side device store: held generations were released
+    # at loop exit; allow the refcount flush a moment to drain
+    deadline = time.time() + 20
+    n = 99
+    while time.time() < deadline:
+        n = ray_tpu.get(p.store_len.remote(), timeout=30)
+        if n <= 2:
+            break
+        time.sleep(0.3)
+    assert n <= 2, f"device outputs leaking: {n} live"
+    ray_tpu.kill(p)
+    ray_tpu.kill(c)
