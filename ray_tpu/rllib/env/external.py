@@ -1,0 +1,287 @@
+"""External-env service: simulators connect over TCP and ship episodes.
+
+Behavioral parity with the reference's external-inference EnvRunner
+(`rllib/env/external/env_runner_server_for_external_inference.py`, the
+`tcp_client_inference_env_runner` service): the CLIENT owns the
+environment AND runs inference locally — the server pushes policy
+weights down (`set_state` with a monotonically increasing seq-no) and
+turns the episode stream coming back into the [T, N, ...] batches the
+learners consume. One client per runner (reference assumption).
+
+Wire protocol: length-prefixed pickled dicts
+  client -> server: {"type": "hello"}
+                    {"type": "episodes", "episodes": [...]}   (bulk)
+                    {"type": "ping"}
+  server -> client: {"type": "set_config", "config": {...}}
+                    {"type": "set_state", "weights": ..., "seq_no": n}
+                    {"type": "pong"}
+An episode dict carries obs/actions/rewards (+ optional logp/values for
+GAE-based learners) and terminated/truncated flags.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    data = pickle.dumps(msg)
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 16, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class ExternalEnvServer:
+    """EnvRunner-shaped server for ONE external simulator client.
+
+    Drop-in for the sampling side of SingleAgentEnvRunner: set_weights()
+    pushes to the client; sample(num_steps) blocks until the episode
+    stream covers the request and returns the standard [T, 1, ...]
+    batch."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 config: Optional[dict] = None):
+        self._srv = socket.create_server((host, port))
+        self.port = self._srv.getsockname()[1]
+        self.config = config or {}
+        self._client: Optional[socket.socket] = None
+        self._client_lock = threading.Lock()
+        self._episodes: deque = deque()
+        self._steps_buffered = 0
+        self._cv = threading.Condition()
+        self._weights = None
+        self._seq_no = 0
+        self._stop = threading.Event()
+        self._ep_returns: List[float] = []
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"extenv-{self.port}")
+        self._thread.start()
+
+    # ------------------------------------------------------------- server
+    def _serve(self) -> None:
+        self._srv.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._client_lock:
+                self._client = sock
+            try:
+                self._client_loop(sock)
+            except (OSError, EOFError, pickle.PickleError):
+                pass
+            finally:
+                with self._client_lock:
+                    if self._client is sock:
+                        self._client = None
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _client_loop(self, sock: socket.socket) -> None:
+        while not self._stop.is_set():
+            msg = recv_msg(sock)
+            if msg is None:
+                return
+            t = msg.get("type")
+            if t == "hello":
+                send_msg(sock, {"type": "set_config",
+                                "config": self.config})
+                with self._cv:
+                    if self._weights is not None:
+                        send_msg(sock, {"type": "set_state",
+                                        "weights": self._weights,
+                                        "seq_no": self._seq_no})
+            elif t == "ping":
+                send_msg(sock, {"type": "pong"})
+            elif t == "episodes":
+                with self._cv:
+                    for ep in msg["episodes"]:
+                        steps = len(ep["actions"])
+                        self._episodes.append(ep)
+                        self._steps_buffered += steps
+                        self._ep_returns.append(
+                            float(np.sum(ep["rewards"])))
+                    self._cv.notify_all()
+
+    # ----------------------------------------------- EnvRunner interface
+    def set_weights(self, params) -> None:
+        """New policy weights: bump seq-no and push to the live client
+        (reference WEIGHTS_SEQ_NO semantics)."""
+        import jax
+
+        host = jax.tree.map(np.asarray, params)
+        with self._cv:
+            self._weights = host
+            self._seq_no += 1
+            seq = self._seq_no
+        with self._client_lock:
+            sock = self._client
+        if sock is not None:
+            try:
+                send_msg(sock, {"type": "set_state", "weights": host,
+                                "seq_no": seq})
+            except OSError:
+                pass
+
+    @property
+    def weights_seq_no(self) -> int:
+        return self._seq_no
+
+    def sample(self, num_steps: int, epsilon: float = 0.0,
+               timeout: float = 60.0) -> Dict[str, np.ndarray]:
+        """Block until the client has shipped >= num_steps env steps;
+        return the standard [T, N=1, ...] batch."""
+        deadline = time.monotonic() + timeout
+        eps: List[dict] = []
+        got = 0
+        with self._cv:
+            while got < num_steps:
+                while not self._episodes:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise TimeoutError(
+                            f"external client shipped {got}/{num_steps} "
+                            f"steps in {timeout}s")
+                    self._cv.wait(left)
+                ep = self._episodes.popleft()
+                n = len(ep["actions"])
+                self._steps_buffered -= n
+                got += n
+                eps.append(ep)
+
+        def cat(key, default=None):
+            parts = []
+            for ep in eps:
+                if key in ep:
+                    parts.append(np.asarray(ep[key]))
+                elif default is not None:
+                    parts.append(np.full(len(ep["actions"]), default,
+                                         np.float32))
+                else:
+                    raise KeyError(key)
+            return np.concatenate(parts)
+
+        T = got
+        obs = cat("obs").astype(np.float32)
+        terms = np.zeros(T, bool)
+        truncs = np.zeros(T, bool)
+        next_obs_seq = np.concatenate(
+            [np.asarray(ep.get("next_obs", ep["obs"])) for ep in eps]
+        ).astype(np.float32)
+        i = 0
+        for ep in eps:
+            n = len(ep["actions"])
+            terms[i + n - 1] = bool(ep.get("terminated", True))
+            truncs[i + n - 1] = bool(ep.get("truncated", False)) \
+                and not terms[i + n - 1]
+            i += n
+        batch = {
+            "obs": obs[:, None],
+            "actions": cat("actions")[:, None],
+            "rewards": cat("rewards").astype(np.float32)[:, None],
+            "terminateds": terms[:, None],
+            "truncateds": truncs[:, None],
+            "dones": (terms | truncs)[:, None],
+            "next_obs_seq": next_obs_seq[:, None],
+            "logp": cat("logp", 0.0).astype(np.float32)[:, None],
+            "values": cat("values", 0.0).astype(np.float32)[:, None],
+            "final_values": np.zeros((T, 1), np.float32),
+            "next_obs": next_obs_seq[-1:][:].astype(np.float32),
+            "last_values": np.zeros((1,), np.float32),
+        }
+        return batch
+
+    def episode_metrics(self) -> dict:
+        rets, self._ep_returns = self._ep_returns, []
+        return {"episodes": len(rets),
+                "episode_return_mean": float(np.mean(rets)) if rets
+                else float("nan")}
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._client_lock:
+            if self._client is not None:
+                try:
+                    self._client.close()
+                except OSError:
+                    pass
+
+
+class ExternalEnvClient:
+    """Reference client helper (the simulator side): connect, receive
+    config/weights, ship episodes. Real deployments embed this loop in
+    the game/simulator process."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        send_msg(self.sock, {"type": "hello"})
+        self.config: dict = {}
+        self.weights = None
+        self.seq_no = -1
+        msg = recv_msg(self.sock)
+        if msg and msg.get("type") == "set_config":
+            self.config = msg["config"]
+
+    def poll(self, timeout: float = 0.1) -> None:
+        """Drain pending server messages (weight updates)."""
+        self.sock.settimeout(timeout)
+        try:
+            while True:
+                msg = recv_msg(self.sock)
+                if msg is None:
+                    return
+                if msg.get("type") == "set_state":
+                    self.weights = msg["weights"]
+                    self.seq_no = msg["seq_no"]
+        except socket.timeout:
+            pass
+        finally:
+            self.sock.settimeout(None)
+
+    def wait_for_weights(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while self.weights is None:
+            if time.monotonic() > deadline:
+                raise TimeoutError("no weights from server")
+            self.poll(0.2)
+
+    def send_episodes(self, episodes: List[dict]) -> None:
+        send_msg(self.sock, {"type": "episodes", "episodes": episodes})
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

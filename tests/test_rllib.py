@@ -570,3 +570,64 @@ def test_iql_offline_pendulum():
     st = algo.learner.get_state()
     algo.learner.set_state(st)
     algo.stop()
+
+
+def test_external_env_service():
+    """External simulators connect over TCP, receive weights, run
+    inference locally, and ship episodes back; the server turns the
+    stream into learner batches (reference
+    rllib/env/external/env_runner_server_for_external_inference.py)."""
+    import threading
+    import time
+
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.env.external import (ExternalEnvClient,
+                                            ExternalEnvServer)
+
+    srv = ExternalEnvServer(config={"env": "CartPole-v1"})
+    try:
+        srv.set_weights({"w": jnp.ones((4, 2))})
+        results = {}
+
+        def client_main():
+            cl = ExternalEnvClient("127.0.0.1", srv.port)
+            results["config"] = cl.config
+            cl.wait_for_weights()
+            results["seq0"] = cl.seq_no
+            rng = np.random.default_rng(0)
+            # the client OWNS env + inference: fabricate two episodes
+            eps = []
+            for n in (5, 7):
+                eps.append({
+                    "obs": rng.normal(size=(n, 4)).astype(np.float32),
+                    "next_obs": rng.normal(size=(n, 4)).astype(np.float32),
+                    "actions": rng.integers(0, 2, n),
+                    "rewards": np.ones(n, np.float32),
+                    "logp": np.full(n, -0.69, np.float32),
+                    "values": np.zeros(n, np.float32),
+                    "terminated": True,
+                })
+            cl.send_episodes(eps)
+            # weight update flows down mid-session
+            deadline = time.time() + 20
+            while cl.seq_no < 2 and time.time() < deadline:
+                cl.poll(0.2)
+            results["seq1"] = cl.seq_no
+            cl.close()
+
+        t = threading.Thread(target=client_main, daemon=True)
+        t.start()
+        batch = srv.sample(num_steps=10, timeout=30)
+        assert batch["obs"].shape == (12, 1, 4)       # whole episodes
+        assert batch["dones"].sum() == 2              # one per episode end
+        assert batch["rewards"].sum() == 12.0
+        srv.set_weights({"w": jnp.zeros((4, 2))})     # push update
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert results["config"]["env"] == "CartPole-v1"
+        assert results["seq0"] == 1 and results["seq1"] == 2
+        m = srv.episode_metrics()
+        assert m["episodes"] == 2
+    finally:
+        srv.stop()
