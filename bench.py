@@ -45,7 +45,8 @@ def main():
         preset, max_seq_len=seq_len,
         remat=os.environ.get("BENCH_REMAT", "1") != "0",
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots"),
-        attn_impl=os.environ.get("BENCH_ATTN", "auto"))
+        attn_impl=os.environ.get("BENCH_ATTN", "auto"),
+        ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "0")))
 
     train = compile_gpt2_train(cfg, mesh, optimizer=default_optimizer(total_steps=100))
     state = train.init_fn(jax.random.key(0))

@@ -50,6 +50,11 @@ class GPT2Config:
     # attention implementation: auto | dense | flash (pallas) | ring | ulysses
     # auto: ring when the active mesh has sp>1, flash on TPU, dense otherwise
     attn_impl: str = "auto"
+    # chunked fused cross-entropy: unembed+CE computed per ce_chunk-token
+    # slice under jax.checkpoint, so the [B,T,V] logits (the single
+    # largest training buffer — ~3 GB at 350M/b14) never materialize;
+    # backward recomputes each chunk's logits. 0 = off (plain unembed+CE)
+    ce_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -249,8 +254,9 @@ def unembed(params: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
     return constrain(logits, "batch", "seq", "vocab")
 
 
-def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, vocab] (compute dtype)."""
+def hidden_states(params: Params, tokens: jax.Array,
+                  cfg: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> final hidden [B, T, D] (pre-unembed)."""
     x = embed(params, tokens, cfg)
 
     block_fn = partial(_block, cfg=cfg)
@@ -267,7 +273,41 @@ def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
         return block_fn(carry, bp), None
 
     x, _ = lax.scan(scan_body, x, params["blocks"])
-    return unembed(params, x, cfg)
+    return x
+
+
+def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] (compute dtype)."""
+    return unembed(params, hidden_states(params, tokens, cfg), cfg)
+
+
+def chunked_ce(params: Params, x: jax.Array, targets: jax.Array,
+               cfg: GPT2Config) -> jax.Array:
+    """Fused unembed + cross-entropy over seq chunks: peak logits memory
+    drops from [B, T, V] to [B, ce_chunk, V] (fwd AND bwd — the chunk
+    body is rematerialized), freeing HBM for larger per-chip batches.
+    Numerically identical to unembed+cross_entropy (f32 reductions)."""
+    x = _layer_norm(x, params["ln_f"])
+    W = params["wte"].T.astype(cfg.dtype)                  # [D, V]
+    B, T, D = x.shape
+    C = cfg.ce_chunk
+    if T % C:
+        raise ValueError(f"seq len {T} not divisible by ce_chunk={C}")
+    K = T // C
+    xc = x.reshape(B, K, C, D).swapaxes(0, 1)              # [K, B, C, D]
+    tc = targets.reshape(B, K, C).swapaxes(0, 1)           # [K, B, C]
+
+    def body(acc, xt):
+        xcb, tcb = xt
+        logits = constrain(xcb @ W, "batch", "seq", "vocab")
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tcb[..., None],
+                                   axis=-1)[..., 0]
+        return acc + jnp.sum(logz - gold), None
+
+    total, _ = lax.scan(jax.checkpoint(body), jnp.float32(0.0), (xc, tc))
+    return total / (B * T)
 
 
 def loss_fn(params: Params, batch: dict, cfg: GPT2Config) -> jax.Array:
@@ -276,6 +316,9 @@ def loss_fn(params: Params, batch: dict, cfg: GPT2Config) -> jax.Array:
     from ray_tpu.models.lm import cross_entropy, split_lm_batch
 
     inputs, targets = split_lm_batch(batch)
+    if cfg.ce_chunk:
+        return chunked_ce(params, hidden_states(params, inputs, cfg),
+                          targets, cfg)
     return cross_entropy(forward(params, inputs, cfg), targets)
 
 

@@ -84,3 +84,34 @@ def test_num_params_matches():
     params = gpt2.init_params(jax.random.key(0), CFG)
     actual = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
     assert actual == gpt2.num_params(CFG)
+
+
+def test_chunked_ce_matches_plain(devices8):
+    """ce_chunk fused unembed+CE: identical loss and (bf16-tolerance)
+    grads to the plain path, with [B,T,V] logits never materialized."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+
+    cfg0 = gpt2.GPT2Config.preset("gpt2-tiny", max_seq_len=64)
+    cfg1 = gpt2.GPT2Config.preset("gpt2-tiny", max_seq_len=64, ce_chunk=16)
+    params = gpt2.init_params(jax.random.key(0), cfg0)
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(
+        rng.integers(0, cfg0.vocab_size, (2, 65)), jnp.int32)}
+    l0 = float(gpt2.loss_fn(params, batch, cfg0))
+    l1 = float(gpt2.loss_fn(params, batch, cfg1))
+    assert abs(l0 - l1) < 1e-4
+    g0 = jax.grad(lambda p: gpt2.loss_fn(p, batch, cfg0))(params)
+    g1 = jax.grad(lambda p: gpt2.loss_fn(p, batch, cfg1))(params)
+    mx = max(jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), g0, g1)))
+    assert mx < 1e-3, f"grad diff {mx}"
+    # indivisible chunking is rejected loudly
+    bad = gpt2.GPT2Config.preset("gpt2-tiny", max_seq_len=64, ce_chunk=60)
+    try:
+        gpt2.loss_fn(params, batch, bad)
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
