@@ -15,6 +15,11 @@ plain `open()` keeps it allocation-free. Anything with a `://` goes to
 matching driver package is installed (gcsfs/s3fs are not baked into this
 image — the seam is what's tested; `memory://` and `file://` ship with
 fsspec itself).
+
+Caveat: `memory://` is PER-PROCESS — a dataset written by the driver is
+invisible to read tasks running in workers. Use it for single-process
+tests only; on a cluster use shared storage (`gs://`, NFS, or `file://`
+on a shared mount).
 """
 
 from __future__ import annotations
