@@ -47,7 +47,9 @@ class Algorithm:
             num_envs_per_runner=config.num_envs_per_env_runner,
             seed=config.seed,
             epsilon=0.0 if self.needs_epsilon else None,
-            env_kwargs=config.env_kwargs)
+            env_kwargs=config.env_kwargs,
+            env_to_module_connector=config.env_to_module_connector,
+            module_to_env_connector=config.module_to_env_connector)
         self.env_runner_group.sync_weights(self.learner.get_weights())
         self.iteration = 0
         self._timesteps = 0

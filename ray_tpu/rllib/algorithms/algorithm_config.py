@@ -21,6 +21,8 @@ class AlgorithmConfig:
         self.num_env_runners: int = 0
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 128
+        self.env_to_module_connector = None   # factory -> ConnectorPipeline
+        self.module_to_env_connector = None
         # training (shared knobs; algo subclasses add their own)
         self.lr: float = 3e-4
         self.gamma: float = 0.99
@@ -48,13 +50,21 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None):
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector=None,
+                    module_to_env_connector=None):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        # connector FACTORIES (reference contract): each runner builds
+        # its own stateful pipeline from these
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def training(self, **kwargs):

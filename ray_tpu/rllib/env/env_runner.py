@@ -23,9 +23,17 @@ class SingleAgentEnvRunner:
 
     def __init__(self, env_spec, module_spec: ModuleSpec, num_envs: int = 1,
                  seed: int = 0, explore: bool = True,
-                 epsilon: Optional[float] = None, env_kwargs: Optional[dict] = None):
+                 epsilon: Optional[float] = None, env_kwargs: Optional[dict] = None,
+                 env_to_module_connector=None,
+                 module_to_env_connector=None):
+        from ray_tpu.rllib.connectors import build_pipeline
+
         self._env_spec = env_spec
         self._env_kwargs = dict(env_kwargs or {})
+        # connector pipelines (reference rllib/connectors): per-runner
+        # stateful transforms between env and module
+        self.env_to_module = build_pipeline(env_to_module_connector)
+        self.module_to_env = build_pipeline(module_to_env_connector)
         self.vec = VectorEnv(env_spec, num_envs, seed=seed, **self._env_kwargs)
         self.module = RLModule(module_spec)
         self.explore = explore
@@ -74,12 +82,16 @@ class SingleAgentEnvRunner:
         term_buf, trunc_buf, next_buf, finalv_buf = ([] for _ in range(4))
         for _ in range(num_steps):
             self._rng, sub = jax.random.split(self._rng)
-            a, logp, v = self._policy_step(self._params, self._obs, sub,
+            mod_obs = (self.env_to_module(self._obs)
+                       if self.env_to_module else self._obs)
+            a, logp, v = self._policy_step(self._params, mod_obs, sub,
                                            jnp.float32(epsilon))
             a_np = np.asarray(a)
-            obs_buf.append(self._obs)
+            obs_buf.append(mod_obs)
             env_a = a_np if self.module.spec.discrete else \
                 a_np * self.module.spec.action_scale
+            if self.module_to_env is not None:
+                env_a = self.module_to_env(env_a)
             next_obs, r, term, trunc, final_obs, ep_ret = self.vec.step(env_a)
             act_buf.append(a_np)
             rew_buf.append(r)
@@ -92,15 +104,18 @@ class SingleAgentEnvRunner:
             boot = trunc & ~term
             fv = np.zeros(self.vec.num_envs, np.float32)
             if boot.any():
-                fv[boot] = np.asarray(
-                    self._value_fn(self._params, final_obs[boot]))
+                bobs = (self.env_to_module.transform(final_obs[boot])
+                        if self.env_to_module else final_obs[boot])
+                fv[boot] = np.asarray(self._value_fn(self._params, bobs))
             finalv_buf.append(fv)
             logp_buf.append(np.asarray(logp))
             val_buf.append(np.asarray(v))
             self._ep_returns.extend(ep_ret[~np.isnan(ep_ret)].tolist())
             self._obs = next_obs
         self._rng, sub = jax.random.split(self._rng)
-        _, _, last_v = self._policy_step(self._params, self._obs, sub,
+        tail_obs = (self.env_to_module.transform(self._obs)
+                    if self.env_to_module else self._obs)
+        _, _, last_v = self._policy_step(self._params, tail_obs, sub,
                                          jnp.float32(epsilon))
         terms = np.stack(term_buf)
         truncs = np.stack(trunc_buf)

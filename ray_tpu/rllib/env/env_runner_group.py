@@ -19,10 +19,14 @@ from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 
 @ray_tpu.remote
 class _RemoteEnvRunner:
-    def __init__(self, env_spec, module_spec, num_envs, seed, epsilon, env_kwargs):
+    def __init__(self, env_spec, module_spec, num_envs, seed, epsilon,
+                 env_kwargs, env_to_module_connector=None,
+                 module_to_env_connector=None):
         self.runner = SingleAgentEnvRunner(
             env_spec, module_spec, num_envs=num_envs, seed=seed, epsilon=epsilon,
-            env_kwargs=env_kwargs)
+            env_kwargs=env_kwargs,
+            env_to_module_connector=env_to_module_connector,
+            module_to_env_connector=module_to_env_connector)
 
     def set_weights(self, params):
         self.runner.set_weights(params)
@@ -44,18 +48,24 @@ class EnvRunnerGroup:
     def __init__(self, env_spec, module_spec: ModuleSpec, *, num_runners: int = 0,
                  num_envs_per_runner: int = 1, seed: int = 0,
                  epsilon: Optional[float] = None,
-                 env_kwargs: Optional[dict] = None):
+                 env_kwargs: Optional[dict] = None,
+                 env_to_module_connector=None,
+                 module_to_env_connector=None):
         self._env_spec = env_spec
         self._module_spec = module_spec
         self._num_envs = num_envs_per_runner
         self._seed = seed
         self._epsilon = epsilon
         self._env_kwargs = dict(env_kwargs or {})
+        self._e2m = env_to_module_connector
+        self._m2e = module_to_env_connector
         self.num_runners = num_runners
         if num_runners == 0:
             self.local = SingleAgentEnvRunner(
                 env_spec, module_spec, num_envs=num_envs_per_runner, seed=seed,
-                epsilon=epsilon, env_kwargs=self._env_kwargs)
+                epsilon=epsilon, env_kwargs=self._env_kwargs,
+                env_to_module_connector=env_to_module_connector,
+                module_to_env_connector=module_to_env_connector)
             self.actors: List = []
         else:
             self.local = None
@@ -64,7 +74,8 @@ class EnvRunnerGroup:
     def _make_actor(self, i: int):
         return _RemoteEnvRunner.options(max_restarts=2).remote(
             self._env_spec, self._module_spec, self._num_envs,
-            self._seed + 1000 * (i + 1), self._epsilon, self._env_kwargs)
+            self._seed + 1000 * (i + 1), self._epsilon, self._env_kwargs,
+            self._e2m, self._m2e)
 
     def sync_weights(self, params) -> None:
         if self.local is not None:
