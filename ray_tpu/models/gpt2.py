@@ -462,3 +462,59 @@ def load_params(path: str, cfg: Optional[GPT2Config] = None
             loaded.append(jnp.asarray(arr, dtype=leaf.dtype))
     treedef = jax.tree_util.tree_structure(template)
     return jax.tree_util.tree_unflatten(treedef, loaded), cfg
+
+
+# ---------------------------------------------------------------------------
+# LoRA adapters
+# ---------------------------------------------------------------------------
+def apply_lora(params: Params, adapter: dict) -> Params:
+    """Merge low-rank adapters into a COPY of `params`.
+
+    `adapter`: {"dotted.path": {"A": [..., D, r], "B": [..., r, K],
+    "alpha": float}} — delta = (alpha / r) * A @ B, the standard LoRA
+    scaling. Stacked scanned-layer params ([L, D, K]) take stacked
+    A/B ([L, D, r], [L, r, K]) via batched matmul. Serving keeps the
+    BASE params shared; each adapter costs only its merged copies of the
+    targeted leaves (reference: multi-LoRA serving behind serve.llm)."""
+    import copy as _copy
+
+    out = jax.tree.map(lambda x: x, params)  # shallow structural copy
+    for path, spec in adapter.items():
+        keys = path.split(".")
+        node = out
+        for k in keys[:-1]:
+            node[k] = dict(node[k]) if isinstance(node[k], dict) else node[k]
+            node = node[k]
+        leaf = node[keys[-1]]
+        A = jnp.asarray(spec["A"], leaf.dtype)
+        B = jnp.asarray(spec["B"], leaf.dtype)
+        r = A.shape[-1]
+        alpha = float(spec.get("alpha", r))
+        delta = (alpha / r) * (A @ B)
+        if delta.shape != leaf.shape:
+            raise ValueError(
+                f"LoRA delta shape {delta.shape} != param {leaf.shape} "
+                f"at {path!r}")
+        node[keys[-1]] = leaf + delta
+    return out
+
+
+def load_lora_npz(path: str) -> dict:
+    """Adapter file: npz with `<dotted.path>.A`, `<dotted.path>.B` and
+    optional `<dotted.path>.alpha` entries (local path or fsspec URI)."""
+    import numpy as _np
+
+    from ray_tpu.utils import fs as _fs
+
+    with _fs.open(path, "rb") as f:
+        data = _np.load(f)
+        adapter: dict = {}
+        for name in data.files:
+            base, _, kind = name.rpartition(".")
+            if kind not in ("A", "B", "alpha"):
+                continue
+            adapter.setdefault(base, {})[kind] = data[name]
+    missing = [k for k, v in adapter.items() if "A" not in v or "B" not in v]
+    if missing:
+        raise ValueError(f"LoRA entries missing A/B pairs: {missing}")
+    return adapter

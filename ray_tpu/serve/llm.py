@@ -19,6 +19,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 
@@ -87,7 +88,8 @@ class LLMEngine:
                  tokenizer: Any = None,
                  enable_prefix_caching: bool = True,
                  kv_blocks: int = 64, kv_block_size: int = 16,
-                 tensor_parallel_size: int = 1):
+                 tensor_parallel_size: int = 1,
+                 params_override=None):
         import jax
         import jax.numpy as jnp
 
@@ -97,7 +99,12 @@ class LLMEngine:
         self.tensor_parallel_size = tensor_parallel_size
         overrides = dict(model_overrides or {})
         overrides.setdefault("max_seq_len", max_seq_len)
-        if checkpoint:
+        if params_override is not None:
+            # LoRA-merged (or otherwise prepared) weights from the caller
+            self.cfg = gpt2.GPT2Config.preset(preset, **overrides)
+            self.params = params_override
+            self.checkpoint = checkpoint
+        elif checkpoint:
             # REAL weights: architecture from the checkpoint sidecar,
             # runtime knobs (seq len etc.) from the preset/overrides
             base = gpt2.GPT2Config.preset(preset, **overrides)
@@ -385,11 +392,13 @@ class LLMServer:
 
     def __init__(self, preset: str = "gpt2-tiny", max_batch: int = 4,
                  max_seq_len: int = 128, model_overrides: Optional[dict] = None,
-                 checkpoint: Optional[str] = None, tokenizer: Any = None):
+                 checkpoint: Optional[str] = None, tokenizer: Any = None,
+                 **engine_kwargs):
         self.engine = LLMEngine(preset=preset, max_batch=max_batch,
                                 max_seq_len=max_seq_len,
                                 model_overrides=model_overrides,
-                                checkpoint=checkpoint, tokenizer=tokenizer)
+                                checkpoint=checkpoint, tokenizer=tokenizer,
+                                **engine_kwargs)
 
     def __call__(self, request: Any) -> dict:
         body = request if isinstance(request, dict) else getattr(
@@ -428,9 +437,54 @@ class OpenAIServer(LLMServer):
     `llm/_internal/serve/deployments/routers/router.py` — /v1/completions,
     /v1/chat/completions, /v1/models). Mount with route_prefix="/v1"."""
 
-    def __init__(self, model_id: str = "ray-tpu-llm", **kwargs):
+    def __init__(self, model_id: str = "ray-tpu-llm",
+                 lora_root: Optional[str] = None, max_loras: int = 2,
+                 **kwargs):
         super().__init__(**kwargs)
         self.model_id = model_id
+        # LoRA multiplexing (reference: multi-LoRA serve.llm deployments;
+        # replica-granular here): request `model` = "<base>:<adapter>"
+        # resolves {lora_root}/{adapter}.npz, merged into the base params
+        # and served by a per-adapter engine under an LRU cap
+        self.lora_root = lora_root
+        self.max_loras = max_loras
+        self._lora_engines: "OrderedDict[str, LLMEngine]" = OrderedDict()
+        self._engine_kwargs = dict(kwargs)
+        self._stream_owner: Dict[str, LLMEngine] = {}
+
+    def loaded_lora_ids(self):
+        return list(self._lora_engines)
+
+    def _engine_for(self, body: dict) -> "LLMEngine":
+        model = (body or {}).get("model")
+        if (not self.lora_root or not model or model == self.model_id
+                or ":" not in str(model)):
+            return self.engine
+        adapter_id = str(model).rsplit(":", 1)[1]
+        eng = self._lora_engines.get(adapter_id)
+        if eng is not None:
+            self._lora_engines.move_to_end(adapter_id)
+            return eng
+        from ray_tpu.models.gpt2 import apply_lora, load_lora_npz
+        from ray_tpu.utils import fs as _lfs
+
+        path = _lfs.join(self.lora_root, f"{adapter_id}.npz")
+        merged = apply_lora(self.engine.params, load_lora_npz(path))
+        kwargs = dict(self._engine_kwargs)
+        kwargs.pop("checkpoint", None)
+        eng = LLMEngine(params_override=merged, **kwargs)
+        while len(self._lora_engines) >= self.max_loras:
+            _, old = self._lora_engines.popitem(last=False)
+            old.shutdown()   # LRU eviction must stop the engine thread
+        self._lora_engines[adapter_id] = eng
+        return eng
+
+    def stream_next(self, stream_id: str, cursor: int = 0) -> dict:
+        eng = self._stream_owner.get(stream_id, self.engine)
+        out = eng.stream_next(stream_id, cursor=cursor)
+        if out.get("done"):
+            self._stream_owner.pop(stream_id, None)
+        return out
 
     def __call__(self, request: Any) -> dict:
         path = getattr(request, "path", "/v1/completions")
@@ -445,20 +499,22 @@ class OpenAIServer(LLMServer):
         top_p = float(body.get("top_p", 1.0))
         top_k = int(body.get("top_k", 0))
         stream = bool(body.get("stream"))
+        eng = self._engine_for(body)
         if path.endswith("/chat/completions"):
             msgs = body.get("messages", [])
             prompt = "".join(f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
                              for m in msgs) + "<|assistant|>"
             if stream:
-                sid = self.engine.start_stream(
+                sid = eng.start_stream(
                     prompt=prompt, max_tokens=max_tokens,
                     temperature=temperature, top_k=top_k, top_p=top_p)
+                self._stream_owner[sid] = eng
                 return {"__sse_stream__": {"stream_id": sid,
                                            "model": self.model_id,
                                            "mode": "chat"}}
-            out = self.engine.generate(prompt=prompt, max_tokens=max_tokens,
-                                       temperature=temperature, top_k=top_k,
-                                       top_p=top_p)
+            out = eng.generate(prompt=prompt, max_tokens=max_tokens,
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p)
             finish = ("length" if out["completion_tokens"] >= max_tokens
                       else "stop")
             return {
@@ -478,18 +534,19 @@ class OpenAIServer(LLMServer):
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
         if stream:
-            sid = self.engine.start_stream(
+            sid = eng.start_stream(
                 prompt=prompt, prompt_ids=body.get("prompt_ids"),
                 max_tokens=max_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p)
+            self._stream_owner[sid] = eng
             return {"__sse_stream__": {"stream_id": sid,
                                        "model": self.model_id,
                                        "mode": "completion"}}
-        out = self.engine.generate(prompt=prompt,
-                                   prompt_ids=body.get("prompt_ids"),
-                                   max_tokens=max_tokens,
-                                   temperature=temperature, top_k=top_k,
-                                   top_p=top_p)
+        out = eng.generate(prompt=prompt,
+                           prompt_ids=body.get("prompt_ids"),
+                           max_tokens=max_tokens,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p)
         finish = ("length" if out["completion_tokens"] >= max_tokens
                   else "stop")
         return {

@@ -233,3 +233,61 @@ def test_tp_engine_with_prefix_cache():
     finally:
         eng.shutdown()
         ref.shutdown()
+
+
+def test_lora_multiplexing():
+    """Multi-LoRA serving: request model '<base>:<adapter>' merges the
+    adapter into the base weights under an LRU of per-adapter engines;
+    evicted engines shut down; base requests untouched."""
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.serve.llm import OpenAIServer
+    from ray_tpu.utils.platform import ensure_virtual_cpu
+
+    ensure_virtual_cpu(1)
+    root = tempfile.mkdtemp(prefix="lora_")
+    cfg = gpt2.GPT2Config.preset("gpt2-tiny", max_seq_len=96)
+    rng = np.random.default_rng(0)
+    L, D = cfg.n_layer, cfg.d_model
+    for name, scale in (("alpha_big", 4.0), ("beta", 0.5), ("gamma", 1.0)):
+        np.savez(os.path.join(root, f"{name}.npz"), **{
+            "blocks.attn.wqkv.A": (rng.normal(size=(L, D, 4))
+                                   * 0.3 * scale).astype(np.float32),
+            "blocks.attn.wqkv.B": (rng.normal(size=(L, 4, 3 * D))
+                                   * 0.3 * scale).astype(np.float32),
+            "blocks.attn.wqkv.alpha": np.float32(8.0),
+        })
+    srv = OpenAIServer(model_id="tiny", lora_root=root, max_loras=2,
+                       preset="gpt2-tiny", max_batch=2, max_seq_len=96,
+                       seed=3, enable_prefix_caching=False)
+    try:
+        body = {"prompt": "the quick brown fox", "max_tokens": 6,
+                "temperature": 0.0}
+        base = srv({**body})["choices"][0]["text"]
+        srv({**body, "model": "tiny:alpha_big"})
+        assert srv.loaded_lora_ids() == ["alpha_big"]
+        # merged engine really carries different weights; base untouched
+        import jax.numpy as jnp
+
+        eng_a = srv._lora_engines["alpha_big"]
+        assert not bool(jnp.allclose(
+            eng_a.params["blocks"]["attn"]["wqkv"],
+            srv.engine.params["blocks"]["attn"]["wqkv"]))
+        assert srv({**body})["choices"][0]["text"] == base
+        srv({**body, "model": "tiny:beta"})
+        assert set(srv.loaded_lora_ids()) == {"alpha_big", "beta"}
+        # third adapter evicts the LRU one (alpha_big)
+        srv({**body, "model": "tiny:gamma"})
+        assert set(srv.loaded_lora_ids()) == {"beta", "gamma"}
+        # cached adapter engine reused: same output deterministically
+        assert srv({**body, "model": "tiny:beta"})["choices"][0]["text"] \
+            == srv({**body, "model": "tiny:beta"})["choices"][0]["text"]
+    finally:
+        srv.engine.shutdown()
+        for e in srv._lora_engines.values():
+            e.shutdown()
