@@ -23,6 +23,12 @@ class ScalingConfig:
     """
 
     num_workers: int = 1
+    # elastic range (reference elastic ScalingPolicy): when set, the
+    # controller sizes each (re)start to the resources actually
+    # available, between min_workers and num_workers — a shrunken
+    # cluster restarts smaller instead of waiting, and grows back on the
+    # next restart
+    min_workers: Optional[int] = None
     use_tpu: bool = False
     topology: Optional[str] = None          # e.g. "v5e-16" (a pod type)
     chips_per_worker: Optional[int] = None  # default: all chips of a host

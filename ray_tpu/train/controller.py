@@ -44,6 +44,36 @@ class TrainControllerLogic:
         self._slice_reservation = None
 
     # ----------------------------------------------------------- scheduling
+    def _elastic_size(self) -> int:
+        """Elastic resize decision (reference scaling_policy): fit the
+        group to what the cluster can actually hold right now, within
+        [min_workers, num_workers]. Waits (bounded) for min_workers'
+        worth of resources before giving up to the normal failure path."""
+        want = self.scaling.num_workers
+        lo = self.scaling.min_workers
+        if not lo or lo >= want:
+            return want
+        import ray_tpu
+        from ray_tpu.core.api import _global_client
+
+        per = self.scaling.worker_resources()
+        deadline = time.time() + 60
+        while True:
+            try:
+                info = _global_client().head_request("cluster_info")
+                avail = info.get("available_resources", {})
+            except Exception:
+                return want
+            fit = want
+            for r, v in per.items():
+                if v > 0:
+                    fit = min(fit, int(avail.get(r, 0) // v))
+            if fit >= lo:
+                return min(max(fit, lo), want)
+            if time.time() > deadline:
+                return lo    # let group.start surface the real failure
+            time.sleep(1.0)
+
     def _build_group(self) -> WorkerGroup:
         label_selector = None
         pg = None
@@ -53,7 +83,15 @@ class TrainControllerLogic:
             if self._slice_reservation is None:
                 self._slice_reservation = reserve_tpu_slice(self.scaling.topology)
             label_selector = self._slice_reservation.label_selector
-        return WorkerGroup(self.scaling, label_selector=label_selector,
+        scaling = self.scaling
+        size = self._elastic_size()
+        if size != scaling.num_workers:
+            import dataclasses as _dc
+
+            scaling = _dc.replace(scaling, num_workers=size)
+            self.state = "RESIZING"
+        self.current_world_size = size
+        return WorkerGroup(scaling, label_selector=label_selector,
                            placement_group=pg)
 
     def _resume_checkpoint(self) -> Optional[Checkpoint]:

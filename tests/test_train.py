@@ -147,3 +147,32 @@ def test_resume_from_checkpoint(cluster, tmp_path):
     r2 = t2.fit()
     assert r2.metrics["resumed_from"] == 2
     assert r2.metrics["step"] == 3
+
+
+def test_elastic_scaling_fits_available_resources(cluster):
+    """min_workers elastic range (reference elastic ScalingPolicy): an
+    oversized ask starts at cluster capacity instead of hanging;
+    world_size reflects the resize."""
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.controller import TrainControllerLogic
+
+    def train_fn(config):
+        from ray_tpu.train import session
+
+        ctx = session.get_context()
+        session.report({"world": ctx.world_size, "rank": ctx.rank})
+
+    import tempfile
+
+    logic = TrainControllerLogic(
+        train_fn, {},
+        ScalingConfig(num_workers=32, min_workers=1,
+                      resources_per_worker={"CPU": 1}),
+        RunConfig(name="elastic", storage_path=tempfile.mkdtemp()))
+    result = logic.run()
+    assert result["state"] == "FINISHED", result["error"]
+    world = result["metrics"]["world"]
+    # an 8-CPU cluster cannot hold 32 single-CPU workers: elastic fits
+    # the group to capacity instead of hanging on an impossible ask
+    assert 1 <= world <= 8, world
+    assert logic.current_world_size == world
