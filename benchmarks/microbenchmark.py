@@ -258,6 +258,56 @@ def main(out_path: str | None = None) -> dict:
     results["placement_group_create/removal"] = timeit(pg_cycle, warmup=0,
                                                        repeat=2)
 
+    # ---- Ray-Client-equivalent overhead (reference "client__*" rows):
+    # a REMOTE driver over the one multiplexed proxy port, run in a
+    # subprocess so the measurement includes the full relay hop
+    phase("client__overhead")
+    info = ray_tpu.core.api._global_client().head_request("cluster_info")
+    cp_port = info.get("client_proxy_port")
+    if cp_port:
+        import subprocess
+        import sys as _sys
+
+        script = (
+            "import json, time, ray_tpu\n"
+            f"ray_tpu.init(address='ray-tpu://127.0.0.1:{cp_port}')\n"
+            "@ray_tpu.remote\n"
+            "class S:\n"
+            "    def ping(self):\n"
+            "        return b'ok'\n"
+            "@ray_tpu.remote\n"
+            "def noop():\n"
+            "    return None\n"
+            "s = S.remote()\n"
+            "ray_tpu.get(s.ping.remote())\n"
+            "t0 = time.perf_counter()\n"
+            "for _ in range(300):\n"
+            "    ray_tpu.get(s.ping.remote())\n"
+            "sync = 300 / (time.perf_counter() - t0)\n"
+            "t0 = time.perf_counter()\n"
+            "ray_tpu.get([s.ping.remote() for _ in range(1000)])\n"
+            "asyn = 1000 / (time.perf_counter() - t0)\n"
+            "ray_tpu.get(noop.remote())\n"
+            "t0 = time.perf_counter()\n"
+            "ray_tpu.get([noop.remote() for _ in range(1000)])\n"
+            "tasks = 1000 / (time.perf_counter() - t0)\n"
+            "print('CLIENT_JSON ' + json.dumps({'sync': sync,"
+            " 'async': asyn, 'tasks': tasks}))\n"
+            "ray_tpu.shutdown()\n")
+        try:
+            out = subprocess.run(
+                [_sys.executable, "-c", script], capture_output=True,
+                text=True, timeout=300,
+                env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+            for line in out.stdout.splitlines():
+                if line.startswith("CLIENT_JSON "):
+                    vals = json.loads(line.split(" ", 1)[1])
+                    results["client__1_1_actor_calls_sync"] = vals["sync"]
+                    results["client__1_1_actor_calls_async"] = vals["async"]
+                    results["client__tasks_async"] = vals["tasks"]
+        except Exception as e:
+            print(f"client phase skipped: {e!r}")
+
     ray_tpu.shutdown()
     import os as _os
 
@@ -276,7 +326,10 @@ def main(out_path: str | None = None) -> dict:
                   "single_client_put_gigabytes": 19.9,
                   "multi_client_put_gigabytes": 38.1,
                   "single_client_get_calls_Plasma_Store": 10620,
-                  "placement_group_create/removal": 765},
+                  "placement_group_create/removal": 765,
+                  "client__1_1_actor_calls_sync": 538,
+                  "client__1_1_actor_calls_async": 884,
+                  "client__tasks_async": 790},
               "notes": {
                   "multi_client_tasks_async":
                       "r5: lease grant/revoke churn fixed — multi-client "
