@@ -537,6 +537,30 @@ class Dataset:
         if carry is not None and not drop_last:
             yield block_to_batch(carry, batch_format)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           dtypes=None, device=None) -> Iterator[Any]:
+        """iter_batches with torch-tensor conversion (reference
+        `Dataset.iter_torch_batches`): column dicts become dicts of
+        tensors, optionally cast/moved."""
+        import torch
+
+        def to_t(v):
+            t = torch.as_tensor(np.ascontiguousarray(v))
+            if dtypes is not None:
+                t = t.to(dtypes)
+            if device is not None:
+                t = t.to(device)
+            return t
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if isinstance(batch, dict):
+                yield {k: to_t(v) for k, v in batch.items()}
+            else:
+                yield to_t(np.asarray(batch))
+
     def iter_rows(self) -> Iterator[Any]:
         for block in self._stream_blocks():
             yield from rows_of(block)

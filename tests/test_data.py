@@ -563,3 +563,23 @@ def test_tfrecords_crc_is_valid(cluster, tmp_path):
     data = raw[12:12 + length]
     (data_crc,) = struct.unpack("<I", raw[12 + length:16 + length])
     assert data_crc == _masked_crc(data)
+
+
+def test_iter_torch_batches(cluster):
+    import torch
+
+    import ray_tpu.data as rd
+
+    ds = rd.range(100, parallelism=4).map_batches(
+        lambda b: {"id": b["id"], "f": b["id"].astype("float64") / 2})
+    seen = 0
+    for batch in ds.iter_torch_batches(batch_size=32):
+        assert isinstance(batch["id"], torch.Tensor)
+        assert batch["f"].dtype == torch.float64
+        seen += len(batch["id"])
+    assert seen == 100
+    # dtype cast + drop_last
+    batches = list(ds.iter_torch_batches(batch_size=32, drop_last=True,
+                                         dtypes=torch.float32))
+    assert all(b["id"].dtype == torch.float32 for b in batches)
+    assert sum(len(b["id"]) for b in batches) == 96
