@@ -583,3 +583,52 @@ def test_iter_torch_batches(cluster):
                                          dtypes=torch.float32))
     assert all(b["id"].dtype == torch.float32 for b in batches)
     assert sum(len(b["id"]) for b in batches) == 96
+
+
+def test_preprocessors_family(cluster):
+    """StandardScaler / MinMaxScaler / LabelEncoder / OneHotEncoder /
+    Concatenator / Chain (reference ray.data.preprocessors): streamed
+    fit on the cluster, lazy transform, batch-level serving path."""
+    import ray_tpu.data as rd
+    from ray_tpu.data.preprocessors import (Chain, Concatenator,
+                                            LabelEncoder, MinMaxScaler,
+                                            OneHotEncoder, StandardScaler)
+
+    rng = np.random.default_rng(0)
+    n = 500
+    ds = rd.from_numpy({
+        "x": rng.normal(10.0, 4.0, n),
+        "y": rng.uniform(-3, 7, n),
+        "label": rng.choice(["cat", "dog", "bird"], n),
+    }, parallelism=4)
+
+    sc = StandardScaler(columns=["x"]).fit(ds)
+    out = np.concatenate([b["x"] for b in
+                          sc.transform(ds).iter_batches(batch_size=128)])
+    assert abs(out.mean()) < 0.05 and abs(out.std() - 1) < 0.05
+
+    mm = MinMaxScaler(columns=["y"]).fit(ds)
+    out = np.concatenate([b["y"] for b in
+                          mm.transform(ds).iter_batches(batch_size=128)])
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+    le = LabelEncoder(label_column="label").fit(ds)
+    assert list(le.classes_) == ["bird", "cat", "dog"]
+    rows = le.transform(ds).take(5)
+    assert all(isinstance(int(r["label"]), int) for r in rows)
+
+    oh = OneHotEncoder(columns=["label"]).fit(ds)
+    b = next(oh.transform(ds).iter_batches(batch_size=64))
+    assert {"label_bird", "label_cat", "label_dog"} <= set(b)
+    assert (b["label_bird"] + b["label_cat"] + b["label_dog"] == 1).all()
+
+    chain = Chain(StandardScaler(columns=["x", "y"]),
+                  Concatenator(columns=["x", "y"],
+                               output_column_name="features"))
+    chain.fit(ds)
+    b = next(chain.transform(ds).iter_batches(batch_size=64))
+    assert b["features"].shape == (64, 2)
+    # serving path: single-batch transform matches dataset transform
+    raw = next(ds.iter_batches(batch_size=64))
+    np.testing.assert_allclose(chain.transform_batch(raw)["features"],
+                               b["features"], rtol=1e-5)
