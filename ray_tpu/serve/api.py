@@ -99,8 +99,17 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0) -> int:
 
 def run(target: Deployment, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None,
-        _blocking: bool = True) -> DeploymentHandle:
-    """Deploy and return a handle (reference serve.run)."""
+        _blocking: bool = True,
+        _local_testing_mode: bool = False):
+    """Deploy and return a handle (reference serve.run).
+
+    `_local_testing_mode=True` runs the deployment IN-PROCESS with no
+    cluster (reference local_testing_mode): unit-test deployment logic
+    without actors/proxies."""
+    if _local_testing_mode:
+        return LocalDeploymentHandle(
+            target if name is None else dataclasses.replace(target,
+                                                            name=name))
     controller = _get_or_create_controller()
     dep_name = name or target.name
     ray_tpu.get(controller.deploy.remote(dep_name, target.to_config()),
@@ -170,3 +179,63 @@ def shutdown() -> None:
         ray_tpu.kill(controller)
     except Exception:
         pass
+
+
+# ------------------------------------------------------ local testing mode
+class _LocalResponse:
+    """Synchronous stand-in for DeploymentResponse (.result())."""
+
+    def __init__(self, value=None, exc=None):
+        self._value, self._exc = value, exc
+
+    def result(self, timeout: Optional[float] = None):
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _LocalMethod:
+    def __init__(self, inst, name: str):
+        self._inst, self._name = inst, name
+
+    def remote(self, *args, **kwargs) -> _LocalResponse:
+        try:
+            return _LocalResponse(getattr(self._inst, self._name)(
+                *args, **kwargs))
+        except Exception as e:  # surfaced at .result(), like the real path
+            return _LocalResponse(exc=e)
+
+
+class LocalDeploymentHandle:
+    """In-process deployment execution — no cluster, no actors
+    (reference `serve/_private/local_testing_mode.py`): the user callable
+    is constructed HERE and every .remote() runs synchronously. For unit
+    tests of deployment logic."""
+
+    def __init__(self, dep: Deployment):
+        c = dep.func_or_class
+        if isinstance(c, type):
+            self._inst = c(*dep.init_args, **(dep.init_kwargs or {}))
+        else:
+            self._inst = c
+        if dep.user_config is not None and hasattr(self._inst,
+                                                   "reconfigure"):
+            self._inst.reconfigure(dep.user_config)
+        self.deployment_name = dep.name
+
+    def remote(self, *args, **kwargs) -> _LocalResponse:
+        try:
+            return _LocalResponse(self._inst(*args, **kwargs))
+        except Exception as e:
+            return _LocalResponse(exc=e)
+
+    def options(self, method_name: Optional[str] = None,
+                **_ignored) -> Any:
+        if method_name:
+            return _LocalMethod(self._inst, method_name)
+        return self
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _LocalMethod(self._inst, name)

@@ -137,3 +137,46 @@ def test_status_and_delete(cluster):
     serve.delete("temp")
     time.sleep(0.3)
     assert "temp" not in serve.status()
+
+
+def test_local_testing_mode():
+    """serve.run(..., _local_testing_mode=True): deployment runs
+    in-process with NO cluster (reference local_testing_mode) — same
+    handle call shapes (.remote().result(), method access, options)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Doubler:
+        def __init__(self, bias=0):
+            self.bias = bias
+            self.cfg = None
+
+        def __call__(self, x):
+            return 2 * x + self.bias
+
+        def name(self):
+            return "doubler"
+
+        def reconfigure(self, cfg):
+            self.cfg = cfg
+
+    h = serve.run(Doubler.bind(bias=1).options(user_config={"k": "v"}),
+                  _local_testing_mode=True)
+    assert h.remote(20).result() == 41
+    assert h.name.remote().result() == "doubler"
+    assert h.options(method_name="name").remote().result() == "doubler"
+    # user_config drove reconfigure, like a real replica start
+    assert h._inst.cfg == {"k": "v"}
+
+    @serve.deployment
+    def plain(x):
+        if x < 0:
+            raise ValueError("negative")
+        return x + 1
+
+    hf = serve.run(plain.bind(), _local_testing_mode=True)
+    assert hf.remote(4).result() == 5
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="negative"):
+        hf.remote(-1).result()
