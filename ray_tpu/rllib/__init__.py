@@ -16,6 +16,7 @@ from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.iql import IQL, IQLConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.env.envs import (Box, CartPole, Discrete, Env, Pendulum,
@@ -29,7 +30,7 @@ from ray_tpu.rllib.core.rl_module import ModuleSpec, RLModule, spec_from_env
 __all__ = [
     "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
-    "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "IQL", "IQLConfig",
+    "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "IQL", "IQLConfig", "DreamerV3", "DreamerV3Config",
     "Box", "CartPole", "Discrete", "Env", "Pendulum",
     "VectorEnv", "make_env", "register_env", "SingleAgentEnvRunner",
     "MultiAgentEnv", "MultiAgentEnvRunner", "TargetMatch",

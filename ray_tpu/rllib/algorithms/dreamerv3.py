@@ -1,0 +1,461 @@
+"""DreamerV3 (compact): world-model RL with imagination training.
+
+Behavioral parity (scoped) with `rllib/algorithms/dreamerv3/` — the
+three-part DreamerV3 recipe on vector observations and discrete actions:
+
+1. **RSSM world model**: deterministic GRU path + categorical stochastic
+   latents (Kx8 one-hots, straight-through gradients); posterior
+   q(z | h, obs) vs prior p(z | h) trained with KL-balance and free
+   bits; symlog MSE decoder and reward heads, Bernoulli continue head.
+2. **Imagination actor-critic**: trajectories dreamed from posterior
+   states with the ACTOR (the world model is frozen for these grads);
+   critic regresses lambda-returns on symlog targets; discrete actor
+   uses REINFORCE with the critic baseline + entropy bonus, with
+   returns normalized by an EMA percentile scale (the v3 trick that
+   removes per-env reward tuning).
+
+Deliberate simplifications (documented, not hidden): MLP encoders only
+(no CNN — vector envs), plain symlog-MSE instead of twohot distributional
+heads, one shared imagination horizon. The pieces the reference's tests
+check — RSSM posterior/prior geometry, KL balance, symlog, imagination
+rollouts detached from the world model, percentile return scaling —
+are all here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import spec_from_env
+from ray_tpu.rllib.env.envs import make_env
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def _mlp_init(key, sizes):
+    out = []
+    for m, n in zip(sizes[:-1], sizes[1:]):
+        key, sub = jax.random.split(key)
+        out.append({"w": jax.random.normal(sub, (m, n)) * jnp.sqrt(2.0 / m),
+                    "b": jnp.zeros(n)})
+    return out
+
+
+def _mlp(params, x, act=jax.nn.silu, final_act=None):
+    for i, p in enumerate(params):
+        x = x @ p["w"] + p["b"]
+        if i < len(params) - 1:
+            x = act(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x
+
+
+class DreamerV3Learner:
+    """Owns world-model, actor, and critic params + their optimizers."""
+
+    def __init__(self, obs_dim: int, n_actions: int, cfg: "DreamerV3Config"):
+        c = cfg
+        self.cfg = cfg
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.zdim = c.stoch_groups * c.stoch_classes
+        key = jax.random.key(c.seed)
+        ks = jax.random.split(key, 12)
+        D, H, Z, A = c.deter_dim, c.hidden, self.zdim, n_actions
+        wm = {
+            "enc": _mlp_init(ks[0], [obs_dim, H, H]),
+            # GRU over [z + a] with deter state h
+            "gru_x": _mlp_init(ks[1], [Z + A, 3 * D]),
+            "gru_h": _mlp_init(ks[2], [D, 3 * D]),
+            "prior": _mlp_init(ks[3], [D, H, Z]),
+            "post": _mlp_init(ks[4], [D + H, H, Z]),
+            "dec": _mlp_init(ks[5], [D + Z, H, obs_dim]),
+            "rew": _mlp_init(ks[6], [D + Z, H, 1]),
+            "cont": _mlp_init(ks[7], [D + Z, H, 1]),
+        }
+        self.wm = wm
+        self.actor = _mlp_init(ks[8], [D + Z, H, A])
+        self.critic = _mlp_init(ks[9], [D + Z, H, 1])
+        self.wm_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                  optax.adam(c.wm_lr))
+        self.ac_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                  optax.adam(c.ac_lr))
+        self.wm_opt_state = self.wm_opt.init(self.wm)
+        self.actor_opt_state = self.ac_opt.init(self.actor)
+        self.critic_opt_state = self.ac_opt.init(self.critic)
+        self._rng = jax.random.key(c.seed + 1)
+        # EMA percentile scale for return normalization (v3 §actor)
+        self.ret_scale = jnp.float32(1.0)
+        self._wm_update = jax.jit(self._make_wm_update())
+        self._ac_update = jax.jit(self._make_ac_update())
+
+    # ------------------------------------------------------- RSSM pieces
+    def _gru(self, wm, h, x):
+        gates_x = _mlp(wm["gru_x"], x)
+        gates_h = _mlp(wm["gru_h"], h)
+        r_x, u_x, c_x = jnp.split(gates_x, 3, -1)
+        r_h, u_h, c_h = jnp.split(gates_h, 3, -1)
+        r = jax.nn.sigmoid(r_x + r_h)
+        u = jax.nn.sigmoid(u_x + u_h)
+        cand = jnp.tanh(c_x + r * c_h)
+        return u * cand + (1 - u) * h
+
+    def _sample_categorical(self, logits, rng):
+        """Straight-through one-hot sample over stoch groups.
+        logits [..., G*C] -> one-hot sample [..., G*C]."""
+        c = self.cfg
+        shape = logits.shape[:-1] + (c.stoch_groups, c.stoch_classes)
+        lg = logits.reshape(shape)
+        # unimix: 1% uniform mixed in (v3's fix for determinism collapse)
+        probs = 0.99 * jax.nn.softmax(lg, -1) + 0.01 / c.stoch_classes
+        lg = jnp.log(probs)
+        idx = jax.random.categorical(rng, lg)
+        one = jax.nn.one_hot(idx, c.stoch_classes)
+        # straight-through: sample forward, softmax gradient backward
+        one = one + probs - jax.lax.stop_gradient(probs)
+        return one.reshape(logits.shape), lg
+
+    def _kl(self, lhs_logits, rhs_logits):
+        """KL(lhs || rhs) summed over groups; logits [..., G*C]."""
+        c = self.cfg
+        shape = lhs_logits.shape[:-1] + (c.stoch_groups, c.stoch_classes)
+        lp = jax.nn.log_softmax(lhs_logits.reshape(shape), -1)
+        rp = jax.nn.log_softmax(rhs_logits.reshape(shape), -1)
+        return (jnp.exp(lp) * (lp - rp)).sum(-1).sum(-1)
+
+    # ------------------------------------------------------ world model
+    def _make_wm_update(self):
+        c = self.cfg
+
+        def wm_loss(wm, batch, rng):
+            obs = symlog(batch["obs"])            # [B, L, obs]
+            acts = jax.nn.one_hot(batch["actions"], self.n_actions)
+            B, L = obs.shape[:2]
+            emb = _mlp(wm["enc"], obs)            # [B, L, H]
+            h0 = jnp.zeros((B, c.deter_dim))
+            z0 = jnp.zeros((B, self.zdim))
+            keys = jax.random.split(rng, L)
+
+            def step(carry, xt):
+                h, z = carry
+                e_t, a_t, k_t = xt
+                h = self._gru(wm, h, jnp.concatenate([z, a_t], -1))
+                prior_logits = _mlp(wm["prior"], h)
+                post_logits = _mlp(wm["post"],
+                                   jnp.concatenate([h, e_t], -1))
+                z, post_lg = self._sample_categorical(post_logits, k_t)
+                return (h, z), (h, z, prior_logits, post_logits)
+
+            (_, _), (hs, zs, priors, posts) = jax.lax.scan(
+                step, (h0, z0),
+                (emb.swapaxes(0, 1), acts.swapaxes(0, 1), keys))
+            feat = jnp.concatenate([hs, zs], -1)          # [L, B, D+Z]
+            obs_hat = _mlp(wm["dec"], feat)
+            rew_hat = _mlp(wm["rew"], feat)[..., 0]
+            cont_logit = _mlp(wm["cont"], feat)[..., 0]
+            obs_t = obs.swapaxes(0, 1)
+            rec = ((obs_hat - obs_t) ** 2).sum(-1).mean()
+            rew = ((rew_hat - symlog(batch["rewards"].swapaxes(0, 1)))
+                   ** 2).mean()
+            cont_t = 1.0 - batch["dones"].swapaxes(0, 1)
+            cont = optax.sigmoid_binary_cross_entropy(
+                cont_logit, cont_t).mean()
+            # KL balance with free bits (v3: dyn 0.5 / rep 0.1, clip 1.0)
+            dyn = jnp.maximum(self._kl(jax.lax.stop_gradient(posts),
+                                       priors), 1.0).mean()
+            rep = jnp.maximum(self._kl(posts,
+                                       jax.lax.stop_gradient(priors)),
+                              1.0).mean()
+            loss = rec + rew + cont + 0.5 * dyn + 0.1 * rep
+            return loss, {"wm_rec": rec, "wm_rew": rew, "wm_cont": cont,
+                          "wm_kl_dyn": dyn,
+                          "feat": jax.lax.stop_gradient(feat)}
+
+        def update(wm, opt_state, batch, rng):
+            (l, aux), g = jax.value_and_grad(wm_loss, has_aux=True)(
+                wm, batch, rng)
+            upd, opt_state = self.wm_opt.update(g, opt_state)
+            return optax.apply_updates(wm, upd), opt_state, l, aux
+
+        return update
+
+    # --------------------------------------------------- actor + critic
+    def _make_ac_update(self):
+        c = self.cfg
+
+        def imagine(wm, actor, start_feat, rng):
+            """Dream H steps from start states. Returns feats [H+1, N, F],
+            actions, rewards, continues (world model frozen)."""
+            D = c.deter_dim
+            h = start_feat[..., :D]
+            z = start_feat[..., D:]
+            N = h.shape[0]
+
+            def step(carry, k):
+                h, z = carry
+                feat = jnp.concatenate([h, z], -1)
+                logits = _mlp(actor, feat)
+                ka, kz = jax.random.split(k)
+                a = jax.random.categorical(ka, logits)
+                a1 = jax.nn.one_hot(a, self.n_actions)
+                h2 = self._gru(wm, h, jnp.concatenate([z, a1], -1))
+                prior_logits = _mlp(wm["prior"], h2)
+                z2, _ = self._sample_categorical(prior_logits, kz)
+                return (h2, z2), (feat, a)
+
+            keys = jax.random.split(rng, c.horizon)
+            (h, z), (feats, acts) = jax.lax.scan(step, (h, z), keys)
+            last = jnp.concatenate([h, z], -1)[None]
+            feats = jnp.concatenate([feats, last], 0)   # [H+1, N, F]
+            rew = symexp(_mlp(wm["rew"], feats)[..., 0])
+            cont = jax.nn.sigmoid(_mlp(wm["cont"], feats)[..., 0])
+            return feats, acts, rew, cont
+
+        def lambda_returns(rew, cont, values):
+            """TD(lambda) over imagined steps: [H+1, N] inputs."""
+            disc = cont * c.gamma
+
+            def step(nxt, xt):
+                r_t, d_t, v_t1 = xt
+                ret = r_t + d_t * ((1 - c.lam) * v_t1 + c.lam * nxt)
+                return ret, ret
+
+            last = values[-1]
+            _, rets = jax.lax.scan(
+                step, last,
+                (rew[:-1][::-1], disc[1:][::-1], values[1:][::-1]))
+            return rets[::-1]                            # [H, N]
+
+        def ac_loss(actor, critic, wm, start_feat, ret_scale, rng):
+            feats, acts, rew, cont = imagine(wm, actor, start_feat, rng)
+            feats = jax.lax.stop_gradient(feats)   # REINFORCE actor: no
+            acts = jax.lax.stop_gradient(acts)     # grads through dynamics
+            values = symexp(_mlp(critic, feats)[..., 0])
+            rets = lambda_returns(rew, cont,
+                                  jax.lax.stop_gradient(values))
+            # critic: symlog MSE toward lambda-returns
+            critic_loss = ((_mlp(critic, feats[:-1])[..., 0]
+                            - jax.lax.stop_gradient(symlog(rets))) ** 2
+                           ).mean()
+            # actor: REINFORCE with critic baseline, percentile-scaled
+            adv = (rets - values[:-1]) / jnp.maximum(ret_scale, 1.0)
+            logits = _mlp(actor, feats[:-1])
+            logp = jax.nn.log_softmax(logits)
+            lp_a = jnp.take_along_axis(logp, acts[..., None], -1)[..., 0]
+            ent = -(jnp.exp(logp) * logp).sum(-1)
+            # weight imagined steps by survival probability
+            weight = jnp.cumprod(
+                jnp.concatenate([jnp.ones_like(cont[:1]),
+                                 cont[:-2] * c.gamma], 0), 0)
+            weight = jax.lax.stop_gradient(weight)
+            actor_loss = -(weight * (
+                jax.lax.stop_gradient(adv) * lp_a
+                + c.entropy_coef * ent)).mean()
+            new_scale = jnp.percentile(rets, 95) - jnp.percentile(rets, 5)
+            return actor_loss + critic_loss, {
+                "actor_loss": actor_loss, "critic_loss": critic_loss,
+                "imag_return_mean": rets.mean(), "actor_entropy": ent.mean(),
+                "ret_scale": new_scale}
+
+        def update(actor, critic, a_state, c_state, wm, start_feat,
+                   ret_scale, rng):
+            (l, metrics), (ga, gc) = jax.value_and_grad(
+                ac_loss, argnums=(0, 1), has_aux=True)(
+                actor, critic, wm, start_feat, ret_scale, rng)
+            ua, a_state = self.ac_opt.update(ga, a_state)
+            uc, c_state = self.ac_opt.update(gc, c_state)
+            return (optax.apply_updates(actor, ua),
+                    optax.apply_updates(critic, uc), a_state, c_state,
+                    metrics)
+
+        return update
+
+    # ------------------------------------------------------------ public
+    def update(self, batch: Dict[str, np.ndarray]) -> dict:
+        self._rng, k1, k2 = jax.random.split(self._rng, 3)
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.wm, self.wm_opt_state, wm_l, aux = self._wm_update(
+            self.wm, self.wm_opt_state, jb, k1)
+        feat = aux.pop("feat")                        # [L, B, F]
+        start = feat.reshape(-1, feat.shape[-1])
+        if len(start) > self.cfg.imag_starts:
+            self._rng, ks = jax.random.split(self._rng)
+            idx = jax.random.choice(ks, len(start),
+                                    (self.cfg.imag_starts,), replace=False)
+            start = start[idx]
+        (self.actor, self.critic, self.actor_opt_state,
+         self.critic_opt_state, m) = self._ac_update(
+            self.actor, self.critic, self.actor_opt_state,
+            self.critic_opt_state, self.wm, start, self.ret_scale, k2)
+        # EMA of the return percentile scale
+        self.ret_scale = 0.99 * self.ret_scale + 0.01 * m.pop("ret_scale")
+        out = {"wm_loss": float(wm_l)}
+        out.update({k: float(v) for k, v in aux.items()})
+        out.update({k: float(v) for k, v in m.items()})
+        out["ret_scale"] = float(self.ret_scale)
+        return out
+
+    def act(self, obs: np.ndarray, state, rng_np) -> Tuple[np.ndarray, tuple]:
+        """Environment-side policy: posterior filtering + actor sample.
+        state = (h, z, last_action_onehot) per env."""
+        c = self.cfg
+        obs = symlog(jnp.asarray(obs, jnp.float32))
+        B = obs.shape[0]
+        if state is None:
+            state = (jnp.zeros((B, c.deter_dim)),
+                     jnp.zeros((B, self.zdim)),
+                     jnp.zeros((B, self.n_actions)))
+        h, z, a1 = state
+        emb = _mlp(self.wm["enc"], obs)
+        h = self._gru(self.wm, h, jnp.concatenate([z, a1], -1))
+        post_logits = _mlp(self.wm["post"], jnp.concatenate([h, emb], -1))
+        self._rng, kz, ka = jax.random.split(self._rng, 3)
+        z, _ = self._sample_categorical(post_logits, kz)
+        logits = _mlp(self.actor, jnp.concatenate([h, z], -1))
+        a = jax.random.categorical(ka, logits)
+        a1 = jax.nn.one_hot(a, self.n_actions)
+        return np.asarray(a), (h, z, a1)
+
+    # Algorithm-base compatibility: the generic env-runner group syncs
+    # "policy weights" at init; Dreamer drives its own env loop (the
+    # posterior filter is part of the policy), so these are only a
+    # checkpoint-shaped view of the actor
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.actor)
+
+    def set_weights(self, params) -> None:
+        pass   # runner-side no-op; Dreamer's act() lives on the learner
+
+    def get_state(self) -> dict:
+        t = lambda p: jax.tree.map(np.asarray, p)  # noqa: E731
+        return {"wm": t(self.wm), "actor": t(self.actor),
+                "critic": t(self.critic),
+                "ret_scale": float(self.ret_scale)}
+
+    def set_state(self, state: dict) -> None:
+        t = lambda p: jax.tree.map(jnp.asarray, p)  # noqa: E731
+        self.wm = t(state["wm"])
+        self.actor = t(state["actor"])
+        self.critic = t(state["critic"])
+        self.ret_scale = jnp.float32(state["ret_scale"])
+
+
+class _SeqBuffer:
+    """Uniform sequence replay: stores transitions in ring order, samples
+    contiguous [B, L] windows (the reference's episodic replay, flat)."""
+
+    def __init__(self, capacity: int, obs_dim: int, seed: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.size = 0
+        self._i = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, obs, action, reward, done):
+        i = self._i
+        self.obs[i] = obs
+        self.actions[i] = action
+        self.rewards[i] = reward
+        self.dones[i] = done
+        self._i = (i + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch: int, length: int) -> Dict[str, np.ndarray]:
+        starts = self._rng.integers(0, self.size - length, batch)
+        idx = starts[:, None] + np.arange(length)[None]
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "dones": self.dones[idx]}
+
+
+class DreamerV3(Algorithm):
+    def _module_spec(self, env):
+        spec = spec_from_env(env)
+        if not spec.discrete:
+            raise ValueError("this DreamerV3 targets discrete actions")
+        return spec
+
+    def _build_learner(self, mesh):
+        spec = self.module_spec
+        self._buffer = _SeqBuffer(self.config.replay_capacity,
+                                  spec.obs_dim, self.config.seed)
+        return DreamerV3Learner(spec.obs_dim, spec.action_dim, self.config)
+
+    # Dreamer drives its own env loop (posterior filtering state is part
+    # of the policy), so it bypasses the generic env-runner group.
+    def _init_env_loop(self):
+        if getattr(self, "_env", None) is None:
+            self._env = make_env(self.config.env, **self.config.env_kwargs)
+            self._obs, _ = self._env.reset(seed=self.config.seed)
+            self._policy_state = None
+
+    def training_step(self) -> dict:
+        c = self.config
+        self._init_env_loop()
+        ep_returns = []
+        ep_ret = getattr(self, "_ep_ret", 0.0)
+        for _ in range(c.env_steps_per_iteration):
+            a, self._policy_state = self.learner.act(
+                self._obs[None], self._policy_state, None)
+            nxt, r, term, trunc, _ = self._env.step(int(a[0]))
+            self._buffer.add(self._obs, int(a[0]), r, float(term))
+            ep_ret += r
+            self._obs = nxt
+            if term or trunc:
+                ep_returns.append(ep_ret)
+                ep_ret = 0.0
+                self._obs, _ = self._env.reset()
+                self._policy_state = None
+        self._ep_ret = ep_ret
+        self._timesteps += c.env_steps_per_iteration
+        metrics = {}
+        if self._buffer.size > c.seq_len * 2 + c.batch_size:
+            for _ in range(c.updates_per_iteration):
+                batch = self._buffer.sample(c.batch_size, c.seq_len)
+                metrics = self.learner.update(batch)
+        if ep_returns:
+            metrics["episode_return_mean"] = float(np.mean(ep_returns))
+        return metrics
+
+    def stop(self):
+        if getattr(self, "_env", None) is not None:
+            self._env.close()
+        super().stop()
+
+
+class DreamerV3Config(AlgorithmConfig):
+    algo_class = DreamerV3
+
+    def __init__(self):
+        super().__init__()
+        self.wm_lr = 1e-3
+        self.ac_lr = 3e-4
+        self.deter_dim = 128
+        self.hidden = 128
+        self.stoch_groups = 8
+        self.stoch_classes = 8
+        self.horizon = 15
+        self.lam = 0.95
+        self.entropy_coef = 3e-3
+        self.replay_capacity = 100_000
+        self.seq_len = 16
+        self.batch_size = 8
+        self.imag_starts = 128
+        self.env_steps_per_iteration = 200
+        self.updates_per_iteration = 4

@@ -684,3 +684,37 @@ def test_ppo_with_connectors_trains():
     assert algo.env_runner_group.local.env_to_module is not None
     assert algo.env_runner_group.local.env_to_module.connectors[0].count > 0
     algo.stop()
+
+
+def test_dreamerv3_world_model_learns():
+    """DreamerV3 (compact): the RSSM world model's reconstruction loss
+    falls as real experience accumulates, imagination produces finite
+    returns, and the learner state checkpoints (reference
+    rllib/algorithms/dreamerv3 recipe on a vector env)."""
+    from ray_tpu.rllib import DreamerV3Config
+
+    algo = (DreamerV3Config().environment("CartPole-v1")
+            .training(env_steps_per_iteration=300,
+                      updates_per_iteration=3, batch_size=4, seq_len=12,
+                      horizon=10)
+            .build())
+    recs, rets = [], []
+    for _ in range(6):
+        m = algo.train()
+        if "wm_rec" in m:
+            recs.append(m["wm_rec"])
+            assert np.isfinite(m["wm_loss"])
+            assert np.isfinite(m["actor_loss"])
+            assert np.isfinite(m["critic_loss"])
+            assert np.isfinite(m["imag_return_mean"])
+        if "episode_return_mean" in m:
+            rets.append(m["episode_return_mean"])
+    assert len(recs) >= 3
+    assert recs[-1] < recs[0] * 0.8, \
+        f"world-model reconstruction did not improve: {recs}"
+    # checkpoint roundtrip across all three param groups
+    st = algo.learner.get_state()
+    algo.learner.set_state(st)
+    m2 = algo.train()
+    assert np.isfinite(m2.get("wm_loss", 0.0))
+    algo.stop()
