@@ -129,12 +129,19 @@ class DreamerV3Learner:
         one = one + probs - jax.lax.stop_gradient(probs)
         return one.reshape(logits.shape), lg
 
-    def _kl(self, lhs_logits, rhs_logits):
-        """KL(lhs || rhs) summed over groups; logits [..., G*C]."""
+    def _unimix_logp(self, logits):
         c = self.cfg
-        shape = lhs_logits.shape[:-1] + (c.stoch_groups, c.stoch_classes)
-        lp = jax.nn.log_softmax(lhs_logits.reshape(shape), -1)
-        rp = jax.nn.log_softmax(rhs_logits.reshape(shape), -1)
+        shape = logits.shape[:-1] + (c.stoch_groups, c.stoch_classes)
+        probs = (0.99 * jax.nn.softmax(logits.reshape(shape), -1)
+                 + 0.01 / c.stoch_classes)
+        return jnp.log(probs)
+
+    def _kl(self, lhs_logits, rhs_logits):
+        """KL(lhs || rhs) summed over groups, on the SAME 1%-unimix
+        distributions sampling uses — the floor must protect the KL too,
+        or a saturating prior makes it ill-conditioned."""
+        lp = self._unimix_logp(lhs_logits)
+        rp = self._unimix_logp(rhs_logits)
         return (jnp.exp(lp) * (lp - rp)).sum(-1).sum(-1)
 
     # ------------------------------------------------------ world model
@@ -144,6 +151,15 @@ class DreamerV3Learner:
         def wm_loss(wm, batch, rng):
             obs = symlog(batch["obs"])            # [B, L, obs]
             acts = jax.nn.one_hot(batch["actions"], self.n_actions)
+            # h_t must condition on the PREVIOUS action (what act() has
+            # at inference time), never the action chosen after obs_t
+            acts_prev = jnp.concatenate(
+                [jnp.zeros_like(acts[:, :1]), acts[:, :-1]], 1)
+            # episode starts inside the window: reset (h, z) so the RSSM
+            # never bridges a reset teleport (is_first handling)
+            firsts = jnp.concatenate(
+                [jnp.ones_like(batch["firsts"][:, :1]),
+                 batch["firsts"][:, 1:]], 1)
             B, L = obs.shape[:2]
             emb = _mlp(wm["enc"], obs)            # [B, L, H]
             h0 = jnp.zeros((B, c.deter_dim))
@@ -152,17 +168,21 @@ class DreamerV3Learner:
 
             def step(carry, xt):
                 h, z = carry
-                e_t, a_t, k_t = xt
+                e_t, a_t, f_t, k_t = xt
+                h = jnp.where(f_t[:, None], 0.0, h)
+                z = jnp.where(f_t[:, None], 0.0, z)
+                a_t = jnp.where(f_t[:, None], 0.0, a_t)
                 h = self._gru(wm, h, jnp.concatenate([z, a_t], -1))
                 prior_logits = _mlp(wm["prior"], h)
                 post_logits = _mlp(wm["post"],
                                    jnp.concatenate([h, e_t], -1))
-                z, post_lg = self._sample_categorical(post_logits, k_t)
+                z, _post_lg = self._sample_categorical(post_logits, k_t)
                 return (h, z), (h, z, prior_logits, post_logits)
 
             (_, _), (hs, zs, priors, posts) = jax.lax.scan(
                 step, (h0, z0),
-                (emb.swapaxes(0, 1), acts.swapaxes(0, 1), keys))
+                (emb.swapaxes(0, 1), acts_prev.swapaxes(0, 1),
+                 firsts.swapaxes(0, 1).astype(bool), keys))
             feat = jnp.concatenate([hs, zs], -1)          # [L, B, D+Z]
             obs_hat = _mlp(wm["dec"], feat)
             rew_hat = _mlp(wm["rew"], feat)[..., 0]
@@ -244,11 +264,12 @@ class DreamerV3Learner:
             feats, acts, rew, cont = imagine(wm, actor, start_feat, rng)
             feats = jax.lax.stop_gradient(feats)   # REINFORCE actor: no
             acts = jax.lax.stop_gradient(acts)     # grads through dynamics
-            values = symexp(_mlp(critic, feats)[..., 0])
+            raw_v = _mlp(critic, feats)[..., 0]
+            values = symexp(raw_v)
             rets = lambda_returns(rew, cont,
                                   jax.lax.stop_gradient(values))
-            # critic: symlog MSE toward lambda-returns
-            critic_loss = ((_mlp(critic, feats[:-1])[..., 0]
+            # critic: symlog MSE toward lambda-returns (one forward)
+            critic_loss = ((raw_v[:-1]
                             - jax.lax.stop_gradient(symlog(rets))) ** 2
                            ).mean()
             # actor: REINFORCE with critic baseline, percentile-scaled
@@ -344,6 +365,9 @@ class DreamerV3Learner:
         t = lambda p: jax.tree.map(np.asarray, p)  # noqa: E731
         return {"wm": t(self.wm), "actor": t(self.actor),
                 "critic": t(self.critic),
+                "wm_opt_state": t(self.wm_opt_state),
+                "actor_opt_state": t(self.actor_opt_state),
+                "critic_opt_state": t(self.critic_opt_state),
                 "ret_scale": float(self.ret_scale)}
 
     def set_state(self, state: dict) -> None:
@@ -351,6 +375,10 @@ class DreamerV3Learner:
         self.wm = t(state["wm"])
         self.actor = t(state["actor"])
         self.critic = t(state["critic"])
+        if "wm_opt_state" in state:   # Adam moments resume with params
+            self.wm_opt_state = t(state["wm_opt_state"])
+            self.actor_opt_state = t(state["actor_opt_state"])
+            self.critic_opt_state = t(state["critic_opt_state"])
         self.ret_scale = jnp.float32(state["ret_scale"])
 
 
@@ -363,25 +391,39 @@ class _SeqBuffer:
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.actions = np.zeros(capacity, np.int32)
         self.rewards = np.zeros(capacity, np.float32)
-        self.dones = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)     # TERMINATION only
+        self.firsts = np.zeros(capacity, np.float32)    # episode starts
         self.size = 0
         self._i = 0
         self._rng = np.random.default_rng(seed)
 
-    def add(self, obs, action, reward, done):
+    def add(self, obs, action, reward, done, first):
         i = self._i
         self.obs[i] = obs
         self.actions[i] = action
         self.rewards[i] = reward
         self.dones[i] = done
+        self.firsts[i] = first
         self._i = (i + 1) % self.capacity
         self.size = min(self.size + 1, self.capacity)
 
     def sample(self, batch: int, length: int) -> Dict[str, np.ndarray]:
-        starts = self._rng.integers(0, self.size - length, batch)
+        starts = np.empty(batch, np.int64)
+        for b in range(batch):
+            while True:
+                st = int(self._rng.integers(0, self.size - length))
+                # a full ring has a logical seam at the write head: a
+                # window crossing it would splice newest->oldest data
+                if self.size == self.capacity:
+                    seam = self._i
+                    if (st < seam <= st + length):
+                        continue
+                starts[b] = st
+                break
         idx = starts[:, None] + np.arange(length)[None]
         return {"obs": self.obs[idx], "actions": self.actions[idx],
-                "rewards": self.rewards[idx], "dones": self.dones[idx]}
+                "rewards": self.rewards[idx], "dones": self.dones[idx],
+                "firsts": self.firsts[idx]}
 
 
 class DreamerV3(Algorithm):
@@ -410,11 +452,14 @@ class DreamerV3(Algorithm):
         self._init_env_loop()
         ep_returns = []
         ep_ret = getattr(self, "_ep_ret", 0.0)
+        first = getattr(self, "_first", True)
         for _ in range(c.env_steps_per_iteration):
             a, self._policy_state = self.learner.act(
                 self._obs[None], self._policy_state, None)
             nxt, r, term, trunc, _ = self._env.step(int(a[0]))
-            self._buffer.add(self._obs, int(a[0]), r, float(term))
+            self._buffer.add(self._obs, int(a[0]), r, float(term),
+                             float(first))
+            first = False
             ep_ret += r
             self._obs = nxt
             if term or trunc:
@@ -422,6 +467,8 @@ class DreamerV3(Algorithm):
                 ep_ret = 0.0
                 self._obs, _ = self._env.reset()
                 self._policy_state = None
+                first = True
+        self._first = first
         self._ep_ret = ep_ret
         self._timesteps += c.env_steps_per_iteration
         metrics = {}
@@ -432,6 +479,27 @@ class DreamerV3(Algorithm):
         if ep_returns:
             metrics["episode_return_mean"] = float(np.mean(ep_returns))
         return metrics
+
+    def evaluate(self, num_episodes: int = None) -> dict:
+        """Posterior-filter policy evaluation (the generic env-runner
+        evaluate cannot drive a world-model policy)."""
+        n = num_episodes or self.config.evaluation_num_episodes
+        env = make_env(self.config.env, **self.config.env_kwargs)
+        rets = []
+        for ep in range(n):
+            obs, _ = env.reset(seed=self.config.seed + 7919 + ep)
+            state = None
+            total, done = 0.0, False
+            while not done:
+                a, state = self.learner.act(obs[None], state, None)
+                obs, r, term, trunc, _ = env.step(int(a[0]))
+                total += r
+                done = term or trunc
+            rets.append(total)
+        env.close()
+        return {"evaluation": {
+            "episode_return_mean": float(np.mean(rets)),
+            "num_episodes": n}}
 
     def stop(self):
         if getattr(self, "_env", None) is not None:
