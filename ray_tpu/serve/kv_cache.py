@@ -166,3 +166,58 @@ class PagedKVCache:
                 "prefix_hits": self.hits, "prefix_misses": self.misses,
                 "tokens_reused": self.tokens_reused,
                 "blocks_evicted": self.blocks_evicted}
+
+
+# ----------------------------------------------------- KV transfer (P/D)
+# Reference: serve.llm KV-transfer connectors (`llm/_internal/serve/...
+# nixl_connector.py`, lmcache) — ship computed prefix KV between
+# replicas so a PREFILL fleet feeds a DECODE fleet. Here blocks are jax
+# arrays, so the wire format is a plain numpy blob dict that can ride
+# the object store / an ObjectRef between actors.
+
+def export_prefix(kv: "PagedKVCache", ids) -> Optional[dict]:
+    """Serialize the pooled KV blocks covering `ids`' prefix into a
+    host-memory blob: {"ids", "k", "v"} with k/v [n_blocks, L, H, Bs, Dh].
+    Returns None when nothing is pooled for this prompt."""
+    import numpy as np
+
+    n, blocks = kv.match_prefix(list(ids))
+    if not blocks:
+        return None
+    k = np.stack([np.asarray(
+        kv.jax.lax.dynamic_index_in_dim(kv.pool_k, b, 1, keepdims=False))
+        for b in blocks])
+    v = np.stack([np.asarray(
+        kv.jax.lax.dynamic_index_in_dim(kv.pool_v, b, 1, keepdims=False))
+        for b in blocks])
+    return {"ids": list(ids[:n]), "k": k, "v": v,
+            "block_size": kv.block_size}
+
+
+def import_prefix(kv: "PagedKVCache", blob: dict) -> int:
+    """Install an exported prefix into THIS pool (dedup'd against what's
+    already cached). Returns the number of new blocks installed."""
+    if not blob:
+        return 0
+    if blob["block_size"] != kv.block_size:
+        raise ValueError(
+            f"block_size mismatch: {blob['block_size']} != {kv.block_size}")
+    jnp = kv.jnp
+    installed = 0
+    for i, (h, _blk) in enumerate(kv._chains(blob["ids"])):
+        if h in kv._table:
+            kv._table.move_to_end(h)
+            continue
+        blk = kv._alloc()
+        if blk is None:
+            break
+        kb = jnp.asarray(blob["k"][i])[:, None]   # [L,1,H,Bs,Dh]
+        vb = jnp.asarray(blob["v"][i])[:, None]
+        kv.pool_k = kv.jax.lax.dynamic_update_slice(
+            kv.pool_k, kb.astype(kv.pool_k.dtype), (0, blk, 0, 0, 0))
+        kv.pool_v = kv.jax.lax.dynamic_update_slice(
+            kv.pool_v, vb.astype(kv.pool_v.dtype), (0, blk, 0, 0, 0))
+        kv._table[h] = blk
+        kv._hash_of_block[blk] = h
+        installed += 1
+    return installed

@@ -274,6 +274,38 @@ class LLMEngine:
                 "done": done, "cursor": cursor + len(new),
                 "finish_reason": req.finish_reason if done else None}
 
+    # --------------------------------------------- KV transfer (prefill/decode)
+    def export_prefix(self, prompt: str = "",
+                      prompt_ids: Optional[List[int]] = None):
+        """Disaggregated serving, prefill side: run (or reuse) the
+        prompt's prefill, then hand back a host blob of its pooled KV
+        blocks for a DECODE engine to import (reference KV-transfer
+        connectors: nixl/lmcache behind serve.llm)."""
+        if self.kv is None:
+            raise RuntimeError("prefix caching disabled: no KV to export")
+        from ray_tpu.serve.kv_cache import export_prefix as _export
+
+        ids = prompt_ids if prompt_ids is not None else \
+            self.tokenizer.encode(prompt)
+        ids = ids[-(self.max_seq_len - 2):]
+        blob = _export(self.kv, ids[:-1])
+        if blob is None or len(blob["ids"]) < len(ids) - 1 - \
+                (len(ids) - 1) % self.kv.block_size:
+            # not pooled yet: run the prefill (generate 1 token) which
+            # publishes the prompt's blocks, then export
+            self.generate(prompt_ids=ids, max_tokens=1)
+            blob = _export(self.kv, ids[:-1])
+        return blob
+
+    def import_prefix(self, blob) -> int:
+        """Decode side: install a prefill replica's exported KV blocks;
+        subsequent matching prompts skip prefill for the covered span."""
+        if self.kv is None:
+            raise RuntimeError("prefix caching disabled: no KV to import")
+        from ray_tpu.serve.kv_cache import import_prefix as _import
+
+        return _import(self.kv, blob)
+
     def shutdown(self):
         self._stop.set()
 
