@@ -1,0 +1,76 @@
+"""Data-pipeline throughput benchmark (release perf suite, SURVEY §7.5).
+
+Emits benchmarks/DATA_BENCH.json: rows/s through a fused map chain, an
+actor-pool stage, and a distributed sort — the Data counterparts of the
+reference's release data benchmarks.
+
+Run: python benchmarks/data_benchmark.py [--out path]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(out_path: str | None = None) -> dict:
+    import ray_tpu
+    import ray_tpu.data as rd
+
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+    results = {}
+    N = 2_000_000
+
+    def timed(name, fn, rows):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        results[name] = round(rows / dt, 1)
+        print(f"[data-bench] {name}: {results[name]:,.0f} rows/s",
+              file=sys.stderr)
+
+    base = rd.range(N, parallelism=16)
+    timed("map_chain_rows_per_s", lambda: base
+          .map_batches(lambda b: {"id": b["id"], "x": b["id"] * 2})
+          .filter(lambda r: r["id"] % 2 == 0)
+          .count(), N)
+
+    class AddOne:
+        def __call__(self, b):
+            return {"id": b["id"] + 1}
+
+    timed("actor_pool_rows_per_s", lambda: base
+          .map_batches(AddOne, concurrency=4).count(), N)
+
+    M = 400_000
+    shuf = rd.from_numpy(
+        {"k": np.random.default_rng(0).integers(0, 1 << 30, M)},
+        parallelism=8)
+    timed("sort_rows_per_s", lambda: shuf.sort("k").count(), M)
+    timed("groupby_agg_rows_per_s", lambda: rd.from_numpy(
+        {"g": np.random.default_rng(1).integers(0, 100, M),
+         "v": np.random.default_rng(2).random(M)}, parallelism=8)
+        .groupby("g").mean("v").count(), M)
+
+    ray_tpu.shutdown()
+    report = {"metrics": results, "unit": "rows/s",
+              "host": {"cpus": os.cpu_count()}, "rows": {"map": N,
+                                                         "sort": M}}
+    print(json.dumps(report, indent=2))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    main(p.parse_args().out)

@@ -476,8 +476,6 @@ def apply_lora(params: Params, adapter: dict) -> Params:
     A/B ([L, D, r], [L, r, K]) via batched matmul. Serving keeps the
     BASE params shared; each adapter costs only its merged copies of the
     targeted leaves (reference: multi-LoRA serving behind serve.llm)."""
-    import copy as _copy
-
     out = jax.tree.map(lambda x: x, params)  # shallow structural copy
     for path, spec in adapter.items():
         keys = path.split(".")
