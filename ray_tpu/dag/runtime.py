@@ -44,8 +44,30 @@ def _ref_key(ref) -> tuple:
 
 
 def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
+    from concurrent.futures import ThreadPoolExecutor
+
     readers: Dict[tuple, Any] = {}
     writers: Dict[str, Channel] = {}
+    # overlap scheduling (reference dag_node_operation.py
+    # overlap_gpu_communication): reads of channels this actor does NOT
+    # produce earlier in the same iteration are issued CONCURRENTLY up
+    # front, so a remote edge's RPC latency overlaps other edges' reads
+    # and the first steps' compute. Self-produced channels must be read
+    # in program order (write-then-read same iteration).
+    own_outs = {st["out_chan"] for st in schedule if st["out_chan"]}
+    prefetchable = set()
+    for st in schedule:
+        for ref in list(st["args"]) + list(st["kwargs"].values()):
+            if ref[0] in ("chan", "rchan"):
+                key = _ref_key(ref)
+                name = ref[1] if ref[0] == "chan" else ref[1][0]
+                if name not in own_outs:
+                    prefetchable.add((key, ref[0], name, 
+                                      ref[1] if ref[0] == "chan"
+                                      else tuple(ref[1][1])))
+    pool = (ThreadPoolExecutor(max_workers=min(8, max(1, len(prefetchable))),
+                               thread_name_prefix="dag-prefetch")
+            if len(prefetchable) > 1 else None)
 
     def reader(ref) -> Any:
         key = _ref_key(ref)
@@ -84,12 +106,21 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
         while True:
             # one channel may feed several steps in an iteration: read once
             read_cache: Dict[tuple, Any] = {}
+            futures = {}
+            if pool is not None:
+                for key, kind, name, addr in prefetchable:
+                    r = reader((kind, name if kind == "chan"
+                                else (name, addr)))
+                    futures[key] = pool.submit(r.read)
 
             def fetch(ref) -> Any:
                 key = _ref_key(ref)
                 if key not in read_cache:
-                    read_cache[key] = materialize_channel_value(
-                        reader(ref).read())
+                    if key in futures:
+                        value = futures.pop(key).result()
+                    else:
+                        value = reader(ref).read()
+                    read_cache[key] = materialize_channel_value(value)
                 return read_cache[key]
 
             for step in schedule:
@@ -118,3 +149,6 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
     except ChannelClosedError:
         dev_refs.clear()   # release held device outputs
         return iterations
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
