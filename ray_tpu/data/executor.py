@@ -23,6 +23,26 @@ from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 
+def _op_gauges(stage: "Stage", in_flight: int, queued: int) -> None:
+    """Live per-operator gauges into the cluster metrics registry (the
+    reference streaming executor's Gauge set, streaming_executor.py:105)
+    — visible at /metrics as ray_tpu_data_op_{in_flight,queued}."""
+    try:
+        from ray_tpu.util import metrics as _m
+
+        if not hasattr(stage, "_g_inflight"):
+            stage._g_inflight = _m.Gauge(
+                "data_op_in_flight", "Data operator in-flight block tasks",
+                tag_keys=("op",))
+            stage._g_queued = _m.Gauge(
+                "data_op_queued", "Data operator queued blocks",
+                tag_keys=("op",))
+        stage._g_inflight.set(in_flight, {"op": stage.name})
+        stage._g_queued.set(queued, {"op": stage.name})
+    except Exception:
+        pass   # metrics must never break execution
+
+
 class OpStats:
     """Per-operator execution counters (reference OpState metrics +
     `Dataset.stats()` per-op rows)."""
@@ -156,6 +176,7 @@ class StreamingExecutor:
                 out = stage.submit(ref)
                 stage.stats.on_submit(out)
                 fl[out] = idx
+            _op_gauges(stage, len(fl), len(q))
 
     def run(self) -> Iterator[Tuple[int, Any]]:
         """Yields (partition_idx, final block ref) as they complete —

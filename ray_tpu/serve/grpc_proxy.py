@@ -83,9 +83,12 @@ class _GrpcServer:
                     req = Request("GRPC", handler_call_details.method, {},
                                   metadata, request_bytes, body)
                     model_id = metadata.get("serve_multiplexed_model_id")
+                    from ray_tpu.serve.proxy import prompt_prefix_key
+
                     try:
-                        result = await router.submit("__call__", (req,), {},
-                                                     model_id=model_id)
+                        result = await router.submit(
+                            "__call__", (req,), {}, model_id=model_id,
+                            prefix_key=prompt_prefix_key(body))
                     except Exception as e:  # surface detail like HTTP's 500
                         await context.abort(grpc.StatusCode.INTERNAL, repr(e))
                     if isinstance(result, bytes):
