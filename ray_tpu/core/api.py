@@ -58,9 +58,15 @@ def is_initialized() -> bool:
 def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
          num_tpu_chips: Optional[int] = None, resources: Optional[dict] = None,
          object_store_bytes: Optional[int] = None, max_workers: Optional[int] = None,
-         namespace: str = "default") -> dict:
-    """Start (or join) a cluster and connect this process as the driver."""
-    global _client, _head_proc
+         namespace: str = "default",
+         runtime_env: Optional[dict] = None) -> dict:
+    """Start (or join) a cluster and connect this process as the driver.
+
+    `runtime_env`: driver-level default applied to every task/actor this
+    driver submits (reference `ray.init(runtime_env=...)`); per-task
+    runtime_env keys override the driver's key-by-key."""
+    global _client, _head_proc, _driver_runtime_env
+    _driver_runtime_env = dict(runtime_env or {}) or None
     with _lock:
         if _client is not None:
             return _client.node_info
@@ -172,11 +178,18 @@ def free(refs: Sequence[ObjectRef]) -> None:
     _global_client().free(list(refs))
 
 
+_driver_runtime_env: Optional[dict] = None
+
+
 # ------------------------------------------------------------------- tasks
 def _package_renv_cached(holder, client, opts: dict):
     """Package runtime_env once per (holder, client): re-zipping the tree on
     every .remote() call would re-walk and re-hash it per submission."""
     renv = opts.get("runtime_env")
+    if _driver_runtime_env:
+        # driver default under per-task overrides (reference init-level
+        # runtime_env merge: job config < task config, key-by-key)
+        renv = {**_driver_runtime_env, **(renv or {})}
     if not renv:
         return None
     key = id(client)

@@ -196,3 +196,43 @@ def test_pip_runtime_env_isolated_worker(tmp_path, monkeypatch):
         assert ray_tpu.get(a.magic.remote(), timeout=120) == "isolated-42"
     finally:
         ray_tpu.shutdown()
+
+
+def test_driver_level_runtime_env(tmp_path):
+    """ray_tpu.init(runtime_env=...) applies to EVERY task this driver
+    submits; per-task keys override key-by-key (reference
+    ray.init(runtime_env=...) job-level semantics)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpu_chips=0, max_workers=4,
+                 runtime_env={"env_vars": {"JOB_FLAVOR": "driverwide"}})
+    try:
+        @ray_tpu.remote
+        def read_env():
+            import os
+
+            return os.environ.get("JOB_FLAVOR")
+
+        assert ray_tpu.get(read_env.remote(), timeout=60) == "driverwide"
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"JOB_FLAVOR": "local"}})
+        def read_env2():
+            import os
+
+            return os.environ.get("JOB_FLAVOR")
+
+        assert ray_tpu.get(read_env2.remote(), timeout=60) == "local"
+
+        # actors inherit the driver default too
+        @ray_tpu.remote
+        class E:
+            def get(self):
+                import os
+
+                return os.environ.get("JOB_FLAVOR")
+
+        e = E.remote()
+        assert ray_tpu.get(e.get.remote(), timeout=60) == "driverwide"
+    finally:
+        ray_tpu.shutdown()
