@@ -23,22 +23,27 @@ from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 
+_GAUGES: Dict[str, Any] = {}
+
+
 def _op_gauges(stage: "Stage", in_flight: int, queued: int) -> None:
     """Live per-operator gauges into the cluster metrics registry (the
     reference streaming executor's Gauge set, streaming_executor.py:105)
-    — visible at /metrics as ray_tpu_data_op_{in_flight,queued}."""
+    — visible at /metrics as ray_tpu_data_op_{in_flight,queued}{op}.
+    ONE shared gauge per name (stages are tag values): per-stage Gauge
+    objects would overwrite each other in the registry."""
     try:
         from ray_tpu.util import metrics as _m
 
-        if not hasattr(stage, "_g_inflight"):
-            stage._g_inflight = _m.Gauge(
+        if not _GAUGES:
+            _GAUGES["in_flight"] = _m.Gauge(
                 "data_op_in_flight", "Data operator in-flight block tasks",
                 tag_keys=("op",))
-            stage._g_queued = _m.Gauge(
+            _GAUGES["queued"] = _m.Gauge(
                 "data_op_queued", "Data operator queued blocks",
                 tag_keys=("op",))
-        stage._g_inflight.set(in_flight, {"op": stage.name})
-        stage._g_queued.set(queued, {"op": stage.name})
+        _GAUGES["in_flight"].set(in_flight, {"op": stage.name})
+        _GAUGES["queued"].set(queued, {"op": stage.name})
     except Exception:
         pass   # metrics must never break execution
 
