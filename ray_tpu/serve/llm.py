@@ -513,10 +513,22 @@ class OpenAIServer(LLMServer):
 
     def stream_next(self, stream_id: str, cursor: int = 0) -> dict:
         eng = self._stream_owner.get(stream_id, self.engine)
-        out = eng.stream_next(stream_id, cursor=cursor)
+        try:
+            out = eng.stream_next(stream_id, cursor=cursor)
+        except KeyError:
+            self._stream_owner.pop(stream_id, None)   # expired engine-side
+            raise
         if out.get("done"):
             self._stream_owner.pop(stream_id, None)
         return out
+
+    def _note_stream(self, sid: str, eng) -> None:
+        # abandoned SSE clients leave entries behind; bound the map (the
+        # engines sweep their own stream state independently)
+        if len(self._stream_owner) > 1024:
+            for k in list(self._stream_owner)[:512]:
+                self._stream_owner.pop(k, None)
+        self._stream_owner[sid] = eng
 
     def __call__(self, request: Any) -> dict:
         path = getattr(request, "path", "/v1/completions")
@@ -540,7 +552,7 @@ class OpenAIServer(LLMServer):
                 sid = eng.start_stream(
                     prompt=prompt, max_tokens=max_tokens,
                     temperature=temperature, top_k=top_k, top_p=top_p)
-                self._stream_owner[sid] = eng
+                self._note_stream(sid, eng)
                 return {"__sse_stream__": {"stream_id": sid,
                                            "model": self.model_id,
                                            "mode": "chat"}}
@@ -570,7 +582,7 @@ class OpenAIServer(LLMServer):
                 prompt=prompt, prompt_ids=body.get("prompt_ids"),
                 max_tokens=max_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p)
-            self._stream_owner[sid] = eng
+            self._note_stream(sid, eng)
             return {"__sse_stream__": {"stream_id": sid,
                                        "model": self.model_id,
                                        "mode": "completion"}}
