@@ -533,9 +533,12 @@ class OpenAIServer(LLMServer):
     def __call__(self, request: Any) -> dict:
         path = getattr(request, "path", "/v1/completions")
         if path.endswith("/models"):
-            return {"object": "list",
-                    "data": [{"id": self.model_id, "object": "model",
-                              "owned_by": "ray_tpu"}]}
+            data = [{"id": self.model_id, "object": "model",
+                     "owned_by": "ray_tpu"}]
+            data += [{"id": f"{self.model_id}:{a}", "object": "model",
+                      "owned_by": "ray_tpu", "parent": self.model_id}
+                     for a in self.loaded_lora_ids()]
+            return {"object": "list", "data": data}
         body = request if isinstance(request, dict) else \
             getattr(request, "json", None) or {}
         max_tokens = int(body.get("max_tokens", 16))

@@ -287,6 +287,15 @@ def test_lora_multiplexing():
         # cached adapter engine reused: same output deterministically
         assert srv({**body, "model": "tiny:beta"})["choices"][0]["text"] \
             == srv({**body, "model": "tiny:beta"})["choices"][0]["text"]
+        # /v1/models lists the base model + loaded adapters
+
+        class _Req:
+            path = "/v1/models"
+            json = None
+
+        models = {m["id"] for m in srv(_Req())["data"]}
+        assert "tiny" in models
+        assert {"tiny:beta", "tiny:gamma"} <= models
     finally:
         srv.engine.shutdown()
         for e in srv._lora_engines.values():
