@@ -11,7 +11,7 @@ stats to a single in-memory batch (the serving-time path).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 

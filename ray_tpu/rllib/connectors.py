@@ -17,7 +17,7 @@ the callable builds a pipeline per runner (reference's
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+from typing import Any, List, Optional
 
 import numpy as np
 

@@ -27,7 +27,7 @@ import struct
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 

@@ -10,7 +10,7 @@ external dependency (same approach as the r4 PB2 GP-bandit).
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 

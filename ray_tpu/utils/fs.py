@@ -27,7 +27,7 @@ from __future__ import annotations
 import builtins
 import os
 import posixpath
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 __all__ = [
     "is_uri", "resolve", "open", "exists", "isdir", "isfile", "makedirs",
