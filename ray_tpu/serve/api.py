@@ -97,6 +97,23 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0) -> int:
                        timeout=120)
 
 
+def _resolve_composition(value, controller):
+    """Deployment composition (reference deployment graphs /
+    `serve.run(app)` with bound sub-deployments): a Deployment passed as
+    an init arg deploys FIRST and arrives at the replica as a
+    DeploymentHandle."""
+    if isinstance(value, Deployment):
+        run(value, _blocking=False)
+        return DeploymentHandle(value.name, controller)
+    if isinstance(value, (list, tuple)):
+        return type(value)(_resolve_composition(v, controller)
+                           for v in value)
+    if isinstance(value, dict):
+        return {k: _resolve_composition(v, controller)
+                for k, v in value.items()}
+    return value
+
+
 def run(target: Deployment, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None,
         _blocking: bool = True,
@@ -111,6 +128,14 @@ def run(target: Deployment, *, name: Optional[str] = None,
             target if name is None else dataclasses.replace(target,
                                                             name=name))
     controller = _get_or_create_controller()
+    if any(isinstance(v, Deployment) for v in
+           list(target.init_args) + list((target.init_kwargs or {})
+                                         .values())):
+        target = dataclasses.replace(
+            target,
+            init_args=_resolve_composition(target.init_args, controller),
+            init_kwargs=_resolve_composition(target.init_kwargs or {},
+                                             controller))
     dep_name = name or target.name
     ray_tpu.get(controller.deploy.remote(dep_name, target.to_config()),
                 timeout=60)

@@ -49,6 +49,13 @@ class DeploymentHandle:
         self._inflight: Dict[str, int] = {}
         self._lock = threading.Lock()
 
+    def __reduce__(self):
+        # handles travel into replicas as init args (deployment
+        # composition); reconstruct against the receiving process's
+        # controller — locks/tables are process-local state
+        return (_rebuild_handle, (self.deployment_name, self._method_name,
+                                  self._model_id))
+
     # --------------------------------------------------------------- remote
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
@@ -143,3 +150,14 @@ class _MethodCaller:
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._handle._submit(self._method, args, kwargs)
+
+
+def _rebuild_handle(deployment_name: str, method_name: str,
+                    model_id):
+    from ray_tpu import serve as _serve
+
+    h = _serve.get_deployment_handle(deployment_name)
+    if method_name != "__call__" or model_id:
+        h = h.options(method_name=method_name,
+                      multiplexed_model_id=model_id)
+    return h

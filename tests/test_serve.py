@@ -180,3 +180,32 @@ def test_local_testing_mode():
 
     with _pytest.raises(ValueError, match="negative"):
         hf.remote(-1).result()
+
+
+def test_deployment_composition(cluster):
+    """Deployment graphs (reference model composition): a bound
+    sub-deployment passed as an init arg deploys first and arrives at
+    the parent replica as a live DeploymentHandle."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Featurizer:
+        def __call__(self, x):
+            return x * 10
+
+    @serve.deployment
+    class Model:
+        def __init__(self, featurizer):
+            self.featurizer = featurizer
+
+        def __call__(self, x):
+            feat = self.featurizer.remote(x).result(timeout=30)
+            return feat + 1
+
+    handle = serve.run(Model.bind(Featurizer.bind()))
+    assert handle.remote(4).result(timeout=60) == 41
+    # both deployments exist in the controller's view
+    status = serve.status()
+    assert "Model" in status and "Featurizer" in status
+    serve.delete("Model")
+    serve.delete("Featurizer")
