@@ -1,0 +1,82 @@
+"""Control-plane performance gate.
+
+Compares a fresh run (or a provided JSON) of the control-plane
+microbenchmark rows against the checked-in artifact
+`benchmarks/control_plane_microbench.json` and FAILS (exit 1) if any row
+dropped more than the tolerance (default 10%) — the CI guard that keeps
+the two-level-scheduler hot paths from silently regressing.
+
+Usage:
+  python benchmarks/check_regression.py                # runs the bench
+  python benchmarks/check_regression.py --current run.json
+  python benchmarks/check_regression.py --tolerance 0.15
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+sys.path.insert(0, os.path.dirname(HERE))
+
+DEFAULT_BASELINE = os.path.join(HERE, "control_plane_microbench.json")
+
+
+def compare(baseline: dict, current: dict, tolerance: float) -> list[str]:
+    failures = []
+    for name, base_val in baseline.items():
+        cur_val = current.get(name)
+        if cur_val is None:
+            failures.append(f"{name}: missing from current run")
+            continue
+        floor = base_val * (1.0 - tolerance)
+        delta = cur_val / base_val - 1.0
+        status = "OK " if cur_val >= floor else "FAIL"
+        print(f"[{status}] {name}: {cur_val:,.1f}/s vs baseline "
+              f"{base_val:,.1f}/s ({delta:+.1%}, floor {floor:,.1f})")
+        if cur_val < floor:
+            failures.append(
+                f"{name}: {cur_val:,.1f}/s is {-delta:.1%} below baseline "
+                f"{base_val:,.1f}/s (tolerance {tolerance:.0%})")
+    return failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="committed artifact to compare against")
+    ap.add_argument("--current", default=None,
+                    help="JSON of a finished run; omit to run the "
+                         "benchmark now")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed fractional drop per row (default 0.10)")
+    ap.add_argument("--out", default=None,
+                    help="also write the fresh run's JSON here")
+    args = ap.parse_args()
+
+    with open(args.baseline) as f:
+        baseline = json.load(f)["metrics"]
+    if args.current:
+        with open(args.current) as f:
+            current = json.load(f)["metrics"]
+    else:
+        from microbenchmark import control_plane
+
+        current = control_plane(args.out)["metrics"]
+
+    failures = compare(baseline, current, args.tolerance)
+    if failures:
+        print("\nREGRESSION GATE FAILED:")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print("\nregression gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,7 @@ import sys
 import time
 
 import numpy as np
+from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -142,7 +143,7 @@ def bench_mesh(world: int, sizes: list, ops: list, iters: int) -> list:
             x = jax.device_put(
                 np.ones(n, dtype=np.float32),
                 NamedSharding(mesh, P("p")))
-            f = jax.jit(jax.shard_map(progs[op], mesh=mesh, in_specs=P("p"),
+            f = jax.jit(_compat_shard_map(progs[op], mesh=mesh, in_specs=P("p"),
                                       out_specs=P("p")))
             jax.block_until_ready(f(x))  # compile
             t0 = time.perf_counter()

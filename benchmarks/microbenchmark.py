@@ -45,6 +45,70 @@ def timeit(fn, warmup: int = 1, repeat: int = 3) -> float:
     return float(np.mean(rates))
 
 
+CONTROL_PLANE_REFERENCE = {  # m5.16xlarge numbers from BASELINE.md §6
+    "1_1_actor_calls_sync": 2012,
+    "1_1_actor_calls_async": 8664,
+    "placement_group_create/removal": 765,
+}
+
+
+def control_plane(out_path: str | None = None) -> dict:
+    """Just the single-stream control-plane rows (the reference-parity
+    gate): emitted as a small JSON artifact that `check_regression.py`
+    diffs against the checked-in copy on every run."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+    results = {}
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return b"ok"
+
+    a = Sink.remote()
+    ray_tpu.get(a.ping.remote())
+
+    def sync_calls(n=500):
+        for _ in range(n):
+            ray_tpu.get(a.ping.remote())
+        return n
+
+    phase("1_1_actor_calls_sync")
+    results["1_1_actor_calls_sync"] = timeit(sync_calls)
+
+    def async_calls(n=2000):
+        ray_tpu.get([a.ping.remote() for _ in range(n)])
+        return n
+
+    phase("1_1_actor_calls_async")
+    results["1_1_actor_calls_async"] = timeit(async_calls)
+
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    def pg_cycle(n=50):
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1}], strategy="PACK")
+            pg.ready(timeout=10)
+            remove_placement_group(pg)
+        return n
+
+    phase("placement_group_create/removal")
+    results["placement_group_create/removal"] = timeit(pg_cycle, warmup=1,
+                                                       repeat=3)
+    ray_tpu.shutdown()
+    report = {"metrics": {k: round(v, 2) for k, v in results.items()},
+              "unit": "ops/s",
+              "host": {"cpus": os.cpu_count()},
+              "reference": CONTROL_PLANE_REFERENCE}
+    print(json.dumps(report, indent=2))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    return report
+
+
 def main(out_path: str | None = None) -> dict:
     import ray_tpu
 
@@ -353,5 +417,11 @@ def main(out_path: str | None = None) -> dict:
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--out", default=None)
+    p.add_argument("--control-plane", action="store_true",
+                   help="run only the control-plane gate rows and emit "
+                        "the regression artifact")
     args = p.parse_args()
-    main(args.out)
+    if args.control_plane:
+        control_plane(args.out)
+    else:
+        main(args.out)

@@ -18,7 +18,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from ray_tpu.core import config as _config
 from ray_tpu.core import object_transfer, protocol, refcount, serialization
@@ -35,16 +35,20 @@ ARGS_INLINE_LIMIT = 512 * 1024  # args bigger than this go through the store
 
 
 class _Lease:
-    """A worker granted to this client for direct task pushes."""
+    """A worker granted to this client for direct task pushes. `via` is
+    the granting node daemon's scheduler address (two-level path) or None
+    when the head granted it — releases route back to the granter."""
 
-    __slots__ = ("worker_id", "addr", "inflight", "last_used", "dead")
+    __slots__ = ("worker_id", "addr", "inflight", "last_used", "dead", "via")
 
-    def __init__(self, worker_id: WorkerID, addr: Tuple[str, int]):
+    def __init__(self, worker_id: WorkerID, addr: Tuple[str, int],
+                 via: Optional[Tuple[str, int]] = None):
         self.worker_id = worker_id
         self.addr = addr
         self.inflight = 0
         self.last_used = time.monotonic()
         self.dead = False
+        self.via = via
 
 
 class CoreClient:
@@ -138,6 +142,15 @@ class CoreClient:
         self._lease_lock = threading.Lock()
         self._lease_idle_s = _config.get("lease_idle_s")
         self._lease_reaper_started = False
+        # two-level scheduling: head-pushed cluster resource view + cached
+        # connections to node-daemon schedulers; grants via a daemon never
+        # touch the head (stats observable for tests/diagnostics)
+        from ray_tpu.core.resource_view import ClusterView
+
+        self.cluster_view = ClusterView()
+        self._sched_conns: Dict[Tuple[str, int], protocol.Connection] = {}
+        self.lease_stats = {"daemon_grants": 0, "head_grants": 0,
+                            "spills": 0}
         self._pull_sem: Optional[asyncio.Semaphore] = None
         self._pulled: "OrderedDict[ObjectID, ObjectMeta]" = OrderedDict()
         self._pulled_lock = threading.Lock()  # loop inserts, user threads free
@@ -147,6 +160,13 @@ class CoreClient:
         # invoked synchronously inside the start coroutine, right after the
         # head acks registration and before any pushed task handler can run
         self.on_registered = None
+        # batched loop handoff: every call_soon_threadsafe pays a self-pipe
+        # write to wake the loop; a pipelined burst (2000 actor calls) paid
+        # it 2000 times. One queue + one scheduled drain per wakeup keeps
+        # submission order (single FIFO) while collapsing the syscalls.
+        self._loop_calls: deque = deque()
+        self._loop_calls_lock = threading.Lock()
+        self._loop_calls_scheduled = False
 
     # ----------------------------------------------------------- lifecycle
     def _run_loop(self):
@@ -232,6 +252,8 @@ class CoreClient:
         forever — closing the connection fails them into the resend path,
         which re-resolves the restarted actor's address (reference:
         ActorTaskSubmitter's GCS actor-state subscription)."""
+        if channel == "cluster_view":
+            self.cluster_view.adopt(msg)
         if channel == "actor_state" and msg.get("state") in ("RESTARTING",
                                                              "DEAD"):
             aid = ActorID(msg["actor_id"])
@@ -516,9 +538,12 @@ class CoreClient:
             venv_key=os.environ.get("RAY_TPU_VENV_KEY"))
         # actor failover needs to hear about restarts it can't observe via
         # its own sockets (hung-worker reaping) — fire-and-forget so
-        # registration latency doesn't grow
+        # registration latency doesn't grow. cluster_view feeds the local
+        # feasible-node cache for two-level lease routing.
         asyncio.ensure_future(self.conn.request("subscribe",
                                                 channel="actor_state"))
+        asyncio.ensure_future(self.conn.request("subscribe",
+                                                channel="cluster_view"))
         self.node_id = NodeID(self.node_info["node_id"])
         # negotiated flags: the head's values are authoritative for
         # cluster-shared semantics (config.py registry)
@@ -592,6 +617,9 @@ class CoreClient:
             self.node_id = NodeID(info["node_id"])
             conn.on_close = lambda c: self._handle_head_loss()
             _config.GLOBAL.adopt_head(info.get("config"))
+            # the restarted head has no subscriber table: re-subscribe
+            for ch in ("actor_state", "cluster_view"):
+                asyncio.ensure_future(conn.request("subscribe", channel=ch))
             # enablement is the head's setting; the restarted head may
             # differ and a non-reporting client would see early evictions
             self.ref_tracker.set_enabled(info.get("refcount", True))
@@ -676,6 +704,8 @@ class CoreClient:
                 await c.close()
             for c in self._data_conns.values():
                 await c.close()
+            for c in self._sched_conns.values():
+                await c.close()
             if self.direct_server:
                 await self.direct_server.stop()
 
@@ -691,9 +721,64 @@ class CoreClient:
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout=timeout)
 
+    def _loop_call_soon(self, fn, *args) -> None:
+        """Thread-safe loop handoff with coalesced wakeups: enqueued
+        callables run on the loop in enqueue order; only the first one
+        after an idle period pays the self-pipe wakeup."""
+        with self._loop_calls_lock:
+            self._loop_calls.append((fn, args))
+            if self._loop_calls_scheduled:
+                return
+            self._loop_calls_scheduled = True
+        self.loop.call_soon_threadsafe(self._drain_loop_calls)
+
+    def _drain_loop_calls(self) -> None:
+        while True:
+            with self._loop_calls_lock:
+                if not self._loop_calls:
+                    self._loop_calls_scheduled = False
+                    return
+                batch = list(self._loop_calls)
+                self._loop_calls.clear()
+            for fn, args in batch:
+                try:
+                    fn(*args)
+                except Exception as e:
+                    print(f"[ray_tpu] loop call {fn} failed: {e!r}",
+                          file=sys.stderr, flush=True)
+
     def head_request(self, method: str, **kwargs) -> Any:
+        """Blocking head RPC without per-call coroutine/Task overhead:
+        the request is written by a plain loop callback and the reply
+        future chains straight into a concurrent future (the same trick
+        as _fast_actor_send — Task creation was a measurable slice of
+        every control-plane round trip)."""
         self._wait_connected()
-        return self._call(self.conn.request(method, **kwargs))
+        cfut: _cf.Future = _cf.Future()
+        conn = self.conn  # bind now: a reconnect must not swap mid-flight
+
+        def _send():
+            try:
+                fut = conn.request_future(method, **kwargs)
+            except Exception as e:
+                if not cfut.cancelled():
+                    cfut.set_exception(e)
+                return
+
+            def _done(f):
+                if cfut.cancelled():
+                    return
+                if f.cancelled():
+                    cfut.cancel()
+                elif f.exception() is not None:
+                    cfut.set_exception(f.exception())
+                else:
+                    cfut.set_result(f.result())
+
+            fut.add_done_callback(_done)
+
+        self._loop_call_soon(_send)
+        return cfut.result()
 
     def direct_request(self, addr, method: str, **kwargs) -> Any:
         """Synchronous RPC to another process's direct server (connection
@@ -704,10 +789,8 @@ class CoreClient:
             addr_t = (addr[0], int(addr[1]))
             conn = self._direct.get(addr_t)
             if conn is None or conn.closed:
-                reader_writer = await asyncio.open_connection(*addr_t)
-                conn = protocol.Connection(*reader_writer,
-                                           name=f"direct-{addr_t[1]}")
-                conn.start()
+                conn = await protocol.connect(*addr_t,
+                                              name=f"direct-{addr_t[1]}")
                 self._direct[addr_t] = conn
             return await conn.request(method, **kwargs)
 
@@ -852,7 +935,7 @@ class CoreClient:
         every other message this client sends (incl. submit pushes), so
         registration-before-submit ordering is preserved without paying a
         blocking round trip."""
-        self.loop.call_soon_threadsafe(
+        self._loop_call_soon(
             functools.partial(self.conn.push, method, **kwargs))
 
     def _register_meta(self, meta: ObjectMeta) -> None:
@@ -1265,21 +1348,90 @@ class CoreClient:
     @staticmethod
     def _lease_shape(fn_key: bytes, options: dict) -> tuple:
         res = options.get("resources") or {"CPU": 1}
-        return (fn_key, tuple(sorted(res.items())))
+        sel = options.get("label_selector")
+        sel_key = (tuple(sorted(
+            (k, tuple(v) if isinstance(v, (list, tuple, set)) else str(v))
+            for k, v in sel.items())) if sel else None)
+        return (fn_key, tuple(sorted(res.items())), sel_key)
 
     @staticmethod
     def _lease_eligible(options: dict, num_returns) -> bool:
-        """Direct pushes cover the common shape; anything needing the
-        head's placement machinery takes the scheduled path."""
+        """Direct pushes cover the common shapes (label selectors
+        included — grants are selector-checked by the granting scheduler);
+        anything needing the head's placement machinery (PGs, streaming,
+        runtime envs) takes the scheduled path."""
         return (num_returns == 1
                 and options.get("num_returns") != "streaming"
                 and not options.get("placement_group")
-                and not options.get("label_selector")
                 and not options.get("runtime_env")
                 and options.get("scheduling_strategy", "hybrid") == "hybrid")
 
+    def _pick_lease_node(self, options: dict) -> Optional[dict]:
+        """Feasible-node selection against the head-pushed cluster view:
+        a node-daemon scheduler that can grant without the head."""
+        if not _config.get("node_local_sched") or not self.cluster_view.entries:
+            return None
+        return self.cluster_view.select_node(
+            options.get("resources") or {"CPU": 1},
+            options.get("label_selector"))
+
+    def _on_sched_conn_close(self, addr: Tuple[str, int]) -> None:
+        """The granting daemon's scheduler connection died: every lease it
+        granted is void THERE (the daemon reclaims on disconnect), so it
+        must die HERE too — otherwise the daemon re-grants the worker to
+        another client while we keep pushing to it (double lease)."""
+        with self._lease_lock:
+            for shape, lease in list(self._leases.items()):
+                if lease.via == addr:
+                    lease.dead = True
+                    del self._leases[shape]
+
+    async def _daemon_lease_grant(self, entry: dict,
+                                  options: dict) -> Optional[dict]:
+        """Ask the chosen node daemon for a lease; None = spill to head
+        (infeasible there, stale view, or the daemon is unreachable)."""
+        addr = tuple(entry["sched_addr"])
+        conn = None
+        try:
+            conn = self._sched_conns.get(addr)
+            if conn is None or conn.closed:
+                conn = await protocol.connect(addr[0], addr[1],
+                                              name=f"sched-{addr[1]}")
+                conn.on_close = lambda c, a=addr: self._on_sched_conn_close(a)
+                self._sched_conns[addr] = conn
+                if conn.closed:  # closed before on_close was attached
+                    self._on_sched_conn_close(addr)
+                    return None
+            rep = await asyncio.wait_for(
+                conn.request(
+                    "lease_grant",
+                    resources=options.get("resources") or {"CPU": 1},
+                    label_selector=options.get("label_selector"),
+                    venv_key=(options.get("runtime_env") or {}).get("pip_key")),
+                timeout=10.0)
+        except asyncio.TimeoutError:
+            # the daemon may still complete this grant after we give up —
+            # the only way to reconcile without request ids is to close
+            # the scheduler session: the daemon returns everything it
+            # granted on it, and _on_sched_conn_close voids our side
+            if conn is not None:
+                self._sched_conns.pop(addr, None)
+                asyncio.ensure_future(conn.close())
+            return None
+        except (protocol.RpcError, OSError):
+            return None
+        if not rep or rep.get("spill"):
+            self.lease_stats["spills"] += 1
+            return None
+        return rep
+
     def _maybe_acquire_lease(self, shape: tuple, options: dict) -> None:
-        """Fire-and-forget lease acquisition — never blocks a submit."""
+        """Fire-and-forget lease acquisition — never blocks a submit.
+
+        Warm path: the cached cluster view names a feasible node daemon
+        and the grant is node-local (zero head involvement). Spillback to
+        the head's acquire_lease on label miss, infeasibility, or a stale
+        view (the daemon refused)."""
         with self._lease_lock:
             if shape in self._leases or shape in self._lease_acquiring:
                 return
@@ -1287,11 +1439,21 @@ class CoreClient:
 
         async def _acquire():
             try:
-                rep = await self.conn.request("acquire_lease",
-                                              options=options)
+                rep, via = None, None
+                entry = self._pick_lease_node(options)
+                if entry is not None:
+                    rep = await self._daemon_lease_grant(entry, options)
+                    if rep is not None:
+                        via = tuple(entry["sched_addr"])
+                        self.lease_stats["daemon_grants"] += 1
+                if rep is None:
+                    rep = await self.conn.request("acquire_lease",
+                                                  options=options)
+                    if rep is not None:
+                        self.lease_stats["head_grants"] += 1
                 if rep is not None:
                     lease = _Lease(WorkerID(rep["worker_id"]),
-                                   tuple(rep["addr"]))
+                                   tuple(rep["addr"]), via=via)
                     with self._lease_lock:
                         self._leases[shape] = lease
                     self._start_lease_reaper()
@@ -1300,6 +1462,21 @@ class CoreClient:
                     self._lease_acquiring.discard(shape)
 
         asyncio.run_coroutine_threadsafe(_acquire(), self.loop)
+
+    def _release_lease_now(self, lease: "_Lease") -> None:
+        """Hand a lease back to whoever granted it (loop thread only)."""
+        try:
+            if lease.via is not None:
+                conn = self._sched_conns.get(lease.via)
+                if conn is not None and not conn.closed:
+                    conn.push("lease_return",
+                              worker_id=lease.worker_id.binary())
+                # sched conn gone: the daemon reclaimed on disconnect
+            else:
+                self.conn.push("release_lease",
+                               worker_id=lease.worker_id.binary())
+        except Exception:
+            pass
 
     def _start_lease_reaper(self) -> None:
         if self._lease_reaper_started:
@@ -1316,11 +1493,7 @@ class CoreClient:
                         dead.append((shape, lease))
                         del self._leases[shape]
             for shape, lease in dead:
-                try:
-                    self.conn.push("release_lease",
-                                   worker_id=lease.worker_id.binary())
-                except Exception:
-                    pass
+                self._release_lease_now(lease)
             self.loop.call_later(max(self._lease_idle_s / 2, 0.25), _reap)
 
         self.loop.call_soon_threadsafe(
@@ -1336,21 +1509,18 @@ class CoreClient:
         worker would let the head queue new tasks behind ours, and if one
         of ours blocks on an object THOSE tasks produce, that's deadlock."""
         wid = WorkerID(worker_id)
-        release_now = False
+        release_now = []
         with self._lease_lock:
             for shape, lease in list(self._leases.items()):
                 if lease.worker_id == wid:
                     del self._leases[shape]
                     if lease.inflight == 0:
-                        release_now = True
+                        release_now.append(lease)
                     else:
                         lease.dead = True  # drain in _lease_exec_async
                         self._draining.append(lease)
-        if release_now:
-            try:
-                self.conn.push("release_lease", worker_id=worker_id)
-            except Exception:
-                pass
+        for lease in release_now:
+            self._release_lease_now(lease)
 
     async def _lease_exec_async(self, lease: "_Lease", spec: dict):
         """Push one task to the leased worker; on a dead worker/lease the
@@ -1361,10 +1531,8 @@ class CoreClient:
             try:
                 conn = self._direct.get(lease.addr)
                 if conn is None or conn.closed:
-                    reader_writer = await asyncio.open_connection(*lease.addr)
-                    conn = protocol.Connection(*reader_writer,
-                                               name=f"lease-{lease.addr[1]}")
-                    conn.start()
+                    conn = await protocol.connect(
+                        *lease.addr, name=f"lease-{lease.addr[1]}")
                     self._direct[lease.addr] = conn
             except (ConnectionRefusedError, OSError):
                 # connect-phase failure: the task was provably never sent,
@@ -1415,11 +1583,7 @@ class CoreClient:
                     # revoked mid-burst: last in-flight push done
                     self._draining.remove(lease)
             if release:
-                try:
-                    self.conn.push("release_lease",
-                                   worker_id=lease.worker_id.binary())
-                except Exception:
-                    pass
+                self._release_lease_now(lease)
 
     def _try_lease_submit(self, fn_key, payload, deps, tokens, options,
                           task_id, return_id: ObjectID) -> bool:
@@ -1495,7 +1659,7 @@ class CoreClient:
                 self._inflight_specs.popitem(last=False)
         # bind the CURRENT conn: a reconnect between here and the loop
         # callback must not push into the dead connection object
-        self.loop.call_soon_threadsafe(
+        self._loop_call_soon(
             functools.partial(self.conn.push, "submit_task", spec=spec))
         return [ObjectRef(o) for o in return_ids]
 
@@ -1523,9 +1687,8 @@ class CoreClient:
             self._actor_addr_cache[actor_id] = addr
         conn = self._direct.get(addr)
         if conn is None or conn.closed:
-            reader_writer = await asyncio.open_connection(addr[0], addr[1])
-            conn = protocol.Connection(*reader_writer, name=f"actor-{addr[1]}")
-            conn.start()
+            conn = await protocol.connect(addr[0], addr[1],
+                                          name=f"actor-{addr[1]}")
             self._direct[addr] = conn
         return conn
 
@@ -1653,7 +1816,7 @@ class CoreClient:
         # flagged); the coroutine machinery is only needed for connect /
         # retry, which _fast_actor_send falls back to.
         cfut = _cf.Future()
-        self.loop.call_soon_threadsafe(
+        self._loop_call_soon(
             self._fast_actor_send, actor_id, method, payload, deps,
             return_id.binary(), group, cfut)
         with self._pending_lock:

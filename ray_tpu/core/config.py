@@ -93,6 +93,20 @@ FLAGS: List[Flag] = [
          "Default cluster address for init()/CLI."),
     Flag("lease_idle_s", "RAY_TPU_LEASE_IDLE_S", float, 1.0,
          "Idle time before a leased worker returns to the pool."),
+    # -------------------------------------------- two-level scheduling
+    Flag("view_broadcast_s", "RAY_TPU_VIEW_BROADCAST_S", float, 0.25,
+         "Head cadence for pushing the compacted cluster resource view "
+         "to node daemons and drivers (reference ray_syncer broadcast)."),
+    Flag("gossip_debounce_s", "RAY_TPU_GOSSIP_DEBOUNCE_S", float, 0.05,
+         "Node-daemon debounce for resource-view deltas pushed to the "
+         "head on local pool changes."),
+    Flag("pool_idle_s", "RAY_TPU_POOL_IDLE_S", float, 5.0,
+         "Idle time before a node daemon returns a pooled lease worker "
+         "(and its resource carve-out) to the head."),
+    Flag("node_local_sched", "RAY_TPU_NODE_LOCAL_SCHED", bool, True,
+         "Clients route lease requests to node-daemon schedulers via the "
+         "cached cluster view; off = every lease goes through the head.",
+         negotiated=True),
     Flag("reconnect_timeout_s", "RAY_TPU_RECONNECT_TIMEOUT_S", float, 30.0,
          "Window for clients to reconnect to a restarted head; 0 = die "
          "on disconnect."),

@@ -48,6 +48,14 @@ class NodeInfo:
         # (host, port) of the node's object data server; host None = "the
         # head's host" (clients substitute their known route to the head)
         self.data_addr = None
+        # (host, port) of the node daemon's scheduler server — clients
+        # route warm lease requests here directly (two-level scheduling);
+        # None for the head's own node and for daemons predating the view
+        self.sched_addr = None
+        # gossiped node-daemon state (resource_view_delta): the daemon's
+        # own version counter and its warm lease-pool idle count
+        self.view_version = 0
+        self.pool_idle = 0
         self.alive = True
         self.idle: List["WorkerInfo"] = []
         self.workers: Set[WorkerID] = set()
@@ -62,16 +70,9 @@ class NodeInfo:
                    for r, amt in resources.items())
 
     def matches_labels(self, selector: Optional[Dict[str, str]]) -> bool:
-        if not selector:
-            return True
-        for k, v in selector.items():
-            have = self.labels.get(k)
-            if isinstance(v, (list, tuple, set)):   # "in" semantics
-                if have not in v:
-                    return False
-            elif have != str(v):
-                return False
-        return True
+        from ray_tpu.core.resource_view import matches_labels
+
+        return matches_labels(self.labels, selector)
 
     def utilization(self) -> float:
         fracs = [1 - self.available.get(r, 0) / t
@@ -104,6 +105,10 @@ class WorkerInfo:
         # lease protocol: WorkerID of the client this worker is leased to
         # for direct task pushes (None = scheduled by the head)
         self.leased_to: Optional[WorkerID] = None
+        # two-level scheduling: True while this worker (and its resource
+        # carve-out) belongs to its node daemon's lease pool — the head
+        # never dispatches to it until the daemon releases it back
+        self.pooled = False
         self.log_tag: Optional[str] = None  # stem of its log files
 
 
@@ -328,8 +333,15 @@ class Head:
         # a short grace window that absorbs in-flight handoffs.
         self.refcount_enabled = _config.get("refcount")
         self.obj_holders: Dict[ObjectID, Set[WorkerID]] = {}
-        # bounded-wait lease requests served as workers free up
+        # bounded-wait lease requests served as workers free up; entries
+        # are dicts {resources, selector, venv_key, node_id, fut} so a
+        # grant can honor the waiter's label selector / venv / node pin
         self._lease_waiters: list = []
+        # versioned cluster resource view (ray_syncer role): broadcast
+        # debounced to node daemons + subscribed drivers
+        self._view_seq = 0
+        self._last_view_snap: Optional[dict] = None
+        self._view_wake: Optional[asyncio.Event] = None
         self.obj_pins: Dict[ObjectID, int] = {}
         self.worker_holds: Dict[WorkerID, Set[ObjectID]] = {}
         self.lineage_dep_pins: Dict[ObjectID, int] = {}
@@ -415,17 +427,82 @@ class Head:
                     "driver_sys_path": self.kv.get(("cluster", b"driver_sys_path"))}
 
         async def register_node(node_id, resources, labels, max_workers,
-                                data_port=None):
+                                data_port=None, sched_port=None):
             nid = NodeID(node_id)
             node = NodeInfo(nid, resources, labels, conn_state["conn"],
                             max_workers)
             if data_port:
                 node.data_addr = (_peer_host() or "127.0.0.1", data_port)
+            if sched_port:
+                node.sched_addr = (_peer_host() or "127.0.0.1", sched_port)
             self.nodes[nid] = node
             conn_state["node"] = node
             self._publish("node_state", {"node_id": nid.binary(), "state": "ALIVE"})
             self._kick()
+            self._view_changed()
             return {"session": self.session, "head_node_id": self.node_id.binary()}
+
+        async def resource_view_delta(version, idle_workers, labels=None):
+            """Node-daemon gossip: its lease-pool state changed. Stale
+            versions (a reconnect replaying an old delta) are ignored."""
+            node = conn_state.get("node")
+            if node is None or version <= node.view_version:
+                return False
+            node.view_version = version
+            node.pool_idle = idle_workers
+            if labels:
+                node.labels.update(labels)
+            self._view_changed()
+            return True
+
+        async def pool_acquire(resources, venv_key=None):
+            """A node daemon carves a lease worker out of its own node for
+            its local pool: the head debits the ledger ONCE here; all
+            subsequent grant/return cycles on that worker are daemon-local
+            (reference raylet worker-pool ownership)."""
+            node = conn_state.get("node")
+            if node is None or not node.could_ever_fit(resources):
+                return None
+            lw = None
+            if node.fits(resources):
+                lw = self._idle_worker_on(node, venv_key)
+            if lw is None:
+                self._request_worker(node, pip=None, pip_key=venv_key)
+                fut = asyncio.get_running_loop().create_future()
+                ent = {"resources": resources, "selector": None,
+                       "venv_key": venv_key, "node_id": node.node_id,
+                       "fut": fut}
+                self._lease_waiters.append(ent)
+                try:
+                    # generous: a cold pool needs a full worker spawn
+                    # (python boot + register), seconds on a small host
+                    lw = await asyncio.wait_for(fut, timeout=5.0)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    try:
+                        self._lease_waiters.remove(ent)
+                    except ValueError:
+                        pass
+                    return None
+                # granted pre-acquired by _grant_lease_waiters
+            else:
+                self._acquire(lw, resources)
+            lw.pooled = True
+            self._last_dispatch_ts = time.monotonic()
+            self._view_changed()
+            return {"worker_id": lw.worker_id.binary(),
+                    "addr": (lw.host or "127.0.0.1", lw.port)}
+
+        async def pool_release(worker_id):
+            """Daemon returns a pooled worker (idle too long, or pool
+            teardown): resources flow back to the node ledger and the
+            worker rejoins the head's dispatchable idle set."""
+            lw = self.workers.get(WorkerID(worker_id))
+            if lw is not None and lw.pooled:
+                lw.pooled = False
+                lw.leased_to = None
+                self.notify_task_done(lw)
+                self._view_changed()
+            return True
 
         async def submit_task(spec):
             w = conn_state["worker"]
@@ -685,7 +762,12 @@ class Head:
             pg = PlacementGroupInfo(pgid, bundles, strategy, name)
             self.pgs[pgid] = pg
             self._try_reserve_pg(pg)
-            return True
+            # reservation is attempted synchronously: when it committed,
+            # the reply says so and the client's ready() needs no second
+            # round trip (the PG-cycle hot path is 1 RPC, not 3)
+            return {"state": pg.state,
+                    "bundle_nodes": [b.node_id.binary() if b.node_id else None
+                                     for b in pg.bundles]}
 
         async def wait_pg(pg_id, timeout=None):
             pg = self.pgs.get(PlacementGroupID(pg_id))
@@ -726,6 +808,12 @@ class Head:
 
         async def subscribe(channel):
             self.subscribers.setdefault(channel, []).append(conn_state["conn"])
+            if channel == "cluster_view":
+                # late subscribers must not wait for the next view CHANGE
+                # to learn the current one
+                snap = self._last_view_snap or self._build_view_snapshot()
+                conn_state["conn"].push("pubsub", channel="cluster_view",
+                                        msg=snap)
             return True
 
         async def cluster_info():
@@ -928,16 +1016,20 @@ class Head:
                 if not feasible:
                     return None
                 node = min(feasible, key=lambda n: n.utilization())
-            lw = self._idle_worker_on(node)
+            venv_key = (options.get("runtime_env") or {}).get("pip_key")
+            lw = self._idle_worker_on(node, venv_key)
             if lw is None:
-                self._request_worker(node)  # warm the pool
+                self._request_worker(node, pip_key=venv_key)  # warm the pool
                 fut = asyncio.get_running_loop().create_future()
-                self._lease_waiters.append((resources, fut))
+                ent = {"resources": resources,
+                       "selector": options.get("label_selector"),
+                       "venv_key": venv_key, "node_id": None, "fut": fut}
+                self._lease_waiters.append(ent)
                 try:
                     lw = await asyncio.wait_for(fut, timeout=1.0)
                 except (asyncio.TimeoutError, asyncio.CancelledError):
                     try:
-                        self._lease_waiters.remove((resources, fut))
+                        self._lease_waiters.remove(ent)
                     except ValueError:
                         pass
                     return None
@@ -1747,6 +1839,18 @@ class Head:
             if lw.leased_to == w.worker_id:
                 lw.leased_to = None
                 self.notify_task_done(lw)
+        if w.pooled:
+            # tell the owning daemon its pooled worker died so it drops
+            # the pool entry (the resource carve-out was released above
+            # via _release once the loop below runs)
+            node_ = self.nodes.get(w.node_id)
+            if node_ is not None and node_.conn is not None \
+                    and not node_.conn.closed:
+                try:
+                    node_.conn.push("pool_worker_died",
+                                    worker_id=w.worker_id.binary())
+                except Exception:
+                    pass
         self.workers.pop(w.worker_id, None)
         node = self.nodes.get(w.node_id)
         if node is not None:
@@ -1896,6 +2000,7 @@ class Head:
             if w is not None and not w.conn.closed:
                 asyncio.ensure_future(w.conn.close())
         self._kick()
+        self._view_changed()
 
     def _mark_actor_dead(self, info: ActorInfo, cause: str) -> None:
         info.state = "DEAD"
@@ -1945,6 +2050,56 @@ class Head:
             meta.error = True
             self._seal(meta)
         self._release_spec_borrows(rec.spec)
+
+    # ---------------------------------------------------- resource view
+    def _view_changed(self) -> None:
+        """Request an immediate (still coalesced) cluster-view broadcast."""
+        if self._view_wake is not None:
+            self._view_wake.set()
+
+    def _build_view_snapshot(self) -> dict:
+        from ray_tpu.core import resource_view as rv
+
+        nodes = []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            nodes.append(rv.make_entry(
+                n.node_id.hex(), version=n.view_version, free=n.available,
+                total=n.resources, labels=n.labels,
+                idle_workers=n.pool_idle, sched_addr=n.sched_addr,
+                is_head=n.is_head))
+        return {"version": self._view_seq, "nodes": nodes}
+
+    async def _view_broadcast_loop(self) -> None:
+        """Debounced push of the compacted cluster view to every node
+        daemon and every subscribed driver (the head half of the
+        ray_syncer role). Broadcasts only when the view actually changed;
+        `_view_changed` wakes it early (node join/death, gossip delta)."""
+        interval = _config.get("view_broadcast_s")
+        if interval <= 0:
+            return
+        self._view_wake = asyncio.Event()
+        while not self._shutdown:
+            try:
+                await asyncio.wait_for(self._view_wake.wait(), interval)
+            except asyncio.TimeoutError:
+                pass
+            self._view_wake.clear()
+            snap = self._build_view_snapshot()
+            if (self._last_view_snap is not None
+                    and snap["nodes"] == self._last_view_snap["nodes"]):
+                continue
+            self._view_seq += 1
+            snap["version"] = self._view_seq
+            self._last_view_snap = snap
+            for node in self.nodes.values():
+                if node.conn is not None and node.alive and not node.conn.closed:
+                    try:
+                        node.conn.push("cluster_view", snap=snap)
+                    except Exception:
+                        pass
+            self._publish("cluster_view", snap)
 
     def _publish(self, channel: str, msg: dict) -> None:
         conns = self.subscribers.get(channel)
@@ -2327,6 +2482,7 @@ class Head:
         self.head_node.data_addr = (None, self.data_port)
         asyncio.ensure_future(self._evict_loop())
         asyncio.ensure_future(self._health_loop())
+        asyncio.ensure_future(self._view_broadcast_loop())
         from ray_tpu.core.job_manager import JobManager
 
         self.job_manager = JobManager(self.session, self.port)
@@ -2397,7 +2553,7 @@ class Head:
         self._release(w)
         node = self.nodes.get(w.node_id)
         if (not w.is_driver and w.actor_id is None and not w.retiring
-                and w.leased_to is None
+                and w.leased_to is None and not w.pooled
                 and node is not None and w not in node.idle):
             node.idle.append(w)
             # waiting lease requests outrank the head-path queue: the
@@ -2407,20 +2563,33 @@ class Head:
         self._kick()
 
     def _grant_lease_waiters(self, node: "NodeInfo") -> None:
-        while self._lease_waiters and node.idle:
-            resources, fut = self._lease_waiters[0]
-            if fut.done():
-                self._lease_waiters.pop(0)   # timed out / cancelled
+        """Serve queued lease/pool waiters from a node that freed a worker.
+
+        Each waiter carries its full scheduling shape: a TPU-slice-affine
+        lease (label_selector) or a pip-isolated one (venv_key) must NOT
+        be granted a worker on a non-matching node — skip it and keep
+        scanning so an eligible later waiter still gets the worker."""
+        if not self._lease_waiters or not node.idle:
+            return
+        remaining = []
+        for ent in self._lease_waiters:
+            if ent["fut"].done():
+                continue  # timed out / cancelled
+            if (not node.idle
+                    or (ent.get("node_id") is not None
+                        and ent["node_id"] != node.node_id)
+                    or not node.matches_labels(ent.get("selector"))
+                    or any(node.available.get(r, 0) < v
+                           for r, v in ent["resources"].items())):
+                remaining.append(ent)
                 continue
-            if any(node.available.get(r, 0) < v
-                   for r, v in resources.items()):
-                return
-            lw = self._idle_worker_on(node)
+            lw = self._idle_worker_on(node, ent.get("venv_key"))
             if lw is None:
-                return
-            self._lease_waiters.pop(0)
-            self._acquire(lw, resources)
-            fut.set_result(lw)
+                remaining.append(ent)
+                continue
+            self._acquire(lw, ent["resources"])
+            ent["fut"].set_result(lw)
+        self._lease_waiters[:] = remaining
 
     def notify_actor_ready(self, info: ActorInfo, address) -> None:
         info.state = "ALIVE"

@@ -1,8 +1,13 @@
 """Node daemon (`python -m ray_tpu.core.node_main`): joins a cluster.
 
-The thin per-node agent — the remainder of the reference raylet's job after
-the head absorbed scheduling (SURVEY §2.1 N1/N3): advertise this node's
-resources+labels to the head, spawn/kill local worker processes on request.
+The per-node agent — the raylet's role split (SURVEY §2.1 N1/N3): advertise
+this node's resources+labels to the head, spawn/kill local worker processes
+on request, AND run the node-local half of the two-level scheduler: a
+scheduler server that grants/returns worker leases from a local pool, so a
+client in steady state never touches the head (reference
+`ClusterTaskManager::ScheduleAndDispatchTasks` + worker-pool ownership).
+Pool state is gossiped to the head as versioned resource-view deltas
+(`ray_syncer` role); the head pushes back the compacted cluster view.
 Workers connect straight to the head; object data rides the node-local shm
 store.
 
@@ -18,11 +23,13 @@ import os
 import signal
 import subprocess
 import sys
-from typing import Dict
+import time
+from typing import Dict, List
 
 from ray_tpu.core import config as _config
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import NodeID
+from ray_tpu.core.resource_view import ClusterView, matches_labels
 
 
 class NodeDaemon:
@@ -48,6 +55,14 @@ class NodeDaemon:
         self.store = None
         self.data_port: int = 0
         self._data_server: protocol.Server = None
+        # node-local scheduler: warm lease pool + gossip state
+        self.sched_port: int = 0
+        self._sched_server: protocol.Server = None
+        self.pool_idle: List[dict] = []     # {wid, addr, venv_key, shape, since}
+        self.pool_leases: Dict[bytes, dict] = {}  # wid -> pool entry
+        self.cluster_view = ClusterView()
+        self._gossip_version = 0
+        self._gossip_pending = False
         isolation = _config.get("store_isolation")
         self.store_ns = _config.get("store_namespace") or (
             self.node_id.hex()[:8] if isolation else "")
@@ -61,6 +76,10 @@ class NodeDaemon:
             name="node-data")
         self.data_port = await self._data_server.start(
             host=_config.get("bind_host"))
+        self._sched_server = protocol.Server(
+            {}, on_connect=self._on_sched_connect, name="node-sched")
+        self.sched_port = await self._sched_server.start(
+            host=_config.get("bind_host"))
         self.conn = await protocol.connect(
             self.head_host, self.head_port,
             handlers={
@@ -70,14 +89,18 @@ class NodeDaemon:
                 "free_object": self._free_object,
                 "adopt_object": self._adopt_object,
                 "health_ping": self._health_ping,
+                "cluster_view": self._on_cluster_view,
+                "pool_worker_died": self._on_pool_worker_died,
             },
             name="node")
         self.conn.on_close = lambda c: self.stopping.set()
         reply = await self.conn.request(
             "register_node", node_id=self.node_id.binary(),
             resources=self.resources, labels=self.labels,
-            max_workers=self.max_workers, data_port=self.data_port)
+            max_workers=self.max_workers, data_port=self.data_port,
+            sched_port=self.sched_port)
         self.session = reply["session"]
+        asyncio.ensure_future(self._pool_shrink_loop())
         from ray_tpu.core.store import (SharedMemoryStore,
                                         default_store_bytes as _default_store_bytes)
 
@@ -110,6 +133,138 @@ class NodeDaemon:
         self._log_monitor.start()
 
     async def _health_ping(self):
+        return True
+
+    # ------------------------------------------- node-local scheduling
+    def _on_sched_connect(self, conn: protocol.Connection) -> None:
+        """Per-client scheduler session. Leases are bound to the client's
+        live connection — its death returns every held worker to the pool
+        (the renew protocol is connection liveness, like the reference's
+        lease expiry on client disconnect)."""
+        held: set = set()
+
+        async def lease_grant(resources, label_selector=None, venv_key=None):
+            if not matches_labels(self.labels, label_selector):
+                return {"spill": "labels"}
+            shape = tuple(sorted(resources.items()))
+            ent = self._pool_take(shape, venv_key)
+            if ent is None:
+                # cold pool: carve a worker out of the head's ledger ONCE;
+                # every later grant/return cycle on it is daemon-local
+                if self.conn is None or self.conn.closed:
+                    return {"spill": "head"}
+                try:
+                    rep = await self.conn.request(
+                        "pool_acquire", resources=resources,
+                        venv_key=venv_key)
+                except protocol.RpcError:
+                    return {"spill": "head"}
+                if rep is None:
+                    return {"spill": "resources"}
+                ent = {"wid": rep["worker_id"], "addr": tuple(rep["addr"]),
+                       "venv_key": venv_key, "shape": shape,
+                       "since": time.monotonic()}
+                if conn.closed:
+                    # client died during the head round trip: its on_close
+                    # already drained `held`, so lease it to nobody — pool
+                    # the fresh worker instead of leaking it forever
+                    self.pool_idle.append(ent)
+                    self._gossip_soon()
+                    return None
+            self.pool_leases[ent["wid"]] = ent
+            held.add(ent["wid"])
+            self._gossip_soon()
+            return {"worker_id": ent["wid"], "addr": ent["addr"]}
+
+        async def lease_return(worker_id):
+            held.discard(worker_id)
+            self._pool_return(worker_id)
+            return True
+
+        async def health_ping():
+            return True
+
+        conn.handlers.update({"lease_grant": lease_grant,
+                              "lease_return": lease_return,
+                              "health_ping": health_ping})
+        orig_close = conn.on_close
+
+        def on_close(c):
+            if orig_close:
+                orig_close(c)
+            for wid in list(held):
+                self._pool_return(wid)
+
+        conn.on_close = on_close
+
+    def _pool_take(self, shape: tuple, venv_key):
+        for i in range(len(self.pool_idle) - 1, -1, -1):
+            ent = self.pool_idle[i]
+            if ent["shape"] == shape and ent["venv_key"] == venv_key:
+                del self.pool_idle[i]
+                return ent
+        return None
+
+    def _pool_return(self, worker_id: bytes) -> None:
+        ent = self.pool_leases.pop(worker_id, None)
+        if ent is None:
+            return  # already reaped (worker died) or double return
+        ent["since"] = time.monotonic()
+        self.pool_idle.append(ent)
+        self._gossip_soon()
+
+    async def _pool_shrink_loop(self) -> None:
+        """Return pooled workers (and their head-side carve-outs) after
+        they idle too long — the pool borrows capacity, it doesn't own
+        it forever."""
+        idle_s = _config.get("pool_idle_s")
+        while not self.stopping.is_set():
+            await asyncio.sleep(max(idle_s / 2, 0.5))
+            now = time.monotonic()
+            keep = [e for e in self.pool_idle
+                    if now - e["since"] <= idle_s]
+            drop = [e for e in self.pool_idle
+                    if now - e["since"] > idle_s]
+            if not drop:
+                continue
+            self.pool_idle = keep
+            for ent in drop:
+                if self.conn is not None and not self.conn.closed:
+                    try:
+                        self.conn.push("pool_release", worker_id=ent["wid"])
+                    except Exception:
+                        pass
+            self._gossip_soon()
+
+    def _gossip_soon(self) -> None:
+        """Debounced versioned delta to the head (ray_syncer node half)."""
+        if self._gossip_pending:
+            return
+        self._gossip_pending = True
+        asyncio.get_running_loop().call_later(
+            _config.get("gossip_debounce_s"), self._gossip_flush)
+
+    def _gossip_flush(self) -> None:
+        self._gossip_pending = False
+        if self.conn is None or self.conn.closed:
+            return
+        self._gossip_version += 1
+        try:
+            self.conn.push("resource_view_delta",
+                           version=self._gossip_version,
+                           idle_workers=len(self.pool_idle))
+        except Exception:
+            pass
+
+    async def _on_cluster_view(self, snap):
+        self.cluster_view.adopt(snap)
+        return True
+
+    async def _on_pool_worker_died(self, worker_id):
+        self.pool_leases.pop(worker_id, None)
+        self.pool_idle = [e for e in self.pool_idle
+                          if e["wid"] != worker_id]
+        self._gossip_soon()
         return True
 
     async def _spawn_worker(self, pip=None, pip_key=None):
@@ -192,6 +347,8 @@ class NodeDaemon:
                 proc.kill()
             except ProcessLookupError:
                 pass
+        if self._sched_server is not None:
+            await self._sched_server.stop()
         if self._data_server is not None:
             await self._data_server.stop()
         if self.store is not None:

@@ -22,10 +22,18 @@ class PlacementGroup:
         self.bundles = bundles
         self.strategy = strategy
         self.name = name
+        # creation-time state from the head's create_pg reply: when the
+        # reservation committed synchronously (the common case), the first
+        # ready() needs no second round trip. One-shot — a later ready()
+        # re-verifies with the head (bundles can unplace on node death).
+        self._created_state: Optional[str] = None
 
     def ready(self, timeout: Optional[float] = None) -> bool:
         from ray_tpu.core.api import _global_client
 
+        if self._created_state == "CREATED":
+            self._created_state = None
+            return True
         reply = _global_client().head_request("wait_pg", pg_id=self.id.binary(),
                                               timeout=timeout)
         return reply["state"] == "CREATED"
@@ -50,14 +58,20 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
         raise ValueError("bundles must be a non-empty list of non-empty dicts")
     _auto_init()
     pg_id = PlacementGroupID.generate()
-    _global_client().head_request(
+    reply = _global_client().head_request(
         "create_pg", pg_id=pg_id.binary(),
         bundles=[{k: float(v) for k, v in b.items()} for b in bundles],
         strategy=strategy, name=name)
-    return PlacementGroup(pg_id, bundles, strategy, name)
+    pg = PlacementGroup(pg_id, bundles, strategy, name)
+    if isinstance(reply, dict):
+        pg._created_state = reply.get("state")
+    return pg
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
+    """Fire-and-forget removal: the head needs no reply, and same-client
+    ordering (a subsequent create_pg reusing the freed resources) is
+    guaranteed by per-connection FIFO."""
     from ray_tpu.core.api import _global_client
 
-    _global_client().head_request("remove_pg", pg_id=pg.id.binary())
+    _global_client().head_push("remove_pg", pg_id=pg.id.binary())

@@ -21,6 +21,32 @@ HEADER = 12  # u64 pickle-payload length + u32 out-of-band buffer count
 # --- fault injection (env: RAY_TPU_TESTING_RPC_FAILURE="method:prob") -------
 _chaos: Dict[str, float] = {}
 
+# --- RPC interposition (tests): every outbound request/push is reported as
+# (connection_name, kind, method) with kind in {"req", "push"}. The warm-path
+# scheduling tests count head-bound traffic through this hook to PROVE a
+# dispatch never touched the head (same role as the reference's rpc_chaos
+# interposition layer, minus the fault).
+_interposers: list = []
+
+
+def add_rpc_interposer(fn) -> None:
+    _interposers.append(fn)
+
+
+def remove_rpc_interposer(fn) -> None:
+    try:
+        _interposers.remove(fn)
+    except ValueError:
+        pass
+
+
+def _interpose(name: str, kind: str, method: str) -> None:
+    for fn in _interposers:
+        try:
+            fn(name, kind, method)
+        except Exception:
+            pass
+
 
 def configure_chaos(spec: Optional[str] = None) -> None:
     _chaos.clear()
@@ -194,6 +220,8 @@ class Connection:
         if prob := _chaos.get(rpc):
             if random.random() < prob:
                 raise ConnectionLost(f"chaos: injected failure for {rpc}")
+        if _interposers:
+            _interpose(self.name, "req", rpc)
         if self.closed:
             raise ConnectionLost(f"connection {self.name} already closed")
         rid = next(self._seq)
@@ -207,6 +235,8 @@ class Connection:
 
     def push(self, rpc: str, **kwargs) -> None:
         if not self.closed:
+            if _interposers:
+                _interpose(self.name, "push", rpc)
             write_frame(self.writer, ("push", rpc, kwargs))
 
     async def close(self) -> None:

@@ -1,0 +1,120 @@
+"""Versioned cluster resource view — the `ray_syncer` equivalent.
+
+Reference: `src/ray/common/ray_syncer/ray_syncer.h` — each node owns a
+monotonically versioned snapshot of its local resource state and gossips
+deltas; consumers keep a compacted cluster view and ignore stale versions.
+
+Three parties share this module:
+
+- the **head** (`gcs.py`) builds the authoritative compacted view: its own
+  ledger supplies `free`/`total` per node, node-daemon deltas supply
+  `idle_workers` (the daemon's warm lease pool) and `sched_addr`.  The view
+  is broadcast (debounced) to node daemons and drivers.
+- **node daemons** (`node_main.py`) gossip `{version, idle_workers,
+  labels}` deltas to the head on change and cache the pushed cluster view.
+- **clients** (`client.py`) cache the pushed view and use
+  `select_node` for feasible-node lease routing: a lease request goes
+  straight to the chosen node's daemon scheduler, touching the head only
+  on infeasibility, version conflict (grant refused), or label miss.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def matches_labels(labels: Dict[str, str],
+                   selector: Optional[dict]) -> bool:
+    """Shared label-selector semantics (NodeInfo and view entries must
+    agree, or client-side routing and head-side granting diverge)."""
+    if not selector:
+        return True
+    for k, v in selector.items():
+        have = labels.get(k)
+        if isinstance(v, (list, tuple, set)):   # "in" semantics
+            if have not in v:
+                return False
+        elif have != str(v):
+            return False
+    return True
+
+
+def fits(free: Dict[str, float], resources: Dict[str, float]) -> bool:
+    return all(free.get(r, 0) >= amt - 1e-9 for r, amt in resources.items())
+
+
+def make_entry(node_id_hex: str, *, version: int, free: Dict[str, float],
+               total: Dict[str, float], labels: Dict[str, str],
+               idle_workers: int = 0, sched_addr=None,
+               is_head: bool = False) -> dict:
+    return {"node_id": node_id_hex, "version": version, "free": dict(free),
+            "total": dict(total), "labels": dict(labels),
+            "idle_workers": idle_workers, "sched_addr": sched_addr,
+            "is_head": is_head}
+
+
+class ClusterView:
+    """Compacted per-node view entries + a view-level version.
+
+    `update` ignores regressions of a node's own version (a reconnecting
+    daemon's stale delta must not rewind the view); every accepted change
+    bumps the view version so consumers can detect staleness cheaply."""
+
+    def __init__(self):
+        self.entries: Dict[str, dict] = {}   # node_id hex -> entry
+        self.version = 0
+
+    def update(self, entry: dict) -> bool:
+        cur = self.entries.get(entry["node_id"])
+        if cur is not None and entry["version"] < cur["version"]:
+            return False
+        if cur == entry:
+            return False
+        self.entries[entry["node_id"]] = entry
+        self.version += 1
+        return True
+
+    def remove(self, node_id_hex: str) -> bool:
+        if self.entries.pop(node_id_hex, None) is None:
+            return False
+        self.version += 1
+        return True
+
+    def snapshot(self) -> dict:
+        return {"version": self.version,
+                "nodes": list(self.entries.values())}
+
+    def adopt(self, snap: dict) -> None:
+        """Replace wholesale with a pushed snapshot. Pushes ride one FIFO
+        connection, so the latest received is the latest sent; the version
+        is kept for diagnostics and conflict reporting."""
+        self.entries = {e["node_id"]: e for e in snap.get("nodes", [])}
+        self.version = snap.get("version", self.version)
+
+    # ------------------------------------------------------------ routing
+    def select_node(self, resources: Dict[str, float],
+                    label_selector: Optional[dict] = None,
+                    require_sched: bool = True,
+                    exclude: Optional[str] = None) -> Optional[dict]:
+        """Feasible-node selection against the cached view: a node whose
+        labels match and that either has warm idle pool workers or free
+        capacity for the ask. Prefers the warmest pool (most idle
+        workers), breaking ties on free capacity — the reference's
+        best-node-by-load flavor without a second RPC."""
+        best, best_key = None, None
+        for e in self.entries.values():
+            if require_sched and not e.get("sched_addr"):
+                continue
+            if exclude is not None and e["node_id"] == exclude:
+                continue
+            if not matches_labels(e.get("labels") or {}, label_selector):
+                continue
+            warm = e.get("idle_workers", 0)
+            if not warm and not fits(e.get("free") or {}, resources):
+                continue
+            if not fits(e.get("total") or {}, resources):
+                continue
+            key = (warm, sum((e.get("free") or {}).values()))
+            if best_key is None or key > best_key:
+                best, best_key = e, key
+        return best

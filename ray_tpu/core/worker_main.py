@@ -39,6 +39,18 @@ from ray_tpu.core.serialization import SerializedObject
 
 DEFAULT_GROUP = "_default"
 
+_EMPTY_ARGS_BLOB: Optional[bytes] = None
+
+
+def _empty_args_blob() -> bytes:
+    """The constant serialized form of ((), {}) — zero-arg calls (the
+    actor hot path) ship exactly these bytes (client.py caches the same
+    constant), so matching them skips the per-call deserialize."""
+    global _EMPTY_ARGS_BLOB
+    if _EMPTY_ARGS_BLOB is None:
+        _EMPTY_ARGS_BLOB = serialization.serialize(((), {})).to_bytes()
+    return _EMPTY_ARGS_BLOB
+
 
 class WorkerRuntime:
     def __init__(self, head_host: str, head_port: int, session: str):
@@ -67,6 +79,7 @@ class WorkerRuntime:
         self._task_threads: Dict[bytes, int] = {}    # task_id -> thread ident
         self._fn_calls: Dict[bytes, int] = {}
         self._retiring = False
+        self._method_is_coro: Dict[str, bool] = {}   # per-call inspect is hot
 
     # ------------------------------------------------------------ plumbing
     def start(self):
@@ -114,6 +127,8 @@ class WorkerRuntime:
 
     def _resolve_args(self, payload) -> tuple:
         if "inline" in payload:
+            if payload["inline"] == _empty_args_blob():
+                return (), {}
             ser = SerializedObject.from_view(memoryview(payload["inline"]))
         else:
             meta = payload["meta"]
@@ -130,6 +145,8 @@ class WorkerRuntime:
         """Event-loop-safe variant (async actor methods run on the loop; the
         sync path would deadlock calling back into it)."""
         if "inline" in payload:
+            if payload["inline"] == _empty_args_blob():
+                return (), {}
             ser = SerializedObject.from_view(memoryview(payload["inline"]))
         else:
             meta = payload["meta"]
@@ -341,7 +358,11 @@ class WorkerRuntime:
         gname = group or self.actor_method_groups.get(method) or DEFAULT_GROUP
         fn = getattr(self.actor_instance, method, None)
 
-        if fn is not None and inspect.iscoroutinefunction(fn):
+        is_coro = self._method_is_coro.get(method)
+        if is_coro is None:
+            is_coro = self._method_is_coro[method] = (
+                fn is not None and inspect.iscoroutinefunction(fn))
+        if is_coro:
             # async actor method: runs on this event loop under the group's
             # semaphore (reference asyncio-actor / fiber semantics)
             sem = self.actor_semaphores.get(gname) or \

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.parallel.mesh import current_mesh, logical_to_spec
+from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 NEG_INF = -1e30
 
@@ -134,7 +135,7 @@ def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True,
 def _wrap_shard_map(local_fn, q, k, v, mesh, axis, causal, scale):
     spec = logical_to_spec("batch", "heads", "seq", None)
     fn = functools.partial(local_fn, axis_name=axis, causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return _compat_shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 

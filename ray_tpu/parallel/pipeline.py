@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.parallel.mesh import current_mesh
+from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 
 def pipeline_apply(
@@ -110,7 +111,7 @@ def pipeline_apply(
             jnp.where(stage == F - 1, outs, jnp.zeros_like(outs)), axis)
         return outs
 
-    out = jax.shard_map(
+    out = _compat_shard_map(
         spmd_fn,
         mesh=mesh,
         in_specs=(P(axis), P()),

@@ -69,6 +69,10 @@ class ExternalEnvServer:
         self.config = config or {}
         self._client: Optional[socket.socket] = None
         self._client_lock = threading.Lock()
+        # serializes every send on the client socket: set_weights (trainer
+        # thread) races _client_loop replies (server thread), and two
+        # interleaved sendall()s would corrupt the length-prefixed stream
+        self._send_lock = threading.Lock()
         self._episodes: deque = deque()
         self._steps_buffered = 0
         self._cv = threading.Condition()
@@ -112,15 +116,19 @@ class ExternalEnvServer:
                 return
             t = msg.get("type")
             if t == "hello":
-                send_msg(sock, {"type": "set_config",
-                                "config": self.config})
+                with self._send_lock:
+                    send_msg(sock, {"type": "set_config",
+                                    "config": self.config})
                 with self._cv:
-                    if self._weights is not None:
+                    weights, seq = self._weights, self._seq_no
+                if weights is not None:
+                    with self._send_lock:
                         send_msg(sock, {"type": "set_state",
-                                        "weights": self._weights,
-                                        "seq_no": self._seq_no})
+                                        "weights": weights,
+                                        "seq_no": seq})
             elif t == "ping":
-                send_msg(sock, {"type": "pong"})
+                with self._send_lock:
+                    send_msg(sock, {"type": "pong"})
             elif t == "episodes":
                 with self._cv:
                     for ep in msg["episodes"]:
@@ -146,8 +154,9 @@ class ExternalEnvServer:
             sock = self._client
         if sock is not None:
             try:
-                send_msg(sock, {"type": "set_state", "weights": host,
-                                "seq_no": seq})
+                with self._send_lock:
+                    send_msg(sock, {"type": "set_state", "weights": host,
+                                    "seq_no": seq})
             except OSError:
                 pass
 

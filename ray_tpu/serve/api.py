@@ -128,14 +128,15 @@ def run(target: Deployment, *, name: Optional[str] = None,
             target if name is None else dataclasses.replace(target,
                                                             name=name))
     controller = _get_or_create_controller()
-    if any(isinstance(v, Deployment) for v in
-           list(target.init_args) + list((target.init_kwargs or {})
-                                         .values())):
-        target = dataclasses.replace(
-            target,
-            init_args=_resolve_composition(target.init_args, controller),
-            init_kwargs=_resolve_composition(target.init_kwargs or {},
-                                             controller))
+    # unconditional: _resolve_composition recurses through lists/dicts, so
+    # a Deployment nested in e.g. init_args=([dep_a, dep_b],) deploys too
+    # (a top-level-only trigger would ship it as a raw dataclass); it's an
+    # identity transform when nothing matches
+    target = dataclasses.replace(
+        target,
+        init_args=_resolve_composition(target.init_args, controller),
+        init_kwargs=(_resolve_composition(target.init_kwargs, controller)
+                     if target.init_kwargs else target.init_kwargs))
     dep_name = name or target.name
     ray_tpu.get(controller.deploy.remote(dep_name, target.to_config()),
                 timeout=60)

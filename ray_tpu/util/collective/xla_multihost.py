@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ray_tpu.util.collective.types import ReduceOp
+from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 _COORD_NS = "collective_xmh"
 _MEMBER_NS = "collective_xmh_members"
@@ -262,7 +263,7 @@ class XlaMultihostGroup:
         import jax
         from jax.sharding import PartitionSpec as P
 
-        return jax.shard_map(fn, mesh=self.mesh, in_specs=P("p"),
+        return _compat_shard_map(fn, mesh=self.mesh, in_specs=P("p"),
                              out_specs=P("p"))(g)
 
     def _local_of(self, garr) -> np.ndarray:
@@ -412,7 +413,7 @@ class XlaMultihostGroup:
         # exactly the addressable shards of THIS process (one of the two)
         g = jax.make_array_from_single_device_arrays(
             (2,) + shape, sharding, [local])
-        out = jax.shard_map(
+        out = _compat_shard_map(
             lambda a: lax.ppermute(a, "pp", [(0, 1)]),
             mesh=mesh, in_specs=P("pp"), out_specs=P("pp"))(g)
         return out.addressable_shards[0].data  # [1, ...] on local device

@@ -1,0 +1,33 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+`shard_map` was promoted from `jax.experimental.shard_map` to the jax top
+level, and its kwargs were renamed along the way (`check_rep` →
+`check_vma`, `auto` → complement of `axis_names`). The runtime must run
+under both layouts (CI images pin older jax than TPU fleets), so every
+caller imports `shard_map` from here, never from jax directly.
+"""
+
+try:
+    from jax import shard_map as _native_shard_map
+
+    _LEGACY = False
+except ImportError:  # older jax: pre-promotion location + old kwarg names
+    from jax.experimental.shard_map import shard_map as _native_shard_map
+
+    _LEGACY = True
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+    if _LEGACY:
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        axis_names = kwargs.pop("axis_names", None)
+        if axis_names is not None:
+            # new API: axis_names = the manually-mapped axes; old API
+            # expresses the same thing as `auto` = its complement
+            kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _native_shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+
+
+__all__ = ["shard_map"]

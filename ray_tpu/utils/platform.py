@@ -29,6 +29,18 @@ def ensure_virtual_cpu(n_devices: int) -> None:
     jax.config.update("jax_platforms", "cpu")
     try:
         jax.config.update("jax_num_cpu_devices", max(n_devices, 1))
+    except AttributeError:
+        # older jax has no jax_num_cpu_devices option: the host-platform
+        # device count binds from XLA_FLAGS at backend init — backends are
+        # uninitialized (or were cleared above), so setting it now works
+        import os
+
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{max(n_devices, 1)}").strip()
     except RuntimeError:
         pass  # backend got initialized under us; XLA_FLAGS may still apply
     got = len(jax.devices())

@@ -44,6 +44,46 @@ def test_rpc_chaos_env_spec():
     assert protocol._chaos == {}
 
 
+@ray_tpu.remote
+def _plus1(x):
+    return x + 1
+
+
+def test_warm_lease_path_makes_zero_head_rpcs(cluster):
+    """Two-level scheduling contract: once a lease is warm, a task burst
+    is dispatched, executed, and resolved with ZERO head round trips —
+    proven by counting head-connection traffic through the RPC
+    interposition hook, not by inspecting internals. The only permitted
+    head-bound traffic is the refcount tracker's background batch flush
+    (a push, not a round trip)."""
+    client = ray_tpu.core.api._global_client()
+    assert ray_tpu.get(_plus1.remote(0), timeout=30) == 1
+    deadline = time.time() + 20
+    while time.time() < deadline and not client._leases:
+        ray_tpu.get(_plus1.remote(0), timeout=30)
+    assert client._leases, "lease never established"
+    time.sleep(0.3)  # let registration/refcount stragglers flush
+
+    events = []
+
+    def hook(conn_name, kind, method):
+        if conn_name == "head":
+            events.append((kind, method))
+
+    protocol.add_rpc_interposer(hook)
+    try:
+        refs = [_plus1.remote(i) for i in range(25)]
+        out = ray_tpu.get(refs, timeout=60)
+    finally:
+        protocol.remove_rpc_interposer(hook)
+    assert out == [i + 1 for i in range(25)]
+    reqs = [m for k, m in events if k == "req"]
+    assert not reqs, f"warm-path burst made head round trips: {reqs}"
+    pushes = {m for k, m in events if k == "push"}
+    assert pushes <= {"ref_update"}, \
+        f"warm-path burst pushed more than refcount batches: {pushes}"
+
+
 @ray_tpu.remote(max_retries=5)
 def _slow_square(x):
     time.sleep(0.2)

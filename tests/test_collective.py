@@ -13,6 +13,7 @@ import pytest
 import ray_tpu
 from ray_tpu.util.collective import ReduceOp, XlaCollectiveGroup
 from ray_tpu.util.collective.types import Backend
+from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 
 @pytest.fixture(scope="module")
@@ -209,7 +210,7 @@ def test_multihost_reducescatter_lowering_and_numerics(devices8):
     mesh = Mesh(np.array(devices8), ("p",))
     x = np.arange(world * world * 4, dtype=np.float32).reshape(world, world, 4)
     g = jax.device_put(x, NamedSharding(mesh, P("p")))
-    f = jax.jit(jax.shard_map(_rs_program(ReduceOp.SUM), mesh=mesh,
+    f = jax.jit(_compat_shard_map(_rs_program(ReduceOp.SUM), mesh=mesh,
                               in_specs=P("p"), out_specs=P("p")))
     out = np.asarray(f(g))
     np.testing.assert_allclose(out, np.stack(
@@ -218,7 +219,7 @@ def test_multihost_reducescatter_lowering_and_numerics(devices8):
     assert "reduce-scatter" in hlo, "SUM path must lower to reduce-scatter"
     assert "all-reduce" not in hlo, "SUM path must NOT be a full allreduce"
     # non-sum ops: no scatter primitive exists; numerics still must hold
-    fmax = jax.jit(jax.shard_map(_rs_program(ReduceOp.MAX), mesh=mesh,
+    fmax = jax.jit(_compat_shard_map(_rs_program(ReduceOp.MAX), mesh=mesh,
                                  in_specs=P("p"), out_specs=P("p")))
     np.testing.assert_allclose(np.asarray(fmax(g)), np.stack(
         [x.max(axis=0)[i] for i in range(world)]))
