@@ -96,6 +96,28 @@ def control_plane(out_path: str | None = None) -> dict:
     phase("placement_group_create/removal")
     results["placement_group_create/removal"] = timeit(pg_cycle, warmup=1,
                                                        repeat=3)
+
+    # warm lease-path task throughput WITH the control-plane flight
+    # recorder enabled (rpc_metrics defaults on): the gate row that keeps
+    # the interposer's counters/latency histograms under the 10% overhead
+    # budget on the exact path they instrument
+    @ray_tpu.remote
+    def echo(x):
+        return x
+
+    client = ray_tpu.core.api._global_client()
+    ray_tpu.get(echo.remote(0))
+    deadline = time.time() + 30
+    while time.time() < deadline and not client._leases:
+        ray_tpu.get(echo.remote(0))
+    assert client._leases, "warm lease never established"
+
+    def warm_burst(n=1500):
+        ray_tpu.get([echo.remote(i) for i in range(n)])
+        return n
+
+    phase("warm_path_tasks_instrumented")
+    results["warm_path_tasks_instrumented"] = timeit(warm_burst)
     ray_tpu.shutdown()
     report = {"metrics": {k: round(v, 2) for k, v in results.items()},
               "unit": "ops/s",

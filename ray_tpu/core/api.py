@@ -131,6 +131,11 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
 
 def shutdown() -> None:
     global _client, _head_proc
+    # stop the metrics pusher FIRST: its next tick would race the closing
+    # head connection (and pre-fix it spun forever after shutdown)
+    from ray_tpu.util import metrics as _metrics
+
+    _metrics.stop_pusher()
     with _lock:
         if _client is not None:
             _client.shutdown()

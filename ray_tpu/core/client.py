@@ -30,6 +30,7 @@ from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.store import INLINE_THRESHOLD, ObjectMeta, SharedMemoryStore
 from ray_tpu.core.serialization import SerializedObject
+from ray_tpu.util import tracing as _tracing
 
 ARGS_INLINE_LIMIT = 512 * 1024  # args bigger than this go through the store
 
@@ -39,7 +40,8 @@ class _Lease:
     the granting node daemon's scheduler address (two-level path) or None
     when the head granted it — releases route back to the granter."""
 
-    __slots__ = ("worker_id", "addr", "inflight", "last_used", "dead", "via")
+    __slots__ = ("worker_id", "addr", "inflight", "last_used", "dead", "via",
+                 "acquire_mode")
 
     def __init__(self, worker_id: WorkerID, addr: Tuple[str, int],
                  via: Optional[Tuple[str, int]] = None):
@@ -49,6 +51,7 @@ class _Lease:
         self.last_used = time.monotonic()
         self.dead = False
         self.via = via
+        self.acquire_mode = None  # flight recorder: local|spillback|head
 
 
 class CoreClient:
@@ -151,6 +154,12 @@ class CoreClient:
         self._sched_conns: Dict[Tuple[str, int], protocol.Connection] = {}
         self.lease_stats = {"daemon_grants": 0, "head_grants": 0,
                             "spills": 0}
+        # flight recorder, driver side: scheduling-phase events for traced
+        # tasks (submit → lease-acquire[mode] → dispatch → run) consumed by
+        # ray_tpu.timeline(); recorded only while tracing is enabled, so
+        # the untraced hot path pays one boolean check
+        self.sched_events: "deque[dict]" = deque(
+            maxlen=_config.get("flight_recorder_head_events"))
         self._pull_sem: Optional[asyncio.Semaphore] = None
         self._pulled: "OrderedDict[ObjectID, ObjectMeta]" = OrderedDict()
         self._pulled_lock = threading.Lock()  # loop inserts, user threads free
@@ -511,6 +520,9 @@ class CoreClient:
         # never records this process as a holder and evicts early)
         self.ref_tracker = refcount.RefTracker(self)
         refcount.activate(self.ref_tracker)
+        from ray_tpu.core import flight_recorder
+
+        flight_recorder.install("driver" if self.is_driver else "worker")
         self._loop_thread.start()
         fut = asyncio.run_coroutine_threadsafe(
             self._start_async(direct_handlers or {}), self.loop)
@@ -730,7 +742,15 @@ class CoreClient:
             if self._loop_calls_scheduled:
                 return
             self._loop_calls_scheduled = True
-        self.loop.call_soon_threadsafe(self._drain_loop_calls)
+        try:
+            self.loop.call_soon_threadsafe(self._drain_loop_calls)
+        except RuntimeError:
+            # loop stopped/closed mid-shutdown: reset the flag so later
+            # callers raise here too instead of parking behind a drain
+            # that will never run (head_request would block forever)
+            with self._loop_calls_lock:
+                self._loop_calls_scheduled = False
+            raise
 
     def _drain_loop_calls(self) -> None:
         while True:
@@ -1346,6 +1366,19 @@ class CoreClient:
 
     # ------------------------------------------------------------- leases
     @staticmethod
+    def _sched_tracing() -> bool:
+        return _tracing.is_enabled()
+
+    def _sched_event(self, phase: str, *, task_id=None, name=None, mode=None,
+                     t0=None, t1=None, **detail) -> None:
+        """Record one scheduling-phase event (flight recorder, driver
+        side). Only called behind a _sched_tracing() check."""
+        self.sched_events.append({
+            "phase": phase,
+            "task_id": task_id.hex() if hasattr(task_id, "hex") else task_id,
+            "name": name, "mode": mode, "t0": t0, "t1": t1, **detail})
+
+    @staticmethod
     def _lease_shape(fn_key: bytes, options: dict) -> tuple:
         res = options.get("resources") or {"CPU": 1}
         sel = options.get("label_selector")
@@ -1438,6 +1471,9 @@ class CoreClient:
             self._lease_acquiring.add(shape)
 
         async def _acquire():
+            traced = self._sched_tracing()
+            t0 = time.time() if traced else 0.0
+            mode = None
             try:
                 rep, via = None, None
                 entry = self._pick_lease_node(options)
@@ -1446,7 +1482,11 @@ class CoreClient:
                     if rep is not None:
                         via = tuple(entry["sched_addr"])
                         self.lease_stats["daemon_grants"] += 1
+                        mode = "local"
                 if rep is None:
+                    # spillback: a daemon refused (stale view/labels/full)
+                    # or no feasible view node existed — the head grants
+                    mode = "spillback" if entry is not None else "head"
                     rep = await self.conn.request("acquire_lease",
                                                   options=options)
                     if rep is not None:
@@ -1454,9 +1494,24 @@ class CoreClient:
                 if rep is not None:
                     lease = _Lease(WorkerID(rep["worker_id"]),
                                    tuple(rep["addr"]), via=via)
+                    if traced:
+                        lease.acquire_mode = mode
+                        with _tracing.start_span(
+                                "lease_acquire",
+                                attributes={"ray_tpu.op": "lease_acquire",
+                                            "mode": mode}) as sp:
+                            if sp is not None:
+                                sp.start_ts = t0
+                        self._sched_event(
+                            "lease-acquire", mode=mode, t0=t0,
+                            t1=time.time(),
+                            worker=lease.worker_id.hex()[:12])
                     with self._lease_lock:
                         self._leases[shape] = lease
                     self._start_lease_reaper()
+                elif traced:
+                    self._sched_event("lease-acquire", mode=mode or "none",
+                                      t0=t0, t1=time.time(), failed=True)
             finally:
                 with self._lease_lock:
                     self._lease_acquiring.discard(shape)
@@ -1542,7 +1597,36 @@ class CoreClient:
                 spec["failover"] = True  # head skips the dup holder add
                 self.conn.push("submit_task", spec=spec)
                 return {"meta": None}
-            rep = await conn.request("lease_exec", spec=spec)
+            if self._sched_tracing():
+                t_dispatch = time.time()
+                rep = await conn.request("lease_exec", spec=spec)
+                t_reply = time.time()
+                prof = rep.get("prof")
+                opts = spec.get("options", {})
+                tid = spec["task_id"]
+                if prof:
+                    # all phase timestamps stay in the DRIVER's clock: the
+                    # worker reports only its run DURATION, anchored here
+                    # to the reply arrival (cross-host wall clocks skew by
+                    # NTP offsets, which would render out-of-order phases)
+                    run_s = max(prof["end"] - prof["start"], 0.0)
+                    t_run = max(t_reply - run_s, t_dispatch)
+                    self._sched_event(
+                        "dispatch", task_id=tid,
+                        name=opts.get("name"), mode="lease",
+                        t0=t_dispatch, t1=t_run,
+                        worker=lease.worker_id.hex()[:12])
+                    self._sched_event(
+                        "run", task_id=tid, name=opts.get("name"),
+                        mode="lease", t0=t_run, t1=t_reply,
+                        worker=lease.worker_id.hex()[:12])
+                else:
+                    self._sched_event(
+                        "dispatch", task_id=tid, name=opts.get("name"),
+                        mode="lease", t0=t_dispatch, t1=t_reply,
+                        worker=lease.worker_id.hex()[:12])
+            else:
+                rep = await conn.request("lease_exec", spec=spec)
             if rep.get("retired"):
                 lease.dead = True
             return rep
@@ -1625,6 +1709,8 @@ class CoreClient:
 
     def submit_task(self, fn_key: bytes, args: tuple, kwargs: dict,
                     options: dict, num_returns: int = 1) -> List[ObjectRef]:
+        traced = self._sched_tracing()
+        t_submit = time.time() if traced else 0.0
         payload, deps, tokens = self.build_args_payload(args, kwargs)
         if "meta" in payload:
             # the args payload object is itself pinned as a dep: the head
@@ -1636,6 +1722,10 @@ class CoreClient:
         if (self._lease_eligible(options, num_returns)
                 and self._try_lease_submit(fn_key, payload, deps, tokens,
                                            options, task_id, return_ids[0])):
+            if traced:
+                self._sched_event("submit", task_id=task_id,
+                                  name=options.get("name"), mode="lease",
+                                  t0=t_submit, t1=time.time())
             return [ObjectRef(return_ids[0])]
         spec = {"task_id": task_id, "fn_key": fn_key, "args": payload,
                 "deps": deps, "return_ids": [o.binary() for o in return_ids],
@@ -1661,6 +1751,10 @@ class CoreClient:
         # callback must not push into the dead connection object
         self._loop_call_soon(
             functools.partial(self.conn.push, "submit_task", spec=spec))
+        if traced:
+            self._sched_event("submit", task_id=task_id,
+                              name=options.get("name"), mode="head",
+                              t0=t_submit, t1=time.time())
         return [ObjectRef(o) for o in return_ids]
 
     # -------------------------------------------------------------- actors

@@ -143,8 +143,21 @@ FLAGS: List[Flag] = [
     # ------------------------------------------------------ observability
     Flag("tracing", "RAY_TPU_TRACING", bool, False,
          "OpenTelemetry-style span export."),
+    Flag("tracing_buffer_spans", "RAY_TPU_TRACING_BUFFER_SPANS", int, 10_000,
+         "In-process finished-span buffer cap; overflow drops the oldest "
+         "half (reference span-processor queue bound)."),
     Flag("metrics_push_interval_s", "RAY_TPU_METRICS_PUSH_INTERVAL_S",
          float, 2.0, "Worker metrics push cadence."),
+    Flag("rpc_metrics", "RAY_TPU_RPC_METRICS", bool, True,
+         "Control-plane flight recorder: per-method RPC counters and "
+         "latency histograms recorded through the protocol interposer "
+         "in every process (head/daemon/driver/worker)."),
+    Flag("flight_recorder_events", "RAY_TPU_FLIGHT_RECORDER_EVENTS", int, 512,
+         "Per-node-daemon ring buffer of lease-lifecycle/gossip events "
+         "piggybacked on resource_view_delta gossip."),
+    Flag("flight_recorder_head_events", "RAY_TPU_FLIGHT_RECORDER_HEAD_EVENTS",
+         int, 5000, "Head-side merged lease-event buffer (state API "
+         "list_lease_events) and driver-side scheduling-phase buffer."),
     # --------------------------------------------------------------- TPU
     Flag("num_chips", "RAY_TPU_NUM_CHIPS", int, -1,
          "Override TPU chip autodetection (-1 = autodetect)."),

@@ -56,6 +56,12 @@ class NodeInfo:
         # own version counter and its warm lease-pool idle count
         self.view_version = 0
         self.pool_idle = 0
+        # flight recorder: when the last delta arrived (feeds the
+        # cluster_view_staleness_s gauge), the daemon's lifetime scheduler
+        # counters, and its reported gossip health (view_age_s etc.)
+        self.last_delta_ts = time.time()
+        self.sched_stats: Dict[str, float] = {}
+        self.gossip_health: Dict[str, float] = {}
         self.alive = True
         self.idle: List["WorkerInfo"] = []
         self.workers: Set[WorkerID] = set()
@@ -314,6 +320,14 @@ class Head:
         # → gcs_task_manager; feeds the state API + `timeline()`)
         from collections import OrderedDict, deque
         self.task_events: deque = deque(maxlen=20000)
+        # flight recorder: merged per-node lease-lifecycle/gossip events
+        # (piggybacked on resource_view_delta) + the head's own scheduler
+        # counters — feeds list_lease_events/list_scheduler_stats and the
+        # dashboard's /api/scheduler
+        self.lease_events: deque = deque(
+            maxlen=_config.get("flight_recorder_head_events"))
+        self.sched_totals = {"head_grants": 0, "pool_acquires": 0,
+                             "pool_releases": 0}
         # object lineage: return oid -> producing task spec, for
         # reconstruction of lost objects (reference: TaskManager lineage +
         # object_recovery_manager). Bounded FIFO.
@@ -442,17 +456,55 @@ class Head:
             self._view_changed()
             return {"session": self.session, "head_node_id": self.node_id.binary()}
 
-        async def resource_view_delta(version, idle_workers, labels=None):
+        async def resource_view_delta(version, idle_workers, labels=None,
+                                      events=None, stats=None, gossip=None,
+                                      metrics=None):
             """Node-daemon gossip: its lease-pool state changed. Stale
-            versions (a reconnect replaying an old delta) are ignored."""
+            versions (a reconnect replaying an old delta) are ignored —
+            but the piggybacked flight-recorder telemetry (events ride
+            exactly once, drained daemon-side) is merged regardless."""
             node = conn_state.get("node")
-            if node is None or version <= node.view_version:
+            if node is None:
+                return False
+            node.last_delta_ts = time.time()
+            if events:
+                nid = node.node_id.hex()
+                for ev in events:
+                    ev["node_id"] = nid
+                    self.lease_events.append(ev)
+            if stats:
+                node.sched_stats = stats
+            if gossip:
+                node.gossip_health = gossip
+            if metrics is not None:
+                # daemons have no CoreClient/pusher: their metrics registry
+                # snapshot rides the gossip into the same _metrics KV
+                # namespace the scrape endpoint aggregates (expired with
+                # the node on disconnect)
+                import json as _json
+
+                self.kv[("_metrics",
+                         f"proc:node-{node.node_id.hex()[:12]}".encode())] = \
+                    _json.dumps(metrics).encode()
+            if version <= node.view_version:
                 return False
             node.view_version = version
             node.pool_idle = idle_workers
             if labels:
                 node.labels.update(labels)
             self._view_changed()
+            return True
+
+        async def metrics_push(value):
+            """Per-process metrics snapshot (drivers/workers push on a
+            cadence — fire-and-forget so telemetry never adds control
+            round trips). Keyed by the pushing worker id; expired by
+            _on_worker_disconnect so dead processes stop being scraped."""
+            w = conn_state.get("worker")
+            if w is None:
+                return False
+            self.kv[("_metrics",
+                     f"proc:{w.worker_id.hex()}".encode())] = value
             return True
 
         async def pool_acquire(resources, venv_key=None):
@@ -487,6 +539,7 @@ class Head:
             else:
                 self._acquire(lw, resources)
             lw.pooled = True
+            self.sched_totals["pool_acquires"] += 1
             self._last_dispatch_ts = time.monotonic()
             self._view_changed()
             return {"worker_id": lw.worker_id.binary(),
@@ -500,6 +553,7 @@ class Head:
             if lw is not None and lw.pooled:
                 lw.pooled = False
                 lw.leased_to = None
+                self.sched_totals["pool_releases"] += 1
                 self.notify_task_done(lw)
                 self._view_changed()
             return True
@@ -1038,6 +1092,15 @@ class Head:
                 self._acquire(lw, resources)
             lw.leased_to = w.worker_id
             self._last_dispatch_ts = time.monotonic()
+            # head-granted lease = the client either had no feasible view
+            # node or a daemon refused (spillback): record it in the merged
+            # flight-recorder stream alongside daemon-local grants
+            self.sched_totals["head_grants"] += 1
+            self.lease_events.append(
+                {"ts": time.time(), "kind": "head_grant",
+                 "node_id": lw.node_id.hex(),
+                 "worker": lw.worker_id.hex()[:12],
+                 "client": w.worker_id.hex()[:12]})
             return {"worker_id": lw.worker_id.binary(),
                     "addr": (lw.host or "127.0.0.1", lw.port)}
 
@@ -1852,6 +1915,10 @@ class Head:
                 except Exception:
                     pass
         self.workers.pop(w.worker_id, None)
+        # a dead process's metrics snapshot must stop being scraped — the
+        # pre-fix behavior left proc:<id> keys in the _metrics namespace
+        # forever, so /metrics reported gauges of processes long gone
+        self.kv.pop(("_metrics", f"proc:{w.worker_id.hex()}".encode()), None)
         node = self.nodes.get(w.node_id)
         if node is not None:
             node.workers.discard(w.worker_id)
@@ -1948,6 +2015,10 @@ class Head:
         path (node table update + pubsub + per-worker failure handling)."""
         node.alive = False
         self.nodes.pop(node.node_id, None)
+        self.kv.pop(("_metrics",
+                     f"proc:node-{node.node_id.hex()[:12]}".encode()), None)
+        self.lease_events.append({"ts": time.time(), "kind": "node_dead",
+                                  "node_id": node.node_id.hex()})
         # objects whose data lived on that node are gone; drop their metas
         # and lazily reconstruct from lineage when next requested (waiters
         # already parked get kicked now)
@@ -2329,6 +2400,12 @@ class Head:
                         self.session, capacity_bytes=cap, create_arena=True,
                         namespace=new_id.hex()[:8])
         self.kv.update(snap["kv"])
+        # metrics snapshots are per-process and every pre-restart process's
+        # connection died with the old head: restoring them would scrape
+        # ghosts (the exact leak the disconnect expiry fixes); live
+        # processes re-push within one metrics interval of reconnecting
+        for k in [k for k in self.kv if k[0] == "_metrics"]:
+            del self.kv[k]
         self._restore_runtime_env_blobs()
         self.job_counter = snap.get("job_counter", 0)
         # PGs first: restored actors may be bound to a PG bundle — without
@@ -2434,6 +2511,10 @@ class Head:
                      "pending_deps": len(r.pending_deps)} for r in self.queue]
         if kind == "task_events":
             return list(self.task_events)
+        if kind == "lease_events":
+            return list(self.lease_events)
+        if kind == "scheduler_stats":
+            return self._scheduler_stats()
         if kind == "nodes":
             return [{"node_id": n.node_id.hex(), "resources": n.resources,
                      "available": n.available, "labels": n.labels,
@@ -2446,6 +2527,33 @@ class Head:
                                  for b in g.bundles]}
                     for p, g in self.pgs.items()]
         raise ValueError(f"unknown state kind {kind}")
+
+    def _scheduler_stats(self) -> List[dict]:
+        """Per-node two-level-scheduler telemetry rows (flight recorder):
+        the head's view-sync bookkeeping + each daemon's gossiped lifetime
+        counters and gossip health, plus one row for the head itself."""
+        now = time.time()
+        rows = []
+        for n in self.nodes.values():
+            if n.is_head:
+                continue
+            rows.append({
+                "node_id": n.node_id.hex(), "alive": n.alive,
+                "is_head": False, "idle_workers": n.pool_idle,
+                "view_version": n.view_version,
+                "staleness_s": round(now - n.last_delta_ts, 3),
+                "gossip": dict(n.gossip_health),
+                "local_grants": 0, "spillbacks": 0,  # until first delta
+                **{k: v for k, v in n.sched_stats.items()},
+            })
+        rows.append({
+            "node_id": self.node_id.hex(), "alive": True, "is_head": True,
+            "view_version": self._view_seq,
+            "staleness_s": 0.0, "gossip": {},
+            "lease_events_buffered": len(self.lease_events),
+            **{k: v for k, v in self.sched_totals.items()},
+        })
+        return rows
 
     # --------------------------------------------------------------- server
     async def start(self, port: int = 0) -> int:
@@ -2467,6 +2575,9 @@ class Head:
             conn.on_close = on_close
 
         # handlers installed per-connection (they close over conn_state)
+        from ray_tpu.core import flight_recorder
+
+        flight_recorder.install("head")
         bind = _config.get("bind_host")
         self._server = protocol.Server({}, on_connect=on_connect, name="head")
         self.port = await self._server.start(host=bind, port=port)

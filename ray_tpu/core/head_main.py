@@ -21,6 +21,16 @@ async def amain(args) -> None:
     from ray_tpu.core.protocol import enable_eager_tasks
 
     enable_eager_tasks(asyncio.get_running_loop())
+    # flight recorder from process birth: node registrations and the
+    # head's own outbound RPCs (spawn_worker, health probes) are counted
+    # from the first connection (idempotent with Head.start's install).
+    # The head's registry is scraped in-process by the dashboard — no
+    # pusher thread needed (there is no CoreClient to push through).
+    from ray_tpu.core import flight_recorder
+    from ray_tpu.util import metrics as _metrics
+
+    _metrics.disable_pusher()
+    flight_recorder.install("head")
     if args.restore:
         # a SIGKILLed predecessor leaves its shm arena behind; object data
         # died with its owner processes, so clear it before re-creating

@@ -63,14 +63,29 @@ class NodeDaemon:
         self.cluster_view = ClusterView()
         self._gossip_version = 0
         self._gossip_pending = False
+        # flight recorder: bounded ring of lease-lifecycle/gossip events +
+        # monotonic counters, both piggybacked on the resource_view_delta
+        # gossip this daemon already sends — telemetry costs zero extra
+        # round trips (core/flight_recorder.py)
+        from ray_tpu.core.flight_recorder import EventRing
+
+        self.fr_events = EventRing(_config.get("flight_recorder_events"))
+        self.sched_stats = {"local_grants": 0, "spillbacks": 0,
+                            "pool_acquires": 0, "lease_returns": 0,
+                            "pool_releases": 0, "pool_worker_deaths": 0}
+        self._fr_metrics_ts = 0.0   # last registry snapshot ride-along
+        self._last_gossip_ts = 0.0  # heartbeat bookkeeping (monotonic)
         isolation = _config.get("store_isolation")
         self.store_ns = _config.get("store_namespace") or (
             self.node_id.hex()[:8] if isolation else "")
         self._create_arena = isolation
 
     async def start(self):
-        from ray_tpu.core import object_transfer
+        from ray_tpu.core import flight_recorder, object_transfer
+        from ray_tpu.util import metrics as _metrics
 
+        _metrics.disable_pusher()  # daemon metrics ride gossip, not the KV
+        flight_recorder.install("daemon")
         self._data_server = protocol.Server(
             object_transfer.make_data_handlers(lambda: self.store),
             name="node-data")
@@ -101,6 +116,7 @@ class NodeDaemon:
             sched_port=self.sched_port)
         self.session = reply["session"]
         asyncio.ensure_future(self._pool_shrink_loop())
+        asyncio.ensure_future(self._fr_heartbeat_loop())
         from ray_tpu.core.store import (SharedMemoryStore,
                                         default_store_bytes as _default_store_bytes)
 
@@ -143,24 +159,32 @@ class NodeDaemon:
         lease expiry on client disconnect)."""
         held: set = set()
 
+        def _spill(reason: str) -> dict:
+            self._fr("spillback", reason=reason)
+            return {"spill": reason}
+
         async def lease_grant(resources, label_selector=None, venv_key=None):
             if not matches_labels(self.labels, label_selector):
-                return {"spill": "labels"}
+                return _spill("labels")
             shape = tuple(sorted(resources.items()))
+            t0 = time.monotonic()
             ent = self._pool_take(shape, venv_key)
+            warm = ent is not None
             if ent is None:
                 # cold pool: carve a worker out of the head's ledger ONCE;
                 # every later grant/return cycle on it is daemon-local
                 if self.conn is None or self.conn.closed:
-                    return {"spill": "head"}
+                    return _spill("head")
                 try:
                     rep = await self.conn.request(
                         "pool_acquire", resources=resources,
                         venv_key=venv_key)
                 except protocol.RpcError:
-                    return {"spill": "head"}
+                    return _spill("head")
                 if rep is None:
-                    return {"spill": "resources"}
+                    return _spill("resources")
+                self._fr("pool_acquire", shape=list(shape),
+                         wait_s=round(time.monotonic() - t0, 6))
                 ent = {"wid": rep["worker_id"], "addr": tuple(rep["addr"]),
                        "venv_key": venv_key, "shape": shape,
                        "since": time.monotonic()}
@@ -173,11 +197,14 @@ class NodeDaemon:
                     return None
             self.pool_leases[ent["wid"]] = ent
             held.add(ent["wid"])
+            self._fr("local_grant", shape=list(shape), warm=warm,
+                     worker=ent["wid"].hex()[:12])
             self._gossip_soon()
             return {"worker_id": ent["wid"], "addr": ent["addr"]}
 
         async def lease_return(worker_id):
             held.discard(worker_id)
+            self._fr("lease_return", worker=worker_id.hex()[:12])
             self._pool_return(worker_id)
             return True
 
@@ -196,6 +223,20 @@ class NodeDaemon:
                 self._pool_return(wid)
 
         conn.on_close = on_close
+
+    _FR_COUNTERS = {"local_grant": "local_grants", "spillback": "spillbacks",
+                    "pool_acquire": "pool_acquires",
+                    "lease_return": "lease_returns",
+                    "pool_release": "pool_releases",
+                    "pool_worker_died": "pool_worker_deaths"}
+
+    def _fr(self, kind: str, **detail) -> None:
+        """Record a flight-recorder event + bump its lifetime counter; the
+        ring drains into the next gossip delta (no RPC of its own)."""
+        self.fr_events.record(kind, **detail)
+        key = self._FR_COUNTERS.get(kind)
+        if key is not None:
+            self.sched_stats[key] += 1
 
     def _pool_take(self, shape: tuple, venv_key):
         for i in range(len(self.pool_idle) - 1, -1, -1):
@@ -229,6 +270,8 @@ class NodeDaemon:
                 continue
             self.pool_idle = keep
             for ent in drop:
+                self._fr("pool_release", worker=ent["wid"].hex()[:12],
+                         idle_s=round(now - ent["since"], 3))
                 if self.conn is not None and not self.conn.closed:
                     try:
                         self.conn.push("pool_release", worker_id=ent["wid"])
@@ -246,24 +289,72 @@ class NodeDaemon:
 
     def _gossip_flush(self) -> None:
         self._gossip_pending = False
+        self._gossip_send(bump=True)
+
+    def _gossip_send(self, bump: bool) -> None:
+        """Push a resource_view_delta. `bump=True` is a real state change
+        (new version, head re-evaluates the view); `bump=False` is the
+        telemetry heartbeat — it resends the CURRENT version so the head
+        merges the piggybacked flight-recorder payload and refreshes its
+        staleness clock without the view plane rebroadcasting anything."""
         if self.conn is None or self.conn.closed:
             return
-        self._gossip_version += 1
+        if bump:
+            self._gossip_version += 1
+        # flight recorder piggyback: drain the event ring, attach lifetime
+        # counters and gossip health to the delta the daemon is sending
+        # anyway; at most once per metrics interval the local metrics
+        # registry snapshot rides along too (daemons hold no CoreClient,
+        # so this gossip IS their metrics export path)
+        events = self.fr_events.drain(limit=256)
+        gossip = {"view_version": self.cluster_view.version,
+                  "view_age_s": round(self.cluster_view.staleness_s(), 3),
+                  "events_dropped": self.fr_events.dropped}
+        metrics_snap = None
+        now = time.monotonic()
+        from ray_tpu.util import metrics as _metrics
+
+        if now - self._fr_metrics_ts >= _config.get(
+                "metrics_push_interval_s"):
+            self._fr_metrics_ts = now
+            metrics_snap = _metrics.snapshot_all()
+        self._last_gossip_ts = now
         try:
             self.conn.push("resource_view_delta",
                            version=self._gossip_version,
-                           idle_workers=len(self.pool_idle))
+                           idle_workers=len(self.pool_idle),
+                           events=events, stats=dict(self.sched_stats),
+                           gossip=gossip, metrics=metrics_snap)
         except Exception:
-            pass
+            # the delta is re-gossiped on the next change/heartbeat, but
+            # drained ring events would be lost — put them back (overflow
+            # counts as dropped, surfaced via gossip.events_dropped)
+            self.fr_events.requeue(events)
+
+    async def _fr_heartbeat_loop(self) -> None:
+        """Telemetry liveness: a quiet daemon (no pool churn → no deltas)
+        must still deliver its ring/stats and keep the head's
+        cluster_view_staleness_s honest — heartbeats reuse the gossip
+        channel with an unchanged version (zero view-plane cost)."""
+        interval = max(float(_config.get("metrics_push_interval_s")), 0.25)
+        while not self.stopping.is_set():
+            await asyncio.sleep(interval / 2)
+            if time.monotonic() - self._last_gossip_ts >= interval:
+                self._gossip_send(bump=False)
 
     async def _on_cluster_view(self, snap):
+        prev_age = self.cluster_view.staleness_s()
         self.cluster_view.adopt(snap)
+        self._fr("view_adopt", version=snap.get("version"),
+                 nodes=len(snap.get("nodes", [])),
+                 age_s=round(prev_age, 3))
         return True
 
     async def _on_pool_worker_died(self, worker_id):
         self.pool_leases.pop(worker_id, None)
         self.pool_idle = [e for e in self.pool_idle
                           if e["wid"] != worker_id]
+        self._fr("pool_worker_died", worker=worker_id.hex()[:12])
         self._gossip_soon()
         return True
 

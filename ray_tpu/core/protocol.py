@@ -14,6 +14,7 @@ import itertools
 import os
 import pickle
 import random
+import time as _time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 HEADER = 12  # u64 pickle-payload length + u32 out-of-band buffer count
@@ -21,29 +22,58 @@ HEADER = 12  # u64 pickle-payload length + u32 out-of-band buffer count
 # --- fault injection (env: RAY_TPU_TESTING_RPC_FAILURE="method:prob") -------
 _chaos: Dict[str, float] = {}
 
-# --- RPC interposition (tests): every outbound request/push is reported as
+# --- RPC interposition: every outbound request/push is reported as
 # (connection_name, kind, method) with kind in {"req", "push"}. The warm-path
 # scheduling tests count head-bound traffic through this hook to PROVE a
 # dispatch never touched the head (same role as the reference's rpc_chaos
-# interposition layer, minus the fault).
-_interposers: list = []
+# interposition layer, minus the fault). Interposers that accept extra
+# keyword arguments additionally receive "rep" events when a request's
+# reply lands, carrying duration_s — the flight recorder's per-RPC
+# latency feed (core/flight_recorder.py) rides this without changing the
+# 3-arg hooks tests already use.
+_interposers: list = []   # (fn, wants_extra)
+_n_extra = 0              # count of extra-accepting interposers
+
+
+def _wants_extra(fn) -> bool:
+    import inspect
+
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    return (len(params) > 3
+            or any(p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+                   for p in params))
 
 
 def add_rpc_interposer(fn) -> None:
-    _interposers.append(fn)
+    global _n_extra
+    wants = _wants_extra(fn)
+    _interposers.append((fn, wants))
+    if wants:
+        _n_extra += 1
 
 
 def remove_rpc_interposer(fn) -> None:
-    try:
-        _interposers.remove(fn)
-    except ValueError:
-        pass
+    global _n_extra
+    for ent in list(_interposers):
+        if ent[0] is fn:
+            _interposers.remove(ent)
+            if ent[1]:
+                _n_extra -= 1
+            return
 
 
-def _interpose(name: str, kind: str, method: str) -> None:
-    for fn in _interposers:
+def _interpose(name: str, kind: str, method: str, **extra) -> None:
+    for fn, wants in _interposers:
         try:
-            fn(name, kind, method)
+            if wants:
+                fn(name, kind, method, **extra)
+            elif kind != "rep":
+                # 3-arg hooks keep the original req/push-only contract —
+                # reply events exist only for extra-kwarg interposers
+                fn(name, kind, method)
         except Exception:
             pass
 
@@ -228,6 +258,16 @@ class Connection:
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         write_frame(self.writer, ("req", rid, rpc, kwargs))
+        if _n_extra:
+            t0 = _time.perf_counter()
+
+            def _report(f, _rpc=rpc, _t0=t0):
+                _interpose(self.name, "rep", _rpc,
+                           duration_s=_time.perf_counter() - _t0,
+                           ok=(not f.cancelled()
+                               and f.exception() is None))
+
+            fut.add_done_callback(_report)
         return fut
 
     async def request(self, rpc: str, **kwargs) -> Any:

@@ -63,6 +63,19 @@ class ClusterView:
     def __init__(self):
         self.entries: Dict[str, dict] = {}   # node_id hex -> entry
         self.version = 0
+        # flight-recorder gossip health: when this consumer last adopted a
+        # head-pushed snapshot (monotonic; 0 = never) — `staleness_s()` is
+        # the age of the cached view, gossiped back to the head as
+        # per-node `gossip_lag_s`
+        self.adopted_ts: float = 0.0
+
+    def staleness_s(self) -> float:
+        """Seconds since the last adopted snapshot; -1 = never adopted."""
+        import time
+
+        if not self.adopted_ts:
+            return -1.0
+        return time.monotonic() - self.adopted_ts
 
     def update(self, entry: dict) -> bool:
         cur = self.entries.get(entry["node_id"])
@@ -88,8 +101,11 @@ class ClusterView:
         """Replace wholesale with a pushed snapshot. Pushes ride one FIFO
         connection, so the latest received is the latest sent; the version
         is kept for diagnostics and conflict reporting."""
+        import time
+
         self.entries = {e["node_id"]: e for e in snap.get("nodes", [])}
         self.version = snap.get("version", self.version)
+        self.adopted_ts = time.monotonic()
 
     # ------------------------------------------------------------ routing
     def select_node(self, resources: Dict[str, float],

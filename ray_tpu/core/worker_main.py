@@ -26,6 +26,7 @@ import inspect
 import os
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
@@ -183,14 +184,24 @@ class WorkerRuntime:
         opts = spec.get("options", {})
         task_key = spec["task_id"].binary()
         self._task_threads[task_key] = threading.get_ident()
+        # run-phase timing for the submitter's flight recorder: the head
+        # never sees lease-path tasks, so the execution window rides the
+        # reply (only when the driver traces — the carrier's presence).
+        # Opened AFTER function load + argument resolution so dependency
+        # fetches land in the dispatch phase, not in "run".
+        prof = None
         try:
             fn = self.client.fn_manager.load(spec["fn_key"])
             args, kwargs = self._resolve_args(spec["args"])
             from ray_tpu.util import tracing
 
+            if opts.get("trace_ctx"):
+                prof = {"start": time.time()}
             with tracing.execute_span(opts.get("name", "task"),
                                       opts.get("trace_ctx")):
                 result = fn(*args, **kwargs)
+            if prof is not None:
+                prof["end"] = time.time()
             meta = self.client.store_result(rid, result, register=False)
         except BaseException as e:  # noqa: BLE001 - failures become error objects
             err = e if isinstance(e, (TaskError, TaskCancelledError)) else \
@@ -209,7 +220,11 @@ class WorkerRuntime:
                         self.client.head_push("worker_retiring")
                     except Exception:
                         pass
-        return {"meta": meta, "retired": self._retiring}
+        rep = {"meta": meta, "retired": self._retiring}
+        if prof is not None:
+            prof.setdefault("end", time.time())  # error path: fn raised
+            rep["prof"] = prof
+        return rep
 
     async def _on_health_ping(self):
         return True

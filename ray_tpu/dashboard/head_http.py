@@ -93,6 +93,58 @@ def _core_metrics_snapshot(head) -> list:
                      total[r], {"resource": r}))
         out.append(g("resource_available", "Cluster resource available",
                      avail.get(r, 0), {"resource": r}))
+    out.extend(_scheduler_metrics_snapshot(head))
+    return out
+
+
+def _scheduler_metrics_snapshot(head) -> list:
+    """Two-level-scheduler flight-recorder series, computed at scrape time
+    from the head's merged per-node telemetry (gossiped counters + delta
+    arrival bookkeeping) — the observability the decentralized warm path
+    took away from the head-centric stack."""
+    import time as _time
+
+    def series(name, kind, desc, rows):
+        return {"name": name, "kind": kind, "description": desc,
+                "series": [{"tags": t, "value": float(v)} for t, v in rows]}
+
+    now = _time.time()
+    local_grants, spillbacks, staleness, lag, pool_idle = [], [], [], [], []
+    for n in head.nodes.values():
+        if n.is_head or not n.alive:
+            continue
+        tags = {"node_id": n.node_id.hex()[:12]}
+        stats = n.sched_stats or {}
+        local_grants.append((tags, stats.get("local_grants", 0)))
+        spillbacks.append((tags, stats.get("spillbacks", 0)))
+        staleness.append((tags, max(now - n.last_delta_ts, 0.0)))
+        view_age = (n.gossip_health or {}).get("view_age_s", -1)
+        if view_age is not None and view_age >= 0:
+            lag.append((tags, view_age))
+        pool_idle.append((tags, n.pool_idle))
+    head_tags = {"node_id": "head"}
+    out = [
+        series("lease_local_grants_total", "counter",
+               "Leases granted daemon-locally (warm path, no head RPC)",
+               local_grants or [(head_tags, 0)]),
+        series("lease_spillbacks_total", "counter",
+               "Lease requests a node daemon refused back to the head",
+               spillbacks or [(head_tags, 0)]),
+        series("lease_head_grants_total", "counter",
+               "Leases granted by the head (cold path or spillback)",
+               [(head_tags, head.sched_totals.get("head_grants", 0))]),
+        series("cluster_view_staleness_s", "gauge",
+               "Age of the newest resource-view delta the head has from "
+               "each node daemon", staleness or [(head_tags, 0.0)]),
+        series("scheduler_pool_idle_workers", "gauge",
+               "Warm lease-pool size gossiped by each node daemon",
+               pool_idle or [(head_tags, 0)]),
+    ]
+    if lag:
+        out.append(series(
+            "gossip_lag_s", "gauge",
+            "Each daemon's reported age of its cached head-broadcast "
+            "cluster view", lag))
     return out
 
 
@@ -132,7 +184,7 @@ def build_app(head) -> web.Application:
         })
 
     async def metrics(_req):
-        from ray_tpu.util.metrics import render_prometheus
+        from ray_tpu.util.metrics import render_prometheus, snapshot_all
 
         snapshots = {}
         for (ns, key), value in list(head.kv.items()):
@@ -141,13 +193,23 @@ def build_app(head) -> web.Application:
                     snapshots[key.decode()] = json.loads(value)
                 except Exception:
                     continue
-        snapshots["head"] = _core_metrics_snapshot(head)
+        # the head's own registry (its flight-recorder RPC series) is
+        # read in-process — the dashboard runs on the head's loop
+        snapshots["head"] = _core_metrics_snapshot(head) + snapshot_all()
         return web.Response(text=render_prometheus(snapshots),
                             content_type="text/plain")
 
+    async def scheduler(_req):
+        """Two-level-scheduler flight recorder: per-node stats + the
+        merged recent lease-lifecycle event stream."""
+        return _json({"stats": head._list_state("scheduler_stats"),
+                      "recent_events": list(head.lease_events)[-200:]})
+
     app.router.add_get("/", index)
     app.router.add_get("/api/cluster", cluster)
+    app.router.add_get("/api/scheduler", scheduler)
     for kind in ("nodes", "actors", "workers", "tasks", "task_events",
+                 "lease_events", "scheduler_stats",
                  "objects", "placement_groups"):
         app.router.add_get(f"/api/{kind}", state_route(kind))
     # ------------------------------------------------------ job REST API

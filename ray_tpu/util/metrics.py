@@ -23,6 +23,8 @@ def _PUSH_INTERVAL_S() -> float:
 
     return _config.get("metrics_push_interval_s")
 _pusher: Optional[threading.Thread] = None
+_pusher_stop = threading.Event()
+_pusher_enabled = True
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0]
@@ -130,29 +132,60 @@ def _push_once() -> bool:
         return False
     client = core_api._global_client()
     try:
-        client.head_request(
-            "kv_put", ns="_metrics",
-            key=f"proc:{client.worker_id.hex()}".encode(),
-            value=json.dumps(snapshot_all()).encode(), overwrite=True)
+        # a push, not a round trip: snapshots are telemetry and must never
+        # add head RPCs to otherwise head-free paths (the warm-path
+        # zero-head-RPC contract counts requests, not pushes). The head
+        # stores it under the _metrics KV namespace keyed by this
+        # process's worker id and expires it on disconnect. Fire-and-forget
+        # loses the old round trip's failure signal, so surface the one
+        # observable failure mode — a dead head connection — explicitly.
+        conn = getattr(client, "conn", None)
+        if conn is not None and conn.closed:
+            return False
+        client.head_push("metrics_push",
+                         value=json.dumps(snapshot_all()).encode())
         return True
     except Exception:
         return False
 
 
+def disable_pusher() -> None:
+    """Processes with no CoreClient (head, node daemons) never have
+    anything the pusher could deliver — let them opt out so Metric
+    creation doesn't spawn a thread that wakes forever for nothing.
+    Daemon registries reach the head by riding gossip instead."""
+    global _pusher_enabled
+    _pusher_enabled = False
+
+
 def _ensure_pusher() -> None:
-    global _pusher
+    global _pusher, _pusher_stop
     with _LOCK:
-        if _pusher is not None:
+        if _pusher is not None or not _pusher_enabled:
             return
+        # per-generation stop event, captured by the thread's closure: a
+        # stale thread that outlives its join timeout keeps watching ITS
+        # OWN (set) event and exits, regardless of later generations
+        stop = _pusher_stop = threading.Event()
 
         def loop():
-            while True:
-                time.sleep(_PUSH_INTERVAL_S())
+            while not stop.wait(_PUSH_INTERVAL_S()):
                 _push_once()
 
         _pusher = threading.Thread(target=loop, daemon=True,
                                    name="metrics-pusher")
         _pusher.start()
+
+
+def stop_pusher() -> None:
+    """Stop the background pusher thread (called by `ray_tpu.shutdown()`);
+    the next Metric creation restarts it."""
+    global _pusher
+    with _LOCK:
+        thread, _pusher = _pusher, None
+        _pusher_stop.set()
+    if thread is not None:
+        thread.join(timeout=2)
 
 
 def flush() -> bool:
@@ -176,30 +209,41 @@ def _fmt_tags(tags: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> s
 
 
 def render_prometheus(snapshots: Dict[str, List[dict]]) -> str:
-    """snapshots: {process_key: snapshot_all() output} → exposition text."""
-    seen_help = set()
-    out: List[str] = []
+    """snapshots: {process_key: snapshot_all() output} → exposition text.
+
+    Prometheus exposition requires every sample of a metric family to sit
+    under a single `# TYPE` block, so samples are grouped by metric name
+    across processes first (per-process iteration would interleave
+    families and make strict parsers drop samples)."""
+    # name -> {"kind", "description", "samples": [(proc, series_dict)]}
+    families: Dict[str, dict] = {}
     for proc, metrics in sorted(snapshots.items()):
         for m in metrics:
-            name = f"ray_tpu_{m['name']}"
-            if name not in seen_help:
-                desc = str(m["description"]).replace("\\", "\\\\").replace(
-                    "\n", "\\n")
-                out.append(f"# HELP {name} {desc}")
-                out.append(f"# TYPE {name} {m['kind']}")
-                seen_help.add(name)
+            fam = families.setdefault(
+                m["name"], {"kind": m["kind"],
+                            "description": m["description"], "samples": []})
             for s in m["series"]:
-                tags = {**s["tags"], "proc": proc}
-                if "histogram" in s:
-                    h, bounds = s["histogram"], s["boundaries"]
-                    acc = 0
-                    for b, c in zip(bounds + [float("inf")], h["buckets"]):
-                        acc += c
-                        le = "+Inf" if b == float("inf") else repr(b)
-                        out.append(f"{name}_bucket"
-                                   f"{_fmt_tags(tags, {'le': le})} {acc}")
-                    out.append(f"{name}_sum{_fmt_tags(tags)} {h['sum']}")
-                    out.append(f"{name}_count{_fmt_tags(tags)} {h['count']}")
-                else:
-                    out.append(f"{name}{_fmt_tags(tags)} {s['value']}")
+                fam["samples"].append((proc, s))
+    out: List[str] = []
+    for mname in sorted(families):
+        fam = families[mname]
+        name = f"ray_tpu_{mname}"
+        desc = str(fam["description"]).replace("\\", "\\\\").replace(
+            "\n", "\\n")
+        out.append(f"# HELP {name} {desc}")
+        out.append(f"# TYPE {name} {fam['kind']}")
+        for proc, s in fam["samples"]:
+            tags = {**s["tags"], "proc": proc}
+            if "histogram" in s:
+                h, bounds = s["histogram"], s["boundaries"]
+                acc = 0
+                for b, c in zip(bounds + [float("inf")], h["buckets"]):
+                    acc += c
+                    le = "+Inf" if b == float("inf") else repr(b)
+                    out.append(f"{name}_bucket"
+                               f"{_fmt_tags(tags, {'le': le})} {acc}")
+                out.append(f"{name}_sum{_fmt_tags(tags)} {h['sum']}")
+                out.append(f"{name}_count{_fmt_tags(tags)} {h['count']}")
+            else:
+                out.append(f"{name}{_fmt_tags(tags)} {s['value']}")
     return "\n".join(out) + "\n"

@@ -7,14 +7,18 @@ dashboard StateHead process.
 
 from ray_tpu.util.state.api import (get_actor, get_placement_group, list_actors,
                                     subscribe,
-                                    list_nodes, list_objects,
-                                    list_placement_groups, list_task_events,
+                                    list_lease_events, list_nodes,
+                                    list_objects,
+                                    list_placement_groups,
+                                    list_scheduler_stats, list_task_events,
                                     list_tasks, list_workers, summarize_actors,
                                     summarize_objects, summarize_tasks)
 
 __all__ = [
     "subscribe",
-    "get_actor", "get_placement_group", "list_actors", "list_nodes",
-    "list_objects", "list_placement_groups", "list_task_events", "list_tasks",
+    "get_actor", "get_placement_group", "list_actors", "list_lease_events",
+    "list_nodes",
+    "list_objects", "list_placement_groups", "list_scheduler_stats",
+    "list_task_events", "list_tasks",
     "list_workers", "summarize_actors", "summarize_objects", "summarize_tasks",
 ]

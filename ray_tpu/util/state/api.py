@@ -68,6 +68,24 @@ def list_placement_groups(filters=None, limit=None) -> List[dict]:
     return _list("placement_groups", filters, limit)
 
 
+def list_lease_events(filters=None, limit=None) -> List[dict]:
+    """Flight-recorder lease-lifecycle events merged at the head: each
+    node daemon's local grants/spillbacks/pool churn (piggybacked on the
+    resource-view gossip) plus head-granted leases and node deaths.
+    Row keys: kind (local_grant | spillback | pool_acquire | lease_return
+    | pool_release | pool_worker_died | view_adopt | head_grant |
+    node_dead), node_id, ts, and per-kind detail."""
+    return _list("lease_events", filters, limit)
+
+
+def list_scheduler_stats(filters=None, limit=None) -> List[dict]:
+    """Per-node two-level-scheduler telemetry: lifetime local-grant /
+    spillback counters, warm-pool size, gossip health (view version,
+    view age) and head-observed delta staleness — one row per node
+    daemon plus one `is_head` row with the head's grant totals."""
+    return _list("scheduler_stats", filters, limit)
+
+
 def get_actor(actor_id: str) -> Optional[dict]:
     rows = list_actors(filters=[("actor_id", "=", actor_id)])
     return rows[0] if rows else None

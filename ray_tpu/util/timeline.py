@@ -4,12 +4,63 @@ Parity: the `ray timeline` CLI (`python/ray/scripts/scripts.py`) which turns
 profile events into a chrome://tracing JSON file. Here RUNNING→FINISHED/
 FAILED transitions from the head's task-event buffer become complete ("X")
 trace events, one row per worker.
+
+With tracing enabled, the driver's flight-recorder scheduling phases are
+merged in: each traced task gets its own row showing submit →
+lease-acquire[local|spillback|head] → dispatch → run as distinct
+sub-spans, with Chrome flow arrows (`s`/`f` events keyed by task id)
+connecting submit to the run slice — the two-level scheduler's warm path
+made visible per task.
 """
 
 from __future__ import annotations
 
 import json
 from typing import List, Optional
+
+
+def _sched_phase_events(trace: List[dict]) -> None:
+    """Append the driver-side scheduling-phase events (flight recorder)
+    for traced tasks; no-op when nothing was recorded."""
+    from ray_tpu.core.api import _global_client, is_initialized
+
+    if not is_initialized():
+        return
+    client = _global_client()
+    events = list(getattr(client, "sched_events", ()) or ())
+    flows = {}   # task_id -> phases seen (for flow arrows)
+    for ev in events:
+        t0, t1 = ev.get("t0"), ev.get("t1")
+        if t0 is None or t1 is None:
+            continue
+        task_id = ev.get("task_id")
+        mode = ev.get("mode")
+        phase = ev["phase"]
+        name = phase if phase in ("submit", "dispatch", "run") else \
+            f"{phase}[{mode}]"
+        tid = task_id[:12] if task_id else "lease-pool"
+        trace.append({
+            "name": name, "cat": "sched", "ph": "X",
+            # floor at 0.1µs: sub-resolution phases must stay visible (and
+            # nonzero) in chrome://tracing
+            "ts": t0 * 1e6, "dur": max(t1 - t0, 1e-7) * 1e6,
+            "pid": "driver-sched", "tid": tid,
+            "args": {k: v for k, v in ev.items()
+                     if k not in ("t0", "t1") and v is not None},
+        })
+        if task_id:
+            flows.setdefault(task_id, {})[phase] = ev
+    # flow arrows: submit → run (falling back to dispatch) per task
+    for task_id, phases in flows.items():
+        src = phases.get("submit")
+        dst = phases.get("run") or phases.get("dispatch")
+        if src is None or dst is None:
+            continue
+        common = {"cat": "sched", "name": "sched-flow", "id": task_id,
+                  "pid": "driver-sched", "tid": task_id[:12]}
+        trace.append({**common, "ph": "s", "ts": src["t1"] * 1e6})
+        trace.append({**common, "ph": "f", "bp": "e",
+                      "ts": dst["t0"] * 1e6})
 
 
 def timeline(filename: Optional[str] = None) -> List[dict]:
@@ -47,6 +98,7 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
                       "pid": start["node_id"] or "head",
                       "tid": start["worker_id"] or "worker",
                       "args": {"task_id": task_id}})
+    _sched_phase_events(trace)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

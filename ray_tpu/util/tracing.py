@@ -110,10 +110,13 @@ def start_span(name: str, *, carrier: Optional[Dict[str, str]] = None,
     finally:
         _current.reset(token)
         span.end_ts = time.time()
+        cap = max(int(_config.get("tracing_buffer_spans")), 2)
         with _lock:
             _finished.append(span)
-            if len(_finished) > 10000:
-                del _finished[:5000]
+            if len(_finished) > cap:
+                # drop the oldest half: amortized O(1) per span, and the
+                # newest spans are the ones a live debugging session needs
+                del _finished[:cap // 2]
         if _exporter is not None:
             try:
                 _exporter.export([span])

@@ -24,6 +24,166 @@ def cluster():
     ray_tpu.shutdown()
 
 
+def test_flight_recorder_warm_burst_and_daemon_death():
+    """Flight recorder on a real 2-node cluster, one spin-up for three
+    contracts: (a) a warm daemon-granted burst makes ZERO head round
+    trips with instrumentation enabled, yet its local-grant events/
+    counters still reach the head (they ride the existing gossip);
+    (b) freezing the daemon makes the head's cluster_view_staleness_s
+    for that node rise (gossip heartbeat stops); (c) killing it expires
+    the node's and its workers' _metrics KV snapshots."""
+    import signal
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core import config as _config
+    from ray_tpu.util import state
+
+    # tight intervals keep this multi-phase test inside the tier-1 budget:
+    # fast lease idle-out (the head-vs-daemon cold-grant race dance) and a
+    # fast telemetry heartbeat (the staleness clock under test). Set BEFORE
+    # spawning so head/daemon/workers inherit them.
+    overrides = {"RAY_TPU_LEASE_IDLE_S": "0.5",
+                 "RAY_TPU_METRICS_PUSH_INTERVAL_S": "0.5"}
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    cluster = Cluster(num_cpus=0)  # head schedules nothing itself
+    nid = cluster.add_node(num_cpus=4)
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(2)
+        client = ray_tpu.core.api._global_client()
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+                e.get("sched_addr")
+                for e in client.cluster_view.entries.values()):
+            time.sleep(0.1)
+
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        @ray_tpu.remote(max_retries=0)
+        def worker_ident():
+            from ray_tpu.util import metrics as m
+
+            import ray_tpu.core.api as api
+
+            m.Gauge("test_fr_node_worker", "probe").set(1.0)
+            m.flush()
+            return api._global_client().worker_id.hex(), os.getpid()
+
+        assert ray_tpu.get([square.remote(i) for i in range(10)],
+                           timeout=120) == [i * i for i in range(10)]
+        # warm a daemon-granted lease (a head-granted one may win the
+        # cold race; let it idle out and retry — same dance as
+        # test_resource_view.test_daemon_grants_lease_without_head)
+        deadline = time.time() + 90
+        while (time.time() < deadline
+               and client.lease_stats["daemon_grants"] == 0):
+            ray_tpu.get(square.remote(2), timeout=60)
+            if client.lease_stats["daemon_grants"]:
+                break
+            if client._leases:
+                time.sleep(float(_config.get("lease_idle_s")) + 0.5)
+            else:
+                time.sleep(0.05)
+        assert client.lease_stats["daemon_grants"] >= 1, client.lease_stats
+
+        # (a) warm burst: zero head round trips. With the short lease
+        # idle set above the lease can expire between phases, so re-warm
+        # and start the burst immediately (an expired lease would route
+        # tasks through the head and fail the zero-RPC assertion for the
+        # wrong reason)
+        deadline = time.time() + 30
+        while time.time() < deadline and not client._leases:
+            ray_tpu.get(square.remote(0), timeout=30)
+        assert client._leases
+        events = []
+
+        def hook(conn_name, kind, method):
+            if conn_name == "head":
+                events.append((kind, method))
+
+        protocol.add_rpc_interposer(hook)
+        try:
+            refs = [square.remote(i) for i in range(25)]
+            out = ray_tpu.get(refs, timeout=60)
+        finally:
+            protocol.remove_rpc_interposer(hook)
+        assert out == [i * i for i in range(25)]
+        reqs = [m for k, m in events if k == "req"]
+        assert not reqs, f"instrumented warm burst made head RPCs: {reqs}"
+
+        # the daemon's flight-recorder events + counters reach the head
+        # via gossip (no new RPCs anywhere to carry them)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            kinds = {e["kind"] for e in state.list_lease_events()}
+            if "local_grant" in kinds:
+                break
+            time.sleep(0.3)
+        assert "local_grant" in kinds, kinds
+        row = next(r for r in state.list_scheduler_stats()
+                   if r["node_id"] == nid)
+        assert row["local_grants"] >= 1, row
+        assert row["staleness_s"] < 30, row
+
+        # worker + daemon metrics snapshots are in the KV namespace
+        wid, wpid = ray_tpu.get(worker_ident.remote(), timeout=60)
+        wkey, nkey = f"proc:{wid}".encode(), f"proc:node-{nid[:12]}".encode()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (client.head_request("kv_get", ns="_metrics", key=wkey)
+                    is not None
+                    and client.head_request("kv_get", ns="_metrics",
+                                            key=nkey) is not None):
+                break
+            time.sleep(0.3)
+        assert client.head_request("kv_get", ns="_metrics",
+                                   key=wkey) is not None
+        assert client.head_request("kv_get", ns="_metrics",
+                                   key=nkey) is not None
+
+        # (b) frozen daemon: heartbeat stops, head-side staleness rises
+        cluster.stop_node(nid)
+        time.sleep(2.0)  # = 4x the 0.5s heartbeat interval set above
+        row = next(r for r in state.list_scheduler_stats()
+                   if r["node_id"] == nid)
+        assert row["staleness_s"] > 1.0, row
+
+        # (c) killed daemon: its (and its workers') metric keys expire.
+        # The daemon's workers survive it and RECONNECT to the live head
+        # (head-FT semantics adopt them onto the head node), which would
+        # legitimately re-push their snapshots — kill the worker process
+        # too so both expiries are observable.
+        cluster._nodes[0].send_signal(signal.SIGCONT)
+        cluster.kill_node(nid)
+        try:
+            os.kill(wpid, 9)
+        except OSError:
+            pass  # already died with its node
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if (client.head_request("kv_get", ns="_metrics", key=wkey)
+                    is None
+                    and client.head_request("kv_get", ns="_metrics",
+                                            key=nkey) is None):
+                break
+            time.sleep(0.3)
+        assert client.head_request("kv_get", ns="_metrics", key=nkey) \
+            is None, "dead daemon's metrics snapshot still scraped"
+        assert client.head_request("kv_get", ns="_metrics", key=wkey) \
+            is None, "dead node's worker metrics snapshot still scraped"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def test_rpc_chaos_injection_and_reset(cluster):
     protocol.configure_chaos("kv_put:1.0")
     try:
@@ -80,8 +240,12 @@ def test_warm_lease_path_makes_zero_head_rpcs(cluster):
     reqs = [m for k, m in events if k == "req"]
     assert not reqs, f"warm-path burst made head round trips: {reqs}"
     pushes = {m for k, m in events if k == "push"}
-    assert pushes <= {"ref_update"}, \
-        f"warm-path burst pushed more than refcount batches: {pushes}"
+    # permitted head-bound traffic is background telemetry only, and only
+    # as pushes: the refcount batch flush and the metrics pusher's
+    # periodic snapshot (the flight recorder deliberately rides pushes /
+    # existing gossip so the warm path stays RPC-free)
+    assert pushes <= {"ref_update", "metrics_push"}, \
+        f"warm-path burst pushed more than telemetry batches: {pushes}"
 
 
 @ray_tpu.remote(max_retries=5)
