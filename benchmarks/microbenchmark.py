@@ -52,6 +52,83 @@ CONTROL_PLANE_REFERENCE = {  # m5.16xlarge numbers from BASELINE.md §6
 }
 
 
+def head_restart_metric() -> float:
+    """Head-restart-to-reconciled time: SIGKILL the head of a warm
+    2-node cluster (daemon holding a pool carve-out), restart it on the
+    same port, and measure until the daemon has re-registered, run the
+    pool-reconciliation handshake, and the head ledger again matches the
+    daemon-reported carve-outs. Reported as recoveries/s (1/elapsed) so
+    the regression gate's higher-is-better convention applies."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state
+
+    overrides = {"RAY_TPU_POOL_IDLE_S": "120",
+                 "RAY_TPU_LEASE_IDLE_S": "0.5"}
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    cluster = Cluster(num_cpus=0, enable_snapshots=True)
+    nid = cluster.add_node(num_cpus=4)
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(2)
+        client = ray_tpu.core.api._global_client()
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+                e.get("sched_addr")
+                for e in client.cluster_view.entries.values()):
+            time.sleep(0.1)
+
+        @ray_tpu.remote
+        def echo(x):
+            return x
+
+        # warm until the daemon pool holds a carve-out
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            ray_tpu.get(echo.remote(0), timeout=60)
+            rows = state.list_scheduler_stats()
+            row = next((r for r in rows if r["node_id"] == nid), None)
+            if row is not None and row["pooled_workers"] >= 1:
+                break
+            time.sleep(0.3)
+        assert row is not None and row["pooled_workers"] >= 1, row
+
+        cluster.kill_head()
+        t0 = time.perf_counter()
+        cluster.restart_head(restore=True)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                rows = state.list_scheduler_stats()
+                row = next((r for r in rows if r["node_id"] == nid), None)
+                if (row is not None and row["reconciled"]
+                        and row["pooled_workers"] >= 1
+                        and row["pooled_workers"] == (
+                            row["idle_workers"] + row["leased_workers"])):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"never reconciled: {row}")
+        elapsed = time.perf_counter() - t0
+        # liveness proof: the reconciled cluster still schedules
+        assert ray_tpu.get(echo.remote(7), timeout=60) == 7
+        return 1.0 / elapsed
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def control_plane(out_path: str | None = None) -> dict:
     """Just the single-stream control-plane rows (the reference-parity
     gate): emitted as a small JSON artifact that `check_regression.py`
@@ -119,6 +196,11 @@ def control_plane(out_path: str | None = None) -> dict:
     phase("warm_path_tasks_instrumented")
     results["warm_path_tasks_instrumented"] = timeit(warm_burst)
     ray_tpu.shutdown()
+
+    # control-plane robustness row: head SIGKILL → restart → all daemons
+    # re-adopted and the carve-out ledger reconciled (PR 3 tentpole)
+    phase("head_restart_recoveries_per_s")
+    results["head_restart_recoveries_per_s"] = head_restart_metric()
     report = {"metrics": {k: round(v, 2) for k, v in results.items()},
               "unit": "ops/s",
               "host": {"cpus": os.cpu_count()},

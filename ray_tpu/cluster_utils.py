@@ -20,31 +20,76 @@ from typing import Dict, List, Optional
 class Cluster:
     def __init__(self, head_resources: Optional[Dict[str, float]] = None,
                  num_cpus: float = 0, object_store_bytes: int = 1 << 30,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 enable_snapshots: bool = False):
         import uuid
 
-        from ray_tpu.core.resources import strip_device_env
-        import os
-
         self.session = f"s{uuid.uuid4().hex[:12]}"
-        cmd = [sys.executable, "-m", "ray_tpu.core.head_main",
-               "--session", self.session,
-               "--num-cpus", str(num_cpus),
-               "--object-store-bytes", str(object_store_bytes)]
-        if head_resources:
-            cmd += ["--resources", json.dumps(head_resources)]
-        if labels:
-            cmd += ["--labels", json.dumps(labels)]
-        env = strip_device_env(dict(os.environ))
-        env.setdefault("RAY_TPU_NUM_CHIPS", "0")
-        self._head = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
-                                      env=env)
+        self._head_args = {"num_cpus": num_cpus,
+                           "object_store_bytes": object_store_bytes,
+                           "head_resources": head_resources,
+                           "labels": labels,
+                           "enable_snapshots": enable_snapshots}
+        self._head = self._spawn_head(port=0, restore=False)
         line = self._head.stdout.readline()
         assert line.startswith("RAY_TPU_HEAD_PORT="), line
         self.port = int(line.split("=", 1)[1])
         self.address = f"127.0.0.1:{self.port}"
         self._nodes: List[subprocess.Popen] = []
         self._node_ids: List[str] = []
+
+    def _spawn_head(self, port: int, restore: bool) -> subprocess.Popen:
+        import os
+
+        from ray_tpu.core.resources import strip_device_env
+
+        a = self._head_args
+        cmd = [sys.executable, "-m", "ray_tpu.core.head_main",
+               "--session", self.session,
+               "--port", str(port),
+               "--num-cpus", str(a["num_cpus"]),
+               "--object-store-bytes", str(a["object_store_bytes"])]
+        if a["head_resources"]:
+            cmd += ["--resources", json.dumps(a["head_resources"])]
+        if a["labels"]:
+            cmd += ["--labels", json.dumps(a["labels"])]
+        if a["enable_snapshots"]:
+            cmd += ["--enable-snapshots"]
+        if restore:
+            cmd += ["--restore"]
+        env = strip_device_env(dict(os.environ))
+        env.setdefault("RAY_TPU_NUM_CHIPS", "0")
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                                env=env)
+
+    # -------------------------------------------------- head FT drills
+    def kill_head(self) -> None:
+        """SIGKILL the head process (reference GCS-kill chaos drill).
+        Node daemons keep serving warm leases and reconnect when
+        `restart_head` brings the control plane back."""
+        self._head.kill()
+        self._head.wait(timeout=10)
+
+    def restart_head(self, restore: bool = True, timeout: float = 30) -> None:
+        """Restart the head on the SAME port/session; daemons, workers
+        and drivers reconnect and the pool-reconciliation handshake
+        rebuilds the resource ledger from daemon reports."""
+        if self._head.poll() is None:
+            self.kill_head()
+        deadline = time.monotonic() + timeout
+        while True:
+            proc = self._spawn_head(port=self.port, restore=restore)
+            line = proc.stdout.readline()
+            if line.startswith("RAY_TPU_HEAD_PORT="):
+                assert int(line.split("=", 1)[1]) == self.port, line
+                self._head = proc
+                return
+            # bind race with the dying predecessor: retry until deadline
+            proc.kill()
+            proc.wait(timeout=10)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"head did not restart: {line!r}")
+            time.sleep(0.3)
 
     def add_node(self, num_cpus: float = 1, num_tpu_chips: int = 0,
                  resources: Optional[Dict[str, float]] = None,

@@ -119,8 +119,12 @@ class CoreClient:
         self._blocked_lock = threading.Lock()
         self.node_id: Optional[NodeID] = None
         # head-restart survival (reference GCS-client reconnect): bounded
-        # reconnect window; 0 restores die-on-disconnect behavior
+        # reconnect window; 0 restores die-on-disconnect behavior.
+        # last_reconnect_ts lets recovery-aware paths (fn_manager.load)
+        # treat misses right after a restart as transient.
         self._reconnect_s = _config.get("reconnect_timeout_s")
+        self.last_reconnect_ts = 0.0
+        self._register_ts = 0.0  # when node_info (head_uptime_s) was taken
         self._closing = False
         self._connected = threading.Event()
         self._connected.set()
@@ -154,6 +158,11 @@ class CoreClient:
         self._sched_conns: Dict[Tuple[str, int], protocol.Connection] = {}
         self.lease_stats = {"daemon_grants": 0, "head_grants": 0,
                             "spills": 0}
+        # epoch fencing: the cluster epoch observed from the head
+        # (registration reply + cluster_view pushes); lease traffic to
+        # node-daemon schedulers is tagged with it, and a daemon that has
+        # reconciled with a newer head refuses the stale-epoch grant
+        self.cluster_epoch = 0
         # flight recorder, driver side: scheduling-phase events for traced
         # tasks (submit → lease-acquire[mode] → dispatch → run) consumed by
         # ray_tpu.timeline(); recorded only while tracing is enabled, so
@@ -263,6 +272,7 @@ class CoreClient:
         ActorTaskSubmitter's GCS actor-state subscription)."""
         if channel == "cluster_view":
             self.cluster_view.adopt(msg)
+            self.cluster_epoch = msg.get("epoch", self.cluster_epoch)
         if channel == "actor_state" and msg.get("state") in ("RESTARTING",
                                                              "DEAD"):
             aid = ActorID(msg["actor_id"])
@@ -557,6 +567,8 @@ class CoreClient:
         asyncio.ensure_future(self.conn.request("subscribe",
                                                 channel="cluster_view"))
         self.node_id = NodeID(self.node_info["node_id"])
+        self.cluster_epoch = self.node_info.get("epoch", 0)
+        self._register_ts = time.monotonic()
         # negotiated flags: the head's values are authoritative for
         # cluster-shared semantics (config.py registry)
         _config.GLOBAL.adopt_head(self.node_info.get("config"))
@@ -616,7 +628,11 @@ class CoreClient:
                     node_id=(bytes.fromhex(node_id_hex)
                              if node_id_hex else None),
                     log_tag=os.environ.get("RAY_TPU_LOG_TAG"),
-                    venv_key=os.environ.get("RAY_TPU_VENV_KEY"))
+                    venv_key=os.environ.get("RAY_TPU_VENV_KEY"),
+                    # a restarted head parks reconnecting workers until
+                    # their node daemon's reconciliation handshake claims
+                    # or disowns them (double-grant fence)
+                    reconnect=True)
             except Exception:
                 try:
                     await conn.close()
@@ -627,6 +643,8 @@ class CoreClient:
             self.conn = conn
             self.node_info = info
             self.node_id = NodeID(info["node_id"])
+            self.cluster_epoch = info.get("epoch", self.cluster_epoch)
+            self._register_ts = time.monotonic()
             conn.on_close = lambda c: self._handle_head_loss()
             _config.GLOBAL.adopt_head(info.get("config"))
             # the restarted head has no subscriber table: re-subscribe
@@ -645,6 +663,10 @@ class CoreClient:
                     except Exception:
                         pass
             self.ref_tracker.resync()
+            # function/class defs exported after the head's last snapshot
+            # died with it; replayed tasks reference them by hash
+            self.fn_manager.resync()
+            self.last_reconnect_ts = time.monotonic()
             if self.is_driver:
                 import json as _json
                 import sys as _sys
@@ -697,6 +719,21 @@ class CoreClient:
         self._connected.set()  # unblock waiters into their errors
         if self.on_disconnect:
             self.on_disconnect()
+
+    def head_recovering(self) -> bool:
+        """True inside the window where a restarted head may still be
+        re-learning state from reconnecting processes — misses (e.g. a
+        function def) are plausibly transient and worth a brief poll."""
+        if self.last_reconnect_ts and (
+                time.monotonic() - self.last_reconnect_ts < 30.0):
+            return True
+        age = self.node_info.get("head_uptime_s")
+        if age is None or not self._register_ts:
+            return False
+        # a FRESH process (never reconnected) registered to a young head:
+        # e.g. a worker spawned right after a restart, whose driver's
+        # re-exports may still be in flight
+        return age + (time.monotonic() - self._register_ts) < 60.0
 
     def _wait_connected(self) -> None:
         """Block a sync API call while a reconnect is in progress (bounded
@@ -772,33 +809,51 @@ class CoreClient:
         the request is written by a plain loop callback and the reply
         future chains straight into a concurrent future (the same trick
         as _fast_actor_send — Task creation was a measurable slice of
-        every control-plane round trip)."""
-        self._wait_connected()
-        cfut: _cf.Future = _cf.Future()
-        conn = self.conn  # bind now: a reconnect must not swap mid-flight
+        every control-plane round trip).
 
-        def _send():
-            try:
-                fut = conn.request_future(method, **kwargs)
-            except Exception as e:
-                if not cfut.cancelled():
-                    cfut.set_exception(e)
-                return
+        Rides a head restart: a ConnectionLost inside the reconnect
+        window retries on the re-established connection instead of
+        surfacing into callers (a worker fetching a function blob
+        mid-outage would otherwise poison its task's result with an
+        infrastructure error the retry machinery never sees)."""
+        deadline = time.monotonic() + max(self._reconnect_s, 0.0) + 5.0
+        while True:
+            self._wait_connected()
+            cfut: _cf.Future = _cf.Future()
+            conn = self.conn  # bind now: a reconnect must not swap mid-flight
 
-            def _done(f):
-                if cfut.cancelled():
+            def _send(conn=conn, cfut=cfut):
+                try:
+                    fut = conn.request_future(method, **kwargs)
+                except Exception as e:
+                    if not cfut.cancelled():
+                        cfut.set_exception(e)
                     return
-                if f.cancelled():
-                    cfut.cancel()
-                elif f.exception() is not None:
-                    cfut.set_exception(f.exception())
-                else:
-                    cfut.set_result(f.result())
 
-            fut.add_done_callback(_done)
+                def _done(f):
+                    if cfut.cancelled():
+                        return
+                    if f.cancelled():
+                        cfut.cancel()
+                    elif f.exception() is not None:
+                        cfut.set_exception(f.exception())
+                    else:
+                        cfut.set_result(f.result())
 
-        self._loop_call_soon(_send)
-        return cfut.result()
+                fut.add_done_callback(_done)
+
+            self._loop_call_soon(_send)
+            try:
+                return cfut.result()
+            except protocol.ConnectionLost:
+                if (self._closing or self._reconnect_s <= 0
+                        or time.monotonic() >= deadline
+                        # a ConnectionLost while the conn is still open is
+                        # synthetic (chaos injection): surface it — only a
+                        # genuinely dead head rides the reconnect
+                        or not conn.closed):
+                    raise
+                time.sleep(0.1)  # _handle_head_loss swaps self.conn
 
     def direct_request(self, addr, method: str, **kwargs) -> Any:
         """Synchronous RPC to another process's direct server (connection
@@ -974,8 +1029,7 @@ class CoreClient:
         meta = self.local_metas.get(ref.id)
         if meta is not None and ref.id not in self._registered:
             self._registered.add(ref.id)
-            self._wait_connected()
-            self._call(self.conn.request("put_meta", meta=meta))
+            self.head_request("put_meta", meta=meta)  # rides a head restart
 
     def adopt_meta(self, meta: ObjectMeta) -> ObjectRef:
         """Record a meta received from a direct actor reply."""
@@ -1144,8 +1198,9 @@ class CoreClient:
                     if self._resolve_pending_call(ref.id, timeout=remaining):
                         meta = self.local_metas[ref.id]
                     else:
-                        meta = self._call(self.conn.request(
-                            "get_meta", object_id=ref.id.binary(), timeout=remaining))
+                        meta = self.head_request(
+                            "get_meta", object_id=ref.id.binary(),
+                            timeout=remaining)
                     if meta is None:
                         raise GetTimeoutError(f"get timed out on {ref}")
                     self.local_metas[ref.id] = meta
@@ -1440,7 +1495,8 @@ class CoreClient:
                     "lease_grant",
                     resources=options.get("resources") or {"CPU": 1},
                     label_selector=options.get("label_selector"),
-                    venv_key=(options.get("runtime_env") or {}).get("pip_key")),
+                    venv_key=(options.get("runtime_env") or {}).get("pip_key"),
+                    epoch=self.cluster_epoch or None),
                 timeout=10.0)
         except asyncio.TimeoutError:
             # the daemon may still complete this grant after we give up —
@@ -1595,6 +1651,7 @@ class CoreClient:
                 # policy (no duplicate-execution risk)
                 lease.dead = True
                 spec["failover"] = True  # head skips the dup holder add
+                self._track_failover(spec)
                 self.conn.push("submit_task", spec=spec)
                 return {"meta": None}
             if self._sched_tracing():
@@ -1640,6 +1697,7 @@ class CoreClient:
             # tasks surface a worker-died error.
             if spec.get("options", {}).get("max_retries", 3):
                 spec["failover"] = True  # head skips the duplicate holder add
+                self._track_failover(spec)
                 self.conn.push("submit_task", spec=spec)
                 return {"meta": None}
             rid = ObjectID(spec["return_ids"][0])
@@ -1668,6 +1726,16 @@ class CoreClient:
                     self._draining.remove(lease)
             if release:
                 self._release_lease_now(lease)
+
+    def _track_failover(self, spec: dict) -> None:
+        """Record a lease-failover resubmission for head-restart replay:
+        the push may land in a dead head socket's buffer (the worker died
+        WITH the head), and lease submits are not otherwise tracked — an
+        untracked failover would lose the task forever."""
+        with self._inflight_lock:
+            self._inflight_specs[ObjectID(spec["return_ids"][0])] = spec
+            while len(self._inflight_specs) > 4096:
+                self._inflight_specs.popitem(last=False)
 
     def _try_lease_submit(self, fn_key, payload, deps, tokens, options,
                           task_id, return_id: ObjectID) -> bool:
@@ -1961,19 +2029,18 @@ class CoreClient:
                                      no_restart=no_restart))
 
     # ------------------------------------------------------------------ kv
+    # via head_request: KV ops are idempotent and ride a head restart
+    # (retry on the re-established connection) — a worker loading a
+    # function blob mid-outage must stall briefly, not fail its task
     def kv_put(self, ns: str, key: bytes, value: bytes, overwrite=True) -> bool:
-        self._wait_connected()
-        return self._call(self.conn.request("kv_put", ns=ns, key=key,
-                                            value=value, overwrite=overwrite))
+        return self.head_request("kv_put", ns=ns, key=key, value=value,
+                                 overwrite=overwrite)
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
-        self._wait_connected()
-        return self._call(self.conn.request("kv_get", ns=ns, key=key))
+        return self.head_request("kv_get", ns=ns, key=key)
 
     def kv_del(self, ns: str, key: bytes) -> bool:
-        self._wait_connected()
-        return self._call(self.conn.request("kv_del", ns=ns, key=key))
+        return self.head_request("kv_del", ns=ns, key=key)
 
     def kv_keys(self, ns: str, prefix: bytes) -> list:
-        self._wait_connected()
-        return self._call(self.conn.request("kv_keys", ns=ns, prefix=prefix))
+        return self.head_request("kv_keys", ns=ns, prefix=prefix)

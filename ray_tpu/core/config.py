@@ -117,6 +117,18 @@ FLAGS: List[Flag] = [
          "each costs a full driver process on the head node."),
     Flag("testing_rpc_failure", "RAY_TPU_TESTING_RPC_FAILURE", str, "",
          "Chaos injection: 'method:prob,...' (reference rpc_chaos)."),
+    Flag("chaos", "RAY_TPU_CHAOS", str, "",
+         "Deterministic fault plan: comma-separated rules "
+         "'kind:target[:k=v...]' with kinds drop|delay|dup|partition|kill,"
+         " triggers n=/every=/p=, windows after=/for=, plan-wide seed=N "
+         "(README 'Failure model'); faults surface as "
+         "chaos_injected_total{method,kind}."),
+    Flag("node_reconnect_timeout_s", "RAY_TPU_NODE_RECONNECT_TIMEOUT_S",
+         float, 60.0,
+         "Window for a node daemon to reconnect to a restarted/partitioned"
+         " head while serving warm leases from its existing pools and "
+         "queueing gossip; 0 = die on head disconnect (pre-epoch "
+         "behavior)."),
     # ------------------------------------------------------------- memory
     # ------------------------------------------------------------- health
     Flag("health_check_interval_s", "RAY_TPU_HEALTH_CHECK_INTERVAL_S",

@@ -85,8 +85,17 @@ def install(process_role: str) -> bool:
         "Control-plane request round-trip latency by method and edge role",
         boundaries=RPC_LATENCY_BOUNDARIES,
         tag_keys=("method", "role"))
+    chaos = metrics.Counter(
+        "chaos_injected_total",
+        "Faults injected by the chaos plane (protocol.configure_chaos) "
+        "by method and fault kind",
+        tag_keys=("method", "kind"))
 
     def _record(name, kind, method, **extra):
+        if kind == "chaos":
+            chaos.inc(tags={"method": method,
+                            "kind": extra.get("chaos_kind", "?")})
+            return
         role = _role_of(name, process_role)
         if kind == "rep":
             latency.observe(extra.get("duration_s", 0.0),
@@ -115,8 +124,11 @@ def installed_role() -> Optional[str]:
 
 class EventRing:
     """Bounded ring of flight-recorder events with monotonic sequence
-    numbers, drain-for-send, and requeue-on-failure — the node daemon's
-    per-node buffer piggybacked on resource_view_delta gossip."""
+    numbers and drain-for-send — the node daemon's per-node buffer
+    piggybacked on resource_view_delta gossip. Delivery reliability
+    lives one level up: drained events wait in the daemon's ack-tracked
+    pending buffer until the head acknowledges their seq (see
+    node_main._gossip_send)."""
 
     def __init__(self, cap: int):
         from collections import deque
@@ -143,12 +155,3 @@ class EventRing:
             out.append(self._events.popleft())
         return out
 
-    def requeue(self, events: list) -> None:
-        """Put a drained batch back at the FRONT (a send failed); events
-        that no longer fit under the cap count as dropped."""
-        room = self.cap - len(self._events)
-        if room < len(events):
-            self.dropped += len(events) - max(room, 0)
-            events = events[-room:] if room > 0 else []
-        for ev in reversed(events):
-            self._events.appendleft(ev)

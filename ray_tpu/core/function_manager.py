@@ -30,12 +30,37 @@ class FunctionManager:
             self._exported[key] = blob
         return key
 
+    def resync(self) -> None:
+        """Re-export every cached definition (head-restart recovery: a
+        def exported after the last snapshot died with the old head, and
+        in-flight/replayed tasks still reference it by hash)."""
+        for key, blob in list(self._exported.items()):
+            try:
+                self.client.head_push("kv_put", ns=FUNCTION_NS, key=key,
+                                      value=blob, overwrite=False)
+            except Exception:
+                pass
+
     def load(self, key: bytes) -> Any:
         if key in self._loaded:
             return self._loaded[key]
         blob = self._exported.get(key)
         if blob is None:
+            import time as _time
+
             blob = self.client.kv_get(FUNCTION_NS, key)
+            recovering = getattr(self.client, "head_recovering", None)
+            if blob is None and recovering is not None and recovering():
+                # a miss inside the head-restart recovery window (we rode
+                # a reconnect, or we are a fresh process on a young head)
+                # is probably transient: the restored head predates this
+                # def and its exporter re-pushes on reconnect — poll
+                # briefly. A miss with no restart in sight fails fast
+                # (no 5 s stall for genuinely missing defs).
+                deadline = _time.monotonic() + 5.0
+                while blob is None and _time.monotonic() < deadline:
+                    _time.sleep(0.2)
+                    blob = self.client.kv_get(FUNCTION_NS, key)
             if blob is None:
                 raise RuntimeError(f"function def {key.hex()} not found in KV")
         obj = cloudpickle.loads(blob)

@@ -62,6 +62,16 @@ class NodeInfo:
         self.last_delta_ts = time.time()
         self.sched_stats: Dict[str, float] = {}
         self.gossip_health: Dict[str, float] = {}
+        # partition tolerance: the daemon's gossiped live-lease count, the
+        # highest flight-recorder event seq merged (duplicate deliveries of
+        # un-acked batches are dropped below it), and the reconciliation
+        # handshake state — False from every (re)registration until the
+        # daemon's pool_reconcile report rebuilds this node's carve-outs
+        self.pool_leased = 0
+        self.fr_last_seq = 0
+        self.reconciled = conn is None  # head-local node: nothing to do
+        self.pending_pool: Dict[WorkerID, dict] = {}  # claimed at register
+        self.unadopted: Set["WorkerInfo"] = set()     # parked reconnectors
         self.alive = True
         self.idle: List["WorkerInfo"] = []
         self.workers: Set[WorkerID] = set()
@@ -113,8 +123,15 @@ class WorkerInfo:
         self.leased_to: Optional[WorkerID] = None
         # two-level scheduling: True while this worker (and its resource
         # carve-out) belongs to its node daemon's lease pool — the head
-        # never dispatches to it until the daemon releases it back
+        # never dispatches to it until the daemon releases it back.
+        # pool_grant_seq keys the carve-out generation: a pool_release
+        # must echo it, so duplicate/late releases of an older generation
+        # are no-ops (epoch + seq keyed idempotence)
         self.pooled = False
+        self.pool_grant_seq: Optional[int] = None
+        # the node id the worker's registration named (survives the
+        # fallback to head_node when its daemon is mid-reconnect)
+        self.declared_node: Optional[NodeID] = None
         self.log_tag: Optional[str] = None  # stem of its log files
 
 
@@ -327,7 +344,16 @@ class Head:
         self.lease_events: deque = deque(
             maxlen=_config.get("flight_recorder_head_events"))
         self.sched_totals = {"head_grants": 0, "pool_acquires": 0,
-                             "pool_releases": 0}
+                             "pool_releases": 0, "stale_epoch_rejects": 0,
+                             "reconciles": 0}
+        # epoch fencing: a cluster epoch stamped into cluster_view and
+        # every grant/carve-out; daemons and clients tag pool/lease traffic
+        # with the epoch they observed, and stale-epoch operations are
+        # rejected and routed into reconciliation instead of silently
+        # mutating the ledger. Wall-clock seeded so a restart without a
+        # snapshot still moves forward; restore bumps past the snapshot's.
+        self.cluster_epoch = int(time.time())
+        self._pool_seq = 0  # carve-out generation counter (grant_seq)
         # object lineage: return oid -> producing task spec, for
         # reconstruction of lost objects (reference: TaskManager lineage +
         # object_recovery_manager). Bounded FIFO.
@@ -412,7 +438,8 @@ class Head:
                 return None
 
         async def register_worker(worker_id, pid, port, is_driver, node_id=None,
-                                  log_tag=None, venv_key=None):
+                                  log_tag=None, venv_key=None,
+                                  reconnect=False):
             nid = NodeID(node_id) if node_id else self.node_id
             node = self.nodes.get(nid) or self.head_node
             w = WorkerInfo(WorkerID(worker_id), conn_state["conn"], pid, port,
@@ -421,15 +448,53 @@ class Head:
             w.proc = self._spawned.pop(pid, None)
             w.log_tag = log_tag    # maps this worker to its log files
             w.venv_key = venv_key
+            # the node the worker CLAIMS to belong to (its spawn-time env),
+            # kept even when the lookup fell back to head_node because the
+            # daemon has not re-registered yet — pool_reconcile uses it to
+            # find fallback-parked workers
+            w.declared_node = nid
             self.workers[w.worker_id] = w
             conn_state["worker"] = w
             node.workers.add(w.worker_id)
             if not is_driver:
-                node.idle.append(w)
                 node.starting_workers = max(0, node.starting_workers - 1)
-                self._grant_lease_waiters(node)
-                self._kick()
+                item = (node.pending_pool.pop(w.worker_id, None)
+                        if node.conn is not None else None)
+                # declared a remote node that has not re-registered yet:
+                # its daemon may still pool this worker — treat like an
+                # unreconciled node (the fallback to head_node must not
+                # bypass the double-grant fence)
+                daemon_pending = (node is self.head_node
+                                  and nid != self.node_id)
+                if item is not None:
+                    # its daemon's reconciliation report already claimed
+                    # this worker for a lease pool: restore the carve-out
+                    # instead of exposing it to head dispatch
+                    self._adopt_pooled(node, w, item)
+                elif reconnect and (daemon_pending or (
+                        node.conn is not None and not node.reconciled)):
+                    # a surviving worker re-registering after a head
+                    # restart: its node daemon may still hold it in a
+                    # lease pool — park it until pool_reconcile claims or
+                    # disowns it (double-grant fence), with a promotion
+                    # timeout in case the daemon never reports. 10 s: a
+                    # live daemon reconciles within ~1 s of reconnecting
+                    # (its backoff caps at 2 s), so the fence comfortably
+                    # outlasts reconcile without stranding workers whose
+                    # daemon died for good.
+                    node.unadopted.add(w)
+                    asyncio.get_running_loop().call_later(
+                        10.0, self._promote_unadopted, node, w)
+                else:
+                    node.idle.append(w)
+                    self._grant_lease_waiters(node)
+                    self._kick()
             return {"node_id": node.node_id.binary(), "session": self.session,
+                    "epoch": self.cluster_epoch,
+                    # lets clients recognize the restart-recovery window
+                    # (a young head may still be re-learning state from
+                    # reconnecting exporters)
+                    "head_uptime_s": time.time() - self.start_time,
                     "resources": node.resources, "labels": node.labels,
                     # the head's refcount setting is authoritative; clients
                     # enable/disable their trackers from this reply
@@ -443,6 +508,34 @@ class Head:
         async def register_node(node_id, resources, labels, max_workers,
                                 data_port=None, sched_port=None):
             nid = NodeID(node_id)
+            existing = self.nodes.get(nid)
+            if existing is not None and not existing.is_head:
+                # re-registration after a connection flap / healed
+                # partition: keep the ledger, workers and pool state —
+                # only the transport is new. The reconciliation handshake
+                # re-runs (the daemon reports its inventory right after
+                # this reply) to settle any drift from the outage.
+                old_conn = existing.conn
+                node = existing
+                node.conn = conn_state["conn"]
+                node.alive = True
+                node.reconciled = False
+                if data_port:
+                    node.data_addr = (_peer_host() or "127.0.0.1", data_port)
+                if sched_port:
+                    node.sched_addr = (_peer_host() or "127.0.0.1",
+                                       sched_port)
+                conn_state["node"] = node
+                if old_conn is not None and not old_conn.closed:
+                    asyncio.ensure_future(old_conn.close())
+                self.lease_events.append(
+                    {"ts": time.time(), "kind": "node_reregister",
+                     "node_id": nid.hex()})
+                self._kick()
+                self._view_changed()
+                return {"session": self.session,
+                        "head_node_id": self.node_id.binary(),
+                        "epoch": self.cluster_epoch}
             node = NodeInfo(nid, resources, labels, conn_state["conn"],
                             max_workers)
             if data_port:
@@ -454,28 +547,46 @@ class Head:
             self._publish("node_state", {"node_id": nid.binary(), "state": "ALIVE"})
             self._kick()
             self._view_changed()
-            return {"session": self.session, "head_node_id": self.node_id.binary()}
+            return {"session": self.session,
+                    "head_node_id": self.node_id.binary(),
+                    "epoch": self.cluster_epoch}
 
         async def resource_view_delta(version, idle_workers, labels=None,
                                       events=None, stats=None, gossip=None,
-                                      metrics=None):
+                                      metrics=None, epoch=None,
+                                      leased_workers=None):
             """Node-daemon gossip: its lease-pool state changed. Stale
-            versions (a reconnect replaying an old delta) are ignored —
-            but the piggybacked flight-recorder telemetry (events ride
-            exactly once, drained daemon-side) is merged regardless."""
+            versions (a reconnect replaying an old delta) are ignored.
+            The reply acks the highest flight-recorder event seq merged —
+            the daemon keeps un-acked batches pending and resends them
+            (duplicates are dropped here by per-node seq), so a delta
+            lost on a dying connection no longer loses its events."""
             node = conn_state.get("node")
             if node is None:
                 return False
+            if epoch is not None and epoch != self.cluster_epoch:
+                # a delta stamped with a dead epoch must not mutate the
+                # view or the telemetry merge — route the daemon into the
+                # reconciliation handshake instead
+                self._stale_epoch("resource_view_delta", node)
+                return {"nack": True, "epoch": self.cluster_epoch}
             node.last_delta_ts = time.time()
             if events:
                 nid = node.node_id.hex()
                 for ev in events:
+                    seq = ev.get("seq", 0)
+                    if seq and seq <= node.fr_last_seq:
+                        continue  # re-delivery of an un-acked batch
                     ev["node_id"] = nid
                     self.lease_events.append(ev)
+                    if seq:
+                        node.fr_last_seq = seq
             if stats:
                 node.sched_stats = stats
             if gossip:
                 node.gossip_health = gossip
+            if leased_workers is not None:
+                node.pool_leased = leased_workers
             if metrics is not None:
                 # daemons have no CoreClient/pusher: their metrics registry
                 # snapshot rides the gossip into the same _metrics KV
@@ -486,14 +597,14 @@ class Head:
                 self.kv[("_metrics",
                          f"proc:node-{node.node_id.hex()[:12]}".encode())] = \
                     _json.dumps(metrics).encode()
-            if version <= node.view_version:
-                return False
-            node.view_version = version
-            node.pool_idle = idle_workers
-            if labels:
-                node.labels.update(labels)
-            self._view_changed()
-            return True
+            if version > node.view_version:
+                node.view_version = version
+                node.pool_idle = idle_workers
+                if labels:
+                    node.labels.update(labels)
+                self._view_changed()
+            return {"acked_seq": node.fr_last_seq,
+                    "epoch": self.cluster_epoch}
 
         async def metrics_push(value):
             """Per-process metrics snapshot (drivers/workers push on a
@@ -507,13 +618,18 @@ class Head:
                      f"proc:{w.worker_id.hex()}".encode())] = value
             return True
 
-        async def pool_acquire(resources, venv_key=None):
+        async def pool_acquire(resources, venv_key=None, epoch=None):
             """A node daemon carves a lease worker out of its own node for
             its local pool: the head debits the ledger ONCE here; all
             subsequent grant/return cycles on that worker are daemon-local
-            (reference raylet worker-pool ownership)."""
+            (reference raylet worker-pool ownership). The reply stamps the
+            cluster epoch and a carve-out generation (grant_seq) the
+            daemon must echo on release."""
             node = conn_state.get("node")
             if node is None or not node.could_ever_fit(resources):
+                return None
+            if epoch is not None and epoch != self.cluster_epoch:
+                self._stale_epoch("pool_acquire", node)
                 return None
             lw = None
             if node.fits(resources):
@@ -539,23 +655,108 @@ class Head:
             else:
                 self._acquire(lw, resources)
             lw.pooled = True
+            self._pool_seq += 1
+            lw.pool_grant_seq = self._pool_seq
             self.sched_totals["pool_acquires"] += 1
             self._last_dispatch_ts = time.monotonic()
             self._view_changed()
             return {"worker_id": lw.worker_id.binary(),
-                    "addr": (lw.host or "127.0.0.1", lw.port)}
+                    "addr": (lw.host or "127.0.0.1", lw.port),
+                    "epoch": self.cluster_epoch,
+                    "grant_seq": lw.pool_grant_seq}
 
-        async def pool_release(worker_id):
+        async def pool_release(worker_id, grant_seq=None, epoch=None):
             """Daemon returns a pooled worker (idle too long, or pool
             teardown): resources flow back to the node ledger and the
-            worker rejoins the head's dispatchable idle set."""
+            worker rejoins the head's dispatchable idle set. Idempotent —
+            keyed by (epoch, worker, grant_seq) so the daemon's
+            requeue-with-backoff retries and duplicate deliveries are
+            safe: an already-released worker, a mismatched carve-out
+            generation, or a stale epoch are all no-ops."""
+            if epoch is not None and epoch != self.cluster_epoch:
+                # reconciliation already rebuilt (or will rebuild) this
+                # ledger from the daemon's inventory; applying a stale
+                # release would double-credit the node
+                self._stale_epoch("pool_release", conn_state.get("node"))
+                return {"stale_epoch": True, "epoch": self.cluster_epoch}
             lw = self.workers.get(WorkerID(worker_id))
-            if lw is not None and lw.pooled:
-                lw.pooled = False
-                lw.leased_to = None
-                self.sched_totals["pool_releases"] += 1
-                self.notify_task_done(lw)
-                self._view_changed()
+            if lw is None or not lw.pooled:
+                return True  # already released / died / reconciled away
+            if (grant_seq is not None and lw.pool_grant_seq is not None
+                    and grant_seq != lw.pool_grant_seq):
+                return True  # duplicate from an older carve-out generation
+            lw.pooled = False
+            lw.pool_grant_seq = None
+            lw.leased_to = None
+            self.sched_totals["pool_releases"] += 1
+            self.notify_task_done(lw)
+            self._view_changed()
+            return True
+
+        async def pool_reconcile(inventory, epoch=None):
+            """Reconciliation handshake: on every (re)connect the daemon
+            reports its full pool inventory (idle entries + live local
+            leases). The daemon is the source of truth for carved
+            capacity — the head rebuilds its ledger from this report
+            rather than from a possibly-stale snapshot: unclaimed
+            head-side carve-outs are released (leak fence), claimed
+            workers are (re-)pooled (double-grant fence), and workers
+            that have not re-registered yet are parked in pending_pool
+            for adoption at registration."""
+            node = conn_state.get("node")
+            if node is None:
+                return None
+            reported: Dict[WorkerID, dict] = {}
+            for item in inventory or []:
+                reported[WorkerID(item["wid"])] = item
+            released = 0
+            for w in list(self.workers.values()):
+                if (w.node_id == node.node_id and w.pooled
+                        and w.worker_id not in reported):
+                    # head thinks pooled, daemon disowns it: the carve-out
+                    # would leak forever (e.g. a pool_release lost while
+                    # the head was unreachable)
+                    w.pooled = False
+                    w.pool_grant_seq = None
+                    released += 1
+                    self.sched_totals["pool_releases"] += 1
+                    self.notify_task_done(w)
+            adopted = 0
+            node.pending_pool = {}
+            for wid, item in reported.items():
+                w = self.workers.get(wid)
+                if w is None:
+                    node.pending_pool[wid] = item
+                    continue
+                self._adopt_pooled(node, w, item)
+                adopted += 1
+            node.reconciled = True
+            self.sched_totals["reconciles"] += 1
+            for w in list(node.unadopted):
+                self._promote_unadopted(node, w)
+            # fallback-parked workers (re-registered before this daemon
+            # did, so they landed on head_node): claimed ones were
+            # re-homed by _adopt_pooled above; disowned ones go to work
+            for w in list(self.head_node.unadopted):
+                if getattr(w, "declared_node", None) == node.node_id:
+                    self._promote_unadopted(self.head_node, w)
+            self.lease_events.append(
+                {"ts": time.time(), "kind": "pool_reconcile",
+                 "node_id": node.node_id.hex(), "adopted": adopted,
+                 "released": released, "pending": len(node.pending_pool)})
+            self._view_changed()
+            self._kick()
+            return {"epoch": self.cluster_epoch, "adopted": adopted,
+                    "released": released}
+
+        async def set_node_chaos(node_id, spec):
+            """Chaos control plane: apply a fault plan inside a node
+            daemon (tests sever the daemon<->head edge at a controlled
+            moment without SIGSTOP-freezing the whole process)."""
+            n = self.nodes.get(NodeID(node_id))
+            if n is None or n.conn is None or n.conn.closed:
+                return False
+            n.conn.push("chaos", spec=spec)
             return True
 
         async def submit_task(spec):
@@ -1924,6 +2125,7 @@ class Head:
             node.workers.discard(w.worker_id)
             if w in node.idle:
                 node.idle.remove(w)
+            node.unadopted.discard(w)
         self._release(w)
         rec = getattr(w, "current_record", None)
         if rec is not None and w.running_task is not None:
@@ -1952,6 +2154,31 @@ class Head:
                     self._mark_actor_dead(info, f"worker died (pid {w.pid})")
         if w.is_driver:
             pass  # job cleanup: objects are session-scoped in round 1
+        self._kick()
+
+    def _purge_stale_worker(self, w: WorkerInfo) -> None:
+        """A superseded WorkerInfo's connection closed after a
+        re-registration replaced it in `self.workers`: drop the stale
+        object from idle/parked lists, return its resources, and retry
+        its in-flight task — WITHOUT the full disconnect teardown (the
+        worker id is alive under a fresh WorkerInfo)."""
+        node = self.nodes.get(w.node_id)
+        if node is not None:
+            if w in node.idle:
+                node.idle.remove(w)
+            node.unadopted.discard(w)
+        self._release(w)
+        rec = getattr(w, "current_record", None)
+        if rec is not None and w.running_task is not None:
+            if rec.cancelled:
+                self._fail_task(rec, "task was cancelled", cancelled=True)
+            elif rec.retries_left > 0:
+                rec.retries_left -= 1
+                rec.pending_deps = set()
+                self._enqueue(rec)
+            else:
+                self._fail_task(
+                    rec, f"worker {w.worker_id} died (pid {w.pid})")
         self._kick()
 
     def _maybe_reconstruct(self, oid: ObjectID) -> None:
@@ -2140,7 +2367,8 @@ class Head:
                 total=n.resources, labels=n.labels,
                 idle_workers=n.pool_idle, sched_addr=n.sched_addr,
                 is_head=n.is_head))
-        return {"version": self._view_seq, "nodes": nodes}
+        return {"version": self._view_seq, "nodes": nodes,
+                "epoch": self.cluster_epoch}
 
     async def _view_broadcast_loop(self) -> None:
         """Debounced push of the compacted cluster view to every node
@@ -2302,6 +2530,7 @@ class Head:
                     for p, g in self.pgs.items() if g.state != "REMOVED"},
             "jobs": jobs,
             "job_counter": self.job_counter,
+            "epoch": self.cluster_epoch,
         }
         self._write_snapshot(snap)
 
@@ -2399,6 +2628,11 @@ class Head:
                     self.store = SharedMemoryStore(
                         self.session, capacity_bytes=cap, create_arena=True,
                         namespace=new_id.hex()[:8])
+        # epoch fencing across the restart: strictly above the snapshot's
+        # epoch even if the wall clock went backwards, so every pre-restart
+        # grant/carve-out tag is verifiably stale
+        self.cluster_epoch = max(self.cluster_epoch,
+                                 int(snap.get("epoch", 0)) + 1)
         self.kv.update(snap["kv"])
         # metrics snapshots are per-process and every pre-restart process's
         # connection died with the old head: restoring them would scrape
@@ -2540,6 +2774,15 @@ class Head:
             rows.append({
                 "node_id": n.node_id.hex(), "alive": n.alive,
                 "is_head": False, "idle_workers": n.pool_idle,
+                "leased_workers": n.pool_leased,
+                # head-side carve-out view vs the daemon's gossiped pool:
+                # after reconciliation these must agree (no double-grant,
+                # no leaked carve-out)
+                "pooled_workers": sum(
+                    1 for w in self.workers.values()
+                    if w.node_id == n.node_id and w.pooled),
+                "reconciled": n.reconciled,
+                "pending_pool": len(n.pending_pool),
                 "view_version": n.view_version,
                 "staleness_s": round(now - n.last_delta_ts, 3),
                 "gossip": dict(n.gossip_health),
@@ -2549,6 +2792,7 @@ class Head:
         rows.append({
             "node_id": self.node_id.hex(), "alive": True, "is_head": True,
             "view_version": self._view_seq,
+            "epoch": self.cluster_epoch,
             "staleness_s": 0.0, "gossip": {},
             "lease_events_buffered": len(self.lease_events),
             **{k: v for k, v in self.sched_totals.items()},
@@ -2567,9 +2811,18 @@ class Head:
                     orig_close(c)
                 w = conn_state.get("worker")
                 if w is not None:
-                    self._on_worker_disconnect(w)
+                    if self.workers.get(w.worker_id) is w:
+                        self._on_worker_disconnect(w)
+                    else:
+                        # superseded by a re-registration: don't tear the
+                        # live registration down, but the stale object
+                        # must leave the scheduling structures and its
+                        # in-flight task must retry
+                        self._purge_stale_worker(w)
                 node = conn_state.get("node")
-                if node is not None:
+                # a stale transport closing after a re-registration
+                # swapped in a fresh one must not tear the node down
+                if node is not None and node.conn is conn_state["conn"]:
                     self._on_node_disconnect(node)
 
             conn.on_close = on_close
@@ -2701,6 +2954,66 @@ class Head:
             self._acquire(lw, ent["resources"])
             ent["fut"].set_result(lw)
         self._lease_waiters[:] = remaining
+
+    # ------------------------------------------- epoch / pool reconciliation
+    def _stale_epoch(self, method: str, node: Optional[NodeInfo]) -> None:
+        """Count + record a rejected stale-epoch operation and route its
+        sender into the reconciliation handshake."""
+        self.sched_totals["stale_epoch_rejects"] += 1
+        self.lease_events.append(
+            {"ts": time.time(), "kind": "stale_epoch", "method": method,
+             "node_id": node.node_id.hex() if node is not None else None,
+             "epoch": self.cluster_epoch})
+        if node is not None and node.conn is not None and not node.conn.closed:
+            try:
+                node.conn.push("reconcile_request")
+            except Exception:
+                pass
+
+    def _adopt_pooled(self, node: NodeInfo, w: WorkerInfo,
+                      item: dict) -> None:
+        """Restore a daemon-reported pool carve-out onto `w`: re-home the
+        worker to the reporting node if a head restart parked it elsewhere
+        (register_worker falls back to the head node when the daemon has
+        not re-registered yet), debit the ledger once, and remember the
+        carve-out generation for idempotent release."""
+        old = self.nodes.get(w.node_id)
+        if old is not None and old is not node:
+            old.workers.discard(w.worker_id)
+            if w in old.idle:
+                old.idle.remove(w)
+            old.unadopted.discard(w)
+            w.node_id = node.node_id
+            node.workers.add(w.worker_id)
+        if w in node.idle:
+            node.idle.remove(w)
+        node.unadopted.discard(w)
+        if not w.pooled:
+            self._acquire(w, item.get("resources") or {})
+            w.pooled = True
+        w.leased_to = None
+        if item.get("venv_key") is not None:
+            w.venv_key = item["venv_key"]
+        seq = item.get("seq")
+        if seq is None:
+            self._pool_seq += 1
+            seq = self._pool_seq
+        else:
+            self._pool_seq = max(self._pool_seq, seq)
+        w.pool_grant_seq = seq
+
+    def _promote_unadopted(self, node: NodeInfo, w: WorkerInfo) -> None:
+        """A parked reconnecting worker the daemon's reconcile did not
+        claim (or whose daemon never reported in time): expose it to
+        normal head dispatch."""
+        if w not in node.unadopted or self.workers.get(w.worker_id) is not w:
+            return
+        node.unadopted.discard(w)
+        if (not w.pooled and w.conn is not None and not w.conn.closed
+                and w not in node.idle):
+            node.idle.append(w)
+            self._grant_lease_waiters(node)
+            self._kick()
 
     def notify_actor_ready(self, info: ActorInfo, address) -> None:
         info.state = "ALIVE"

@@ -75,6 +75,17 @@ class NodeDaemon:
                             "pool_releases": 0, "pool_worker_deaths": 0}
         self._fr_metrics_ts = 0.0   # last registry snapshot ride-along
         self._last_gossip_ts = 0.0  # heartbeat bookkeeping (monotonic)
+        # partition tolerance: the cluster epoch observed from the head
+        # (stamped into pool/lease traffic; stale-epoch ops are rejected
+        # head-side and routed into reconciliation), drained-but-unacked
+        # flight-recorder events (resent until the head acks their seq),
+        # and pool_release carve-out returns awaiting delivery (requeued
+        # with bounded backoff instead of fire-and-forget — a release
+        # lost mid-head-outage must not leak the head-side carve-out)
+        self.head_epoch = 0
+        self._reconnecting = False
+        self._fr_pending: List[dict] = []
+        self._pending_releases: List[dict] = []
         isolation = _config.get("store_isolation")
         self.store_ns = _config.get("store_namespace") or (
             self.node_id.hex()[:8] if isolation else "")
@@ -97,26 +108,22 @@ class NodeDaemon:
             host=_config.get("bind_host"))
         self.conn = await protocol.connect(
             self.head_host, self.head_port,
-            handlers={
-                "spawn_worker": self._spawn_worker,
-                "kill_worker": self._kill_worker,
-                "shutdown_node": self._shutdown_node,
-                "free_object": self._free_object,
-                "adopt_object": self._adopt_object,
-                "health_ping": self._health_ping,
-                "cluster_view": self._on_cluster_view,
-                "pool_worker_died": self._on_pool_worker_died,
-            },
-            name="node")
-        self.conn.on_close = lambda c: self.stopping.set()
+            handlers=self._head_handlers(), name="node")
+        self.conn.on_close = self._on_head_conn_close
         reply = await self.conn.request(
             "register_node", node_id=self.node_id.binary(),
             resources=self.resources, labels=self.labels,
             max_workers=self.max_workers, data_port=self.data_port,
             sched_port=self.sched_port)
         self.session = reply["session"]
+        self.head_epoch = reply.get("epoch", 0)
+        # reconciliation handshake runs on EVERY (re)connect — trivially
+        # empty on first boot, the ledger-rebuild source of truth after a
+        # head restart
+        await self._send_reconcile()
         asyncio.ensure_future(self._pool_shrink_loop())
         asyncio.ensure_future(self._fr_heartbeat_loop())
+        asyncio.ensure_future(self._release_flush_loop())
         from ray_tpu.core.store import (SharedMemoryStore,
                                         default_store_bytes as _default_store_bytes)
 
@@ -151,6 +158,131 @@ class NodeDaemon:
     async def _health_ping(self):
         return True
 
+    def _head_handlers(self) -> Dict[str, object]:
+        return {
+            "spawn_worker": self._spawn_worker,
+            "kill_worker": self._kill_worker,
+            "shutdown_node": self._shutdown_node,
+            "free_object": self._free_object,
+            "adopt_object": self._adopt_object,
+            "health_ping": self._health_ping,
+            "cluster_view": self._on_cluster_view,
+            "pool_worker_died": self._on_pool_worker_died,
+            "reconcile_request": self._on_reconcile_request,
+            "chaos": self._on_chaos,
+        }
+
+    async def _on_reconcile_request(self):
+        """Head-pushed when it saw a stale-epoch op from us: re-run the
+        inventory handshake so its ledger matches our pools."""
+        asyncio.ensure_future(self._send_reconcile())
+        return True
+
+    async def _on_chaos(self, spec):
+        """Chaos control plane: the head relays a fault plan for THIS
+        process (tests partition the daemon<->head edge on demand)."""
+        protocol.configure_chaos(spec)
+        self._fr("chaos_config", spec=spec)
+        return True
+
+    # -------------------------------------------- head outage / reconnect
+    def _on_head_conn_close(self, c) -> None:
+        """Graceful degradation instead of suicide: during a head outage
+        or partition the daemon keeps serving warm-path leases from its
+        existing pools, queues gossip/flight-recorder deltas, and drains
+        them after the reconciliation handshake on heal."""
+        if self.stopping.is_set() or self._reconnecting:
+            return
+        timeout = float(_config.get("node_reconnect_timeout_s"))
+        if timeout <= 0:
+            self.stopping.set()
+            return
+        self._reconnecting = True
+        self._fr("head_lost", epoch=self.head_epoch)
+        asyncio.ensure_future(self._head_reconnect_loop(timeout))
+
+    async def _head_reconnect_loop(self, timeout: float) -> None:
+        try:
+            deadline = time.monotonic() + timeout
+            delay = 0.2
+            while not self.stopping.is_set() and time.monotonic() < deadline:
+                try:
+                    conn = await protocol.connect(
+                        self.head_host, self.head_port,
+                        handlers=self._head_handlers(), name="node")
+                except OSError:
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 1.6, 2.0)
+                    continue
+                try:
+                    reply = await conn.request(
+                        "register_node", node_id=self.node_id.binary(),
+                        resources=self.resources, labels=self.labels,
+                        max_workers=self.max_workers,
+                        data_port=self.data_port,
+                        sched_port=self.sched_port)
+                except Exception:
+                    try:
+                        await conn.close()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 1.6, 2.0)
+                    continue
+                self.conn = conn
+                conn.on_close = self._on_head_conn_close
+                self.head_epoch = reply.get("epoch", 0)
+                self._fr("head_reconnect", epoch=self.head_epoch)
+                await self._send_reconcile()
+                # drain queued telemetry + re-advertise pool state under
+                # the (possibly new) epoch
+                self._gossip_send(bump=True)
+                if conn.closed:
+                    # the head died again mid-handshake; its on_close was
+                    # swallowed by the _reconnecting guard — retry here
+                    # instead of returning detached forever
+                    await asyncio.sleep(delay)
+                    continue
+                return
+            self.stopping.set()
+        finally:
+            self._reconnecting = False
+            if (not self.stopping.is_set() and self.conn is not None
+                    and self.conn.closed):
+                # close landed between the in-loop check and the guard
+                # clearing: re-enter the normal head-loss path now that
+                # it will no longer be swallowed
+                self._on_head_conn_close(self.conn)
+
+    async def _send_reconcile(self) -> None:
+        """Report the full pool inventory (idle + live local leases) so
+        the head rebuilds its carve-out ledger from us — the daemon is
+        the source of truth for carved capacity."""
+        inventory = []
+        for ent in list(self.pool_idle) + list(self.pool_leases.values()):
+            inventory.append({
+                "wid": ent["wid"],
+                "resources": dict(ent.get("res") or dict(ent["shape"])),
+                "venv_key": ent.get("venv_key"),
+                "seq": ent.get("seq")})
+        if self.conn is None or self.conn.closed:
+            return
+        try:
+            rep = await self.conn.request(
+                "pool_reconcile", inventory=inventory,
+                epoch=self.head_epoch)
+        except protocol.RpcError:
+            return
+        if rep:
+            self.head_epoch = rep.get("epoch", self.head_epoch)
+            self._fr("pool_reconcile", reported=len(inventory),
+                     adopted=rep.get("adopted"),
+                     released=rep.get("released"))
+        # the rebuilt ledger covers releases queued under a dead epoch
+        # (their workers are simply absent from the report) — drop them
+        self._pending_releases = [p for p in self._pending_releases
+                                  if p["epoch"] == self.head_epoch]
+
     # ------------------------------------------- node-local scheduling
     def _on_sched_connect(self, conn: protocol.Connection) -> None:
         """Per-client scheduler session. Leases are bound to the client's
@@ -163,7 +295,15 @@ class NodeDaemon:
             self._fr("spillback", reason=reason)
             return {"spill": reason}
 
-        async def lease_grant(resources, label_selector=None, venv_key=None):
+        async def lease_grant(resources, label_selector=None, venv_key=None,
+                              epoch=None):
+            if epoch is not None and self.head_epoch \
+                    and epoch != self.head_epoch:
+                # the client's cached view predates a head restart (or
+                # lags ours): refuse and let it spill to the head, which
+                # grants under the current epoch — stale-epoch traffic is
+                # fenced, never silently applied
+                return _spill("epoch")
             if not matches_labels(self.labels, label_selector):
                 return _spill("labels")
             shape = tuple(sorted(resources.items()))
@@ -178,7 +318,7 @@ class NodeDaemon:
                 try:
                     rep = await self.conn.request(
                         "pool_acquire", resources=resources,
-                        venv_key=venv_key)
+                        venv_key=venv_key, epoch=self.head_epoch)
                 except protocol.RpcError:
                     return _spill("head")
                 if rep is None:
@@ -187,6 +327,8 @@ class NodeDaemon:
                          wait_s=round(time.monotonic() - t0, 6))
                 ent = {"wid": rep["worker_id"], "addr": tuple(rep["addr"]),
                        "venv_key": venv_key, "shape": shape,
+                       "res": dict(resources),
+                       "seq": rep.get("grant_seq"),
                        "since": time.monotonic()}
                 if conn.closed:
                     # client died during the head round trip: its on_close
@@ -272,12 +414,46 @@ class NodeDaemon:
             for ent in drop:
                 self._fr("pool_release", worker=ent["wid"].hex()[:12],
                          idle_s=round(now - ent["since"], 3))
-                if self.conn is not None and not self.conn.closed:
-                    try:
-                        self.conn.push("pool_release", worker_id=ent["wid"])
-                    except Exception:
-                        pass
+                # NOT fire-and-forget: an unreachable head mid-release
+                # used to leak the head-side carve-out forever — queue it
+                # for delivery with bounded backoff; the (epoch,
+                # grant_seq) key makes duplicates/retries idempotent
+                self._pending_releases.append(
+                    {"wid": ent["wid"], "seq": ent.get("seq"),
+                     "epoch": self.head_epoch, "attempts": 0,
+                     "next_try": time.monotonic()})
             self._gossip_soon()
+
+    async def _release_flush_loop(self) -> None:
+        """Deliver queued pool_release returns; retry with bounded
+        exponential backoff while the head is unreachable. Stale-epoch
+        entries are settled by the reconciliation handshake instead
+        (the head rebuilds its ledger from our inventory)."""
+        while not self.stopping.is_set():
+            await asyncio.sleep(0.25)
+            if not self._pending_releases:
+                continue
+            if self.conn is None or self.conn.closed:
+                continue
+            now = time.monotonic()
+            for p in list(self._pending_releases):
+                if p["next_try"] > now:
+                    continue
+                try:
+                    await self.conn.request(
+                        "pool_release", worker_id=p["wid"],
+                        grant_seq=p["seq"], epoch=p["epoch"])
+                except protocol.RpcError:
+                    p["attempts"] += 1
+                    p["next_try"] = time.monotonic() + min(
+                        0.5 * (2 ** p["attempts"]), 5.0)
+                    continue
+                # applied, idempotent no-op, or stale-epoch (reconcile
+                # covers it): the head-side carve-out is settled
+                try:
+                    self._pending_releases.remove(p)
+                except ValueError:
+                    pass
 
     def _gossip_soon(self) -> None:
         """Debounced versioned delta to the head (ray_syncer node half)."""
@@ -292,21 +468,29 @@ class NodeDaemon:
         self._gossip_send(bump=True)
 
     def _gossip_send(self, bump: bool) -> None:
-        """Push a resource_view_delta. `bump=True` is a real state change
-        (new version, head re-evaluates the view); `bump=False` is the
+        """Send a resource_view_delta (a request now: the reply acks the
+        flight-recorder batch). `bump=True` is a real state change (new
+        version, head re-evaluates the view); `bump=False` is the
         telemetry heartbeat — it resends the CURRENT version so the head
         merges the piggybacked flight-recorder payload and refreshes its
-        staleness clock without the view plane rebroadcasting anything."""
+        staleness clock without the view plane rebroadcasting anything.
+
+        Delivery acks: drained ring events wait in `_fr_pending` until
+        the head acknowledges their seq; un-acked batches ride every
+        delta (the head drops duplicates by per-node seq) and survive a
+        dying connection — a delta lost mid-daemon-death no longer loses
+        its drained batch (the reconnect resends it)."""
         if self.conn is None or self.conn.closed:
-            return
+            return  # ring + pending keep buffering; drained on reconnect
         if bump:
             self._gossip_version += 1
-        # flight recorder piggyback: drain the event ring, attach lifetime
-        # counters and gossip health to the delta the daemon is sending
-        # anyway; at most once per metrics interval the local metrics
-        # registry snapshot rides along too (daemons hold no CoreClient,
-        # so this gossip IS their metrics export path)
-        events = self.fr_events.drain(limit=256)
+        # resend buffer bounded at 1024 (drained ≤256 per delta): when
+        # acks stall long enough to fill it, further events stay in the
+        # ring, which bounds itself and counts overflow as dropped
+        room = min(256, 1024 - len(self._fr_pending))
+        if room > 0:
+            self._fr_pending.extend(self.fr_events.drain(limit=room))
+        events = list(self._fr_pending)
         gossip = {"view_version": self.cluster_view.version,
                   "view_age_s": round(self.cluster_view.staleness_s(), 3),
                   "events_dropped": self.fr_events.dropped}
@@ -320,16 +504,32 @@ class NodeDaemon:
             metrics_snap = _metrics.snapshot_all()
         self._last_gossip_ts = now
         try:
-            self.conn.push("resource_view_delta",
-                           version=self._gossip_version,
-                           idle_workers=len(self.pool_idle),
-                           events=events, stats=dict(self.sched_stats),
-                           gossip=gossip, metrics=metrics_snap)
+            fut = self.conn.request_future(
+                "resource_view_delta", version=self._gossip_version,
+                idle_workers=len(self.pool_idle),
+                leased_workers=len(self.pool_leases),
+                events=events, stats=dict(self.sched_stats),
+                gossip=gossip, metrics=metrics_snap,
+                epoch=self.head_epoch)
         except Exception:
-            # the delta is re-gossiped on the next change/heartbeat, but
-            # drained ring events would be lost — put them back (overflow
-            # counts as dropped, surfaced via gossip.events_dropped)
-            self.fr_events.requeue(events)
+            return  # events stay pending; the next heartbeat retries
+
+        def _acked(f):
+            if f.cancelled() or f.exception() is not None:
+                return  # still pending; resent with the next delta
+            rep = f.result()
+            if not isinstance(rep, dict):
+                return
+            if rep.get("nack"):
+                # stale epoch: reconciliation (already requested by the
+                # head) will refresh it; events stay pending meanwhile
+                return
+            ack = rep.get("acked_seq", 0)
+            if ack:
+                self._fr_pending = [e for e in self._fr_pending
+                                    if e["seq"] > ack]
+
+        fut.add_done_callback(_acked)
 
     async def _fr_heartbeat_loop(self) -> None:
         """Telemetry liveness: a quiet daemon (no pool churn → no deltas)
@@ -345,6 +545,7 @@ class NodeDaemon:
     async def _on_cluster_view(self, snap):
         prev_age = self.cluster_view.staleness_s()
         self.cluster_view.adopt(snap)
+        self.head_epoch = snap.get("epoch", self.head_epoch)
         self._fr("view_adopt", version=snap.get("version"),
                  nodes=len(snap.get("nodes", [])),
                  age_s=round(prev_age, 3))

@@ -63,6 +63,11 @@ class ClusterView:
     def __init__(self):
         self.entries: Dict[str, dict] = {}   # node_id hex -> entry
         self.version = 0
+        # epoch fencing: the cluster epoch stamped into head-built
+        # snapshots (0 until the first adopt); consumers tag lease/pool
+        # traffic with it so stale-epoch ops are rejected after a head
+        # restart instead of silently mutating the rebuilt ledger
+        self.epoch = 0
         # flight-recorder gossip health: when this consumer last adopted a
         # head-pushed snapshot (monotonic; 0 = never) — `staleness_s()` is
         # the age of the cached view, gossiped back to the head as
@@ -105,6 +110,7 @@ class ClusterView:
 
         self.entries = {e["node_id"]: e for e in snap.get("nodes", [])}
         self.version = snap.get("version", self.version)
+        self.epoch = snap.get("epoch", self.epoch)
         self.adopted_ts = time.monotonic()
 
     # ------------------------------------------------------------ routing

@@ -110,6 +110,7 @@ def _scheduler_metrics_snapshot(head) -> list:
 
     now = _time.time()
     local_grants, spillbacks, staleness, lag, pool_idle = [], [], [], [], []
+    pool_leased = []
     for n in head.nodes.values():
         if n.is_head or not n.alive:
             continue
@@ -122,8 +123,23 @@ def _scheduler_metrics_snapshot(head) -> list:
         if view_age is not None and view_age >= 0:
             lag.append((tags, view_age))
         pool_idle.append((tags, n.pool_idle))
+        pool_leased.append((tags, getattr(n, "pool_leased", 0)))
     head_tags = {"node_id": "head"}
     out = [
+        series("cluster_epoch", "gauge",
+               "Cluster epoch stamped into cluster_view and every "
+               "grant/carve-out (bumps across head restarts; stale-epoch "
+               "ops are rejected and reconciled)",
+               [(head_tags, getattr(head, "cluster_epoch", 0))]),
+        series("scheduler_stale_epoch_rejects_total", "counter",
+               "Operations rejected for carrying a dead cluster epoch "
+               "and routed into pool reconciliation",
+               [(head_tags,
+                 head.sched_totals.get("stale_epoch_rejects", 0))]),
+        series("scheduler_pool_reconciles_total", "counter",
+               "Pool-reconciliation handshakes completed (daemon "
+               "inventory rebuilt the head ledger)",
+               [(head_tags, head.sched_totals.get("reconciles", 0))]),
         series("lease_local_grants_total", "counter",
                "Leases granted daemon-locally (warm path, no head RPC)",
                local_grants or [(head_tags, 0)]),
@@ -139,6 +155,9 @@ def _scheduler_metrics_snapshot(head) -> list:
         series("scheduler_pool_idle_workers", "gauge",
                "Warm lease-pool size gossiped by each node daemon",
                pool_idle or [(head_tags, 0)]),
+        series("scheduler_pool_leased_workers", "gauge",
+               "Live daemon-local leases gossiped by each node daemon",
+               pool_leased or [(head_tags, 0)]),
     ]
     if lag:
         out.append(series(

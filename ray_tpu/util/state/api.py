@@ -71,18 +71,26 @@ def list_placement_groups(filters=None, limit=None) -> List[dict]:
 def list_lease_events(filters=None, limit=None) -> List[dict]:
     """Flight-recorder lease-lifecycle events merged at the head: each
     node daemon's local grants/spillbacks/pool churn (piggybacked on the
-    resource-view gossip) plus head-granted leases and node deaths.
-    Row keys: kind (local_grant | spillback | pool_acquire | lease_return
-    | pool_release | pool_worker_died | view_adopt | head_grant |
-    node_dead), node_id, ts, and per-kind detail."""
+    resource-view gossip, ack-tracked so a dying connection cannot drop a
+    drained batch) plus head-granted leases, node deaths, and the
+    partition-tolerance protocol (reconciliation handshakes, stale-epoch
+    rejections, head reconnects). Row keys: kind (local_grant | spillback
+    | pool_acquire | lease_return | pool_release | pool_worker_died |
+    view_adopt | head_grant | node_dead | node_reregister |
+    pool_reconcile | stale_epoch | head_lost | head_reconnect |
+    chaos_config), node_id, ts, and per-kind detail."""
     return _list("lease_events", filters, limit)
 
 
 def list_scheduler_stats(filters=None, limit=None) -> List[dict]:
     """Per-node two-level-scheduler telemetry: lifetime local-grant /
-    spillback counters, warm-pool size, gossip health (view version,
-    view age) and head-observed delta staleness — one row per node
-    daemon plus one `is_head` row with the head's grant totals."""
+    spillback counters, warm-pool size (idle_workers / leased_workers as
+    gossiped by the daemon vs pooled_workers as carved in the head
+    ledger — equal after reconciliation), the reconciliation state
+    (reconciled / pending_pool), gossip health (view version, view age)
+    and head-observed delta staleness — one row per node daemon plus one
+    `is_head` row with the head's grant totals, cluster epoch, and
+    stale-epoch reject / reconcile counters."""
     return _list("scheduler_stats", filters, limit)
 
 
