@@ -3,8 +3,10 @@
 Compares a fresh run (or a provided JSON) of the control-plane
 microbenchmark rows against the checked-in artifact
 `benchmarks/control_plane_microbench.json` and FAILS (exit 1) if any row
-dropped more than the tolerance (default 10%) — the CI guard that keeps
-the two-level-scheduler hot paths from silently regressing.
+dropped more than the tolerance (default 10%; rows suffixed `_s` are
+seconds and gate in the opposite direction — they fail when the time
+RISES past tolerance) — the CI guard that keeps the two-level-scheduler
+hot paths and the elastic-train recovery drill from silently regressing.
 
 Usage:
   python benchmarks/check_regression.py                # runs the bench
@@ -33,8 +35,21 @@ def compare(baseline: dict, current: dict, tolerance: float) -> list[str]:
         if cur_val is None:
             failures.append(f"{name}: missing from current run")
             continue
-        floor = base_val * (1.0 - tolerance)
         delta = cur_val / base_val - 1.0
+        if name.endswith("_s") and not name.endswith("_per_s"):
+            # seconds rows (recovery/latency) are LOWER-is-better: the
+            # gate fails when the time RISES past the tolerance ceiling
+            ceiling = base_val * (1.0 + tolerance)
+            ok = cur_val <= ceiling
+            status = "OK " if ok else "FAIL"
+            print(f"[{status}] {name}: {cur_val:,.2f}s vs baseline "
+                  f"{base_val:,.2f}s ({delta:+.1%}, ceiling {ceiling:,.2f})")
+            if not ok:
+                failures.append(
+                    f"{name}: {cur_val:,.2f}s is {delta:.1%} above baseline "
+                    f"{base_val:,.2f}s (tolerance {tolerance:.0%})")
+            continue
+        floor = base_val * (1.0 - tolerance)
         status = "OK " if cur_val >= floor else "FAIL"
         print(f"[{status}] {name}: {cur_val:,.1f}/s vs baseline "
               f"{base_val:,.1f}/s ({delta:+.1%}, floor {floor:,.1f})")

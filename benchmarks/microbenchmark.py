@@ -129,6 +129,191 @@ def head_restart_metric() -> float:
                 os.environ[k] = v
 
 
+def _elastic_train_loop(config):
+    """Tiny GPT-2 DDP loop for the elastic-recovery bench/soak: per-worker
+    2-device mesh, cross-worker kv-collective grad sync, sharded
+    checkpoint every step (the restore path reshards it to whatever world
+    size survives)."""
+    import json
+    import os as _os
+    import tempfile
+    import time as _t
+
+    from ray_tpu.utils.platform import ensure_virtual_cpu
+
+    ensure_virtual_cpu(2)
+    import jax
+    import numpy as _np
+
+    from ray_tpu import train
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.train.spmd import (compile_gpt2_train,
+                                    cross_worker_grad_sync,
+                                    default_optimizer, restore_state_sharded,
+                                    save_state_sharded)
+    from ray_tpu.util import collective
+
+    ctx = train.get_context()
+    world, rank, gen = (ctx.get_world_size(), ctx.get_world_rank(),
+                        ctx.get_generation())
+    mesh = build_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
+    cfg = gpt2.GPT2Config.preset("gpt2-tiny", vocab_size=128, max_seq_len=16,
+                                 n_layer=1, n_head=2, d_model=32, d_ff=64)
+    prog = compile_gpt2_train(
+        cfg, mesh, optimizer=default_optimizer(lr=1e-2, warmup=1,
+                                               total_steps=config["steps"]))
+    ck = ctx.get_checkpoint()
+    if ck is not None:
+        state = restore_state_sharded(ck.as_directory(), prog)
+        start = int(state.step)
+    else:
+        state = prog.init_fn(jax.random.key(0))
+        start = 0
+    group = None
+    if world > 1:
+        group = f"ddp:{config['run']}:g{gen}"
+        collective.rebuild_collective_group(world, rank, backend="kv",
+                                            group_name=group)
+    rng = _np.random.default_rng(rank)
+    tokens = jax.device_put(
+        rng.integers(0, cfg.vocab_size, (4, 17), dtype=_np.int32),
+        prog.batch_sharding)
+    for step in range(start, config["steps"]):
+        loss, grads = prog.grad_fn(state, {"tokens": tokens})
+        if world > 1:
+            grads = cross_worker_grad_sync(grads, group, world)
+        state = prog.apply_fn(state, grads)
+        ckpt = None
+        if rank == 0:
+            d = tempfile.mkdtemp(prefix="bench_ckpt_")
+            save_state_sharded(state, d, world_size=world)
+            ckpt = Checkpoint(d)
+            with open(config["history"], "a") as f:
+                f.write(json.dumps({"gen": gen, "step": step,
+                                    "world": world, "loss": float(loss),
+                                    "ts": _t.time()}) + "\n")
+        train.report({"loss": float(loss), "step": step, "world": world},
+                     checkpoint=ckpt)
+        _t.sleep(config.get("step_s", 0.0))
+
+
+def read_jsonl_history(path: str) -> list:
+    """History lines appended by another process: tolerate a torn
+    trailing line mid-append instead of crashing the caller."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def run_elastic_drill(kill, *, steps: int = 30, step_s: float = 0.1,
+                      run_name: str = "train_ft") -> dict:
+    """Shared elastic-recovery drill harness: 2-worker GPT-2-DDP run on a
+    head + 2 one-CPU nodes; once the gang makes progress, `kill(cluster,
+    nids, client)` takes one daemon down; the drill asserts the
+    controller shrinks to world size 1, restores the resharded
+    checkpoint, and FINISHES covering every step. Returns
+    {recovery_s, restarts, final_world_size, steps}. The kill mechanism
+    is the only thing that differs between the bench (`train_ft_metric`,
+    SIGKILL) and the chaos soak (`soak.py`, set_node_chaos self-kill)."""
+    import tempfile
+    import threading
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import (ElasticConfig, FailureConfig, RunConfig,
+                               ScalingConfig)
+    from ray_tpu.train.controller import TrainControllerLogic
+
+    storage = tempfile.mkdtemp(prefix=f"{run_name}_")
+    history = os.path.join(storage, "history.jsonl")
+    cluster = Cluster(num_cpus=0)
+    nids = [cluster.add_node(num_cpus=1), cluster.add_node(num_cpus=1)]
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+        client = ray_tpu.core.api._global_client()
+        logic = TrainControllerLogic(
+            _elastic_train_loop,
+            {"steps": steps, "run": run_name, "history": history,
+             "step_s": step_s},
+            ScalingConfig(num_workers=2, min_workers=1,
+                          resources_per_worker={"CPU": 1},
+                          elastic=ElasticConfig(regrow=False,
+                                                schedule_wait_s=30.0)),
+            RunConfig(name=run_name, storage_path=storage,
+                      failure_config=FailureConfig(max_failures=2)))
+        box = {}
+
+        def _run():
+            try:
+                box["result"] = logic.run()
+            except BaseException as e:
+                box["error"] = e
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if any(e["world"] == 2 and e["step"] >= 3
+                   for e in read_jsonl_history(history)):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("2-worker run never made progress")
+        t_kill = time.time()
+        kill(cluster, nids, client)
+        deadline = time.time() + 180
+        first_post = None
+        while time.time() < deadline:
+            post = [e for e in read_jsonl_history(history)
+                    if e["gen"] >= 1]
+            if post:
+                first_post = post[0]
+                break
+            time.sleep(0.05)
+        assert first_post is not None, "never recovered after daemon kill"
+        t.join(timeout=240)
+        assert not t.is_alive(), "controller never finished"
+        if "error" in box:
+            raise box["error"]
+        result = box["result"]
+        assert result["state"] == "FINISHED", result["error"]
+        assert result["final_world_size"] == 1, result
+        entries = read_jsonl_history(history)
+        assert {e["step"] for e in entries} == set(range(steps))
+        return {"recovery_s": round(first_post["ts"] - t_kill, 2),
+                "restarts": result["restarts"],
+                "final_world_size": result["final_world_size"],
+                "steps": steps}
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def train_ft_metric() -> float:
+    """Elastic-train recovery time: SIGKILL a node daemon mid-2-worker
+    GPT-2-DDP run and measure kill → first post-restore train step (the
+    controller's death-event detection + epoch/generation fencing + mesh
+    reshape to the surviving worker + resharded checkpoint restore +
+    first step at world size 1). Returns SECONDS (lower is better; the
+    regression gate inverts direction for *_s rows)."""
+    out = run_elastic_drill(
+        lambda cluster, nids, client: cluster.kill_node(nids[1]))
+    return out["recovery_s"]
+
+
 def control_plane(out_path: str | None = None) -> dict:
     """Just the single-stream control-plane rows (the reference-parity
     gate): emitted as a small JSON artifact that `check_regression.py`
@@ -201,8 +386,15 @@ def control_plane(out_path: str | None = None) -> dict:
     # re-adopted and the carve-out ledger reconciled (PR 3 tentpole)
     phase("head_restart_recoveries_per_s")
     results["head_restart_recoveries_per_s"] = head_restart_metric()
+
+    # elastic-training robustness row: daemon SIGKILL mid-GPT-2-DDP run →
+    # death-event detection, fence, reshape to surviving capacity,
+    # resharded restore, first post-restore step (seconds, lower-better —
+    # the _s suffix flips the gate's direction)
+    phase("elastic_train_recovery_s")
+    results["elastic_train_recovery_s"] = train_ft_metric()
     report = {"metrics": {k: round(v, 2) for k, v in results.items()},
-              "unit": "ops/s",
+              "unit": "ops/s (*_s rows: seconds, lower is better)",
               "host": {"cpus": os.cpu_count()},
               "reference": CONTROL_PLANE_REFERENCE}
     print(json.dumps(report, indent=2))
@@ -524,8 +716,21 @@ if __name__ == "__main__":
     p.add_argument("--control-plane", action="store_true",
                    help="run only the control-plane gate rows and emit "
                         "the regression artifact")
+    p.add_argument("--train-ft", action="store_true",
+                   help="run only the elastic-train recovery drill and "
+                        "print its recovery time")
     args = p.parse_args()
-    if args.control_plane:
+    if args.train_ft:
+        recovery = train_ft_metric()
+        report = {"metrics": {"elastic_train_recovery_s": round(recovery, 2)},
+                  "unit": "seconds (lower is better)",
+                  "host": {"cpus": os.cpu_count()}}
+        print(json.dumps(report, indent=2))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+                f.write("\n")
+    elif args.control_plane:
         control_plane(args.out)
     else:
         main(args.out)

@@ -1,0 +1,113 @@
+"""Chaos soak: warm-burst + elastic-train drill under RAY_TPU_CHAOS.
+
+Single-command CI soak (marked `slow` via tests/test_soak.py) that drives
+the two acceptance workloads through the deterministic chaos plane with a
+FIXED seed, so a failure replays identically:
+
+  phase 1 — warm-burst: a 2-node cluster where one daemon runs a seeded
+  delay/dup plan on its control-plane edges; pipelined task bursts must
+  all complete (the two-level warm path absorbs injected gossip delay and
+  duplicated frames without dropping work).
+
+  phase 2 — elastic-train drill: a 2-worker GPT-2-DDP run
+  (microbenchmark._elastic_train_loop); once the gang makes progress, a
+  `kill:*:n=1` plan is injected into one daemon over the chaos control
+  plane (`set_node_chaos`), so the daemon SIGKILLs itself on its next
+  outbound call — a chaos-injected daemon kill, not a test harness kill.
+  The controller must shrink to the surviving worker, restore the
+  resharded checkpoint, and FINISH; the kill→first-post-restore-step time
+  is reported (same definition as the `elastic_train_recovery_s` gate
+  row).
+
+Run: `python benchmarks/soak.py [--seed 7] [--out soak.json]`
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def warm_burst_soak(seed: int, rounds: int = 6, burst: int = 40) -> dict:
+    """Task bursts against a daemon running a seeded delay/dup chaos plan."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    chaos = (f"seed={seed},"
+             "delay:resource_view_delta@node:p=0.3:t=0.05,"
+             "dup:lease_return@*:p=0.2")
+    cluster = Cluster(num_cpus=0)
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2, env={"RAY_TPU_CHAOS": chaos})
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        t0 = time.perf_counter()
+        done = 0
+        for _ in range(rounds):
+            out = ray_tpu.get([square.remote(i) for i in range(burst)],
+                              timeout=120)
+            assert out == [i * i for i in range(burst)]
+            done += burst
+        elapsed = time.perf_counter() - t0
+        return {"tasks_completed": done, "elapsed_s": round(elapsed, 2),
+                "tasks_per_s": round(done / elapsed, 1), "chaos": chaos}
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def elastic_train_drill(seed: int, steps: int = 30) -> dict:
+    """The tentpole acceptance drill as a soak phase: the shared harness
+    (`microbenchmark.run_elastic_drill`), with the kill delivered by the
+    chaos plane — `set_node_chaos` arms a seeded `kill:*:n=1` plan, so
+    the victim daemon SIGKILLs ITSELF on its next outbound control-plane
+    call (a chaos-injected kill, not a harness kill)."""
+    from microbenchmark import run_elastic_drill
+
+    def chaos_kill(cluster, nids, client):
+        assert client.head_request(
+            "set_node_chaos", node_id=bytes.fromhex(nids[1]),
+            spec=f"seed={seed},kill:*:n=1") is True
+
+    return run_elastic_drill(chaos_kill, steps=steps,
+                             run_name=f"soak{seed}")
+
+
+def main(seed: int = 7, out: str | None = None, rounds: int = 6,
+         steps: int = 30) -> dict:
+    report = {"seed": seed}
+    print(f"[soak] warm burst under chaos (seed={seed})", file=sys.stderr)
+    report["warm_burst"] = warm_burst_soak(seed, rounds=rounds)
+    print(f"[soak] elastic train drill (seed={seed})", file=sys.stderr)
+    report["elastic_train"] = elastic_train_drill(seed, steps=steps)
+    print(json.dumps(report, indent=2))
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    return report
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--out", default=None)
+    p.add_argument("--rounds", type=int, default=6)
+    p.add_argument("--steps", type=int, default=30)
+    a = p.parse_args()
+    main(seed=a.seed, out=a.out, rounds=a.rounds, steps=a.steps)

@@ -221,8 +221,21 @@ class K8sNodeProvider(NodeProvider):
             alive = {p["metadata"]["name"] for p in pods
                      if p.get("status", {}).get("phase")
                      in (None, "Pending", "Running")}
+            # restartPolicy=Never pods that ran to Succeeded/Failed stay
+            # in the namespace forever unless someone deletes them; every
+            # listed pod carries our cluster label, so they're ours to
+            # clean up (best-effort — a failed DELETE shows up in the
+            # next list and retries then)
+            terminal = [p["metadata"]["name"] for p in pods
+                        if p.get("status", {}).get("phase")
+                        in ("Succeeded", "Failed")]
         except Exception:
             return list(self._nodes)
+        for name in terminal:
+            try:
+                self.api.delete_pod(name)
+            except Exception:
+                pass
         with self._lock:
             for name in list(self._nodes):
                 if name not in alive:

@@ -281,7 +281,10 @@ class CoreClient:
                 conn = self._direct.pop(addr, None)
                 if conn is not None and not conn.closed:
                     asyncio.ensure_future(conn.close())
-        for cb in self._pubsub_callbacks.get(channel, []):
+        # snapshot: subscribers add/remove from other threads (the train
+        # controller's death watch); mutating the live list mid-iteration
+        # would skip a neighbor's callback for this event
+        for cb in list(self._pubsub_callbacks.get(channel, ())):
             try:
                 cb(msg)
             except Exception:
@@ -293,11 +296,25 @@ class CoreClient:
         publishes on `channel` (node_state / actor_state / object_state;
         reference `src/ray/pubsub/` channels). Callbacks run on the
         client's loop thread — hand off, don't block."""
-        first = channel not in self._pubsub_callbacks
+        # empty list counts as first too: unsubscribe_channel leaves the
+        # key behind, and a restarted head has no subscriber table — a
+        # re-arm after disarm must re-issue the subscribe RPC (it is
+        # idempotent head-side)
+        first = not self._pubsub_callbacks.get(channel)
         self._pubsub_callbacks.setdefault(channel, []).append(callback)
         if first and channel != "actor_state":   # actor_state: always subbed
             self._wait_connected()
             self._call(self.conn.request("subscribe", channel=channel))
+
+    def unsubscribe_channel(self, channel: str, callback) -> None:
+        """Drop a `subscribe_channel` callback. The head-side channel
+        subscription stays (it is per-connection and cheap); only the
+        local fan-out entry is removed — callers that re-arm per worker
+        group (the train controller's death watch) don't accumulate
+        dead callbacks across restarts."""
+        cbs = self._pubsub_callbacks.get(channel)
+        if cbs and callback in cbs:
+            cbs.remove(callback)
 
     async def _on_dump_stacks(self):
         """Formatted stacks of every thread in this process (reference:
@@ -647,8 +664,15 @@ class CoreClient:
             self._register_ts = time.monotonic()
             conn.on_close = lambda c: self._handle_head_loss()
             _config.GLOBAL.adopt_head(info.get("config"))
-            # the restarted head has no subscriber table: re-subscribe
-            for ch in ("actor_state", "cluster_view"):
+            # the restarted head has no subscriber table: re-subscribe —
+            # including every channel live pubsub callbacks still watch
+            # (the train controller's death watch rides node_state; losing
+            # it across a head restart would silently downgrade death
+            # detection to poll timeouts)
+            channels = {"actor_state", "cluster_view"}
+            channels.update(ch for ch, cbs in self._pubsub_callbacks.items()
+                            if cbs)
+            for ch in channels:
                 asyncio.ensure_future(conn.request("subscribe", channel=ch))
             # enablement is the head's setting; the restarted head may
             # differ and a non-reporting client would see early evictions

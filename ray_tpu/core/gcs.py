@@ -1142,6 +1142,17 @@ class Head:
         async def list_state(kind):
             return self._list_state(kind)
 
+        async def train_event(run, phase, t0=None, t1=None, detail=None):
+            """A train controller's lifecycle phase (group_start /
+            death_detected / restore / resize / finished), appended to
+            the merged flight-recorder stream so `ray_tpu.timeline()`
+            renders train restarts alongside the epoch-fence/reconcile
+            windows they ride."""
+            self.lease_events.append({
+                "ts": time.time(), "kind": f"train_{phase}", "run": run,
+                "t0": t0, "t1": t1, **(detail or {})})
+            return True
+
         async def get_config():
             """The head's full flag table (ray-tpu config CLI, dashboard)."""
             return _config.GLOBAL.dump()

@@ -64,6 +64,60 @@ class MeshConfig:
         return MeshConfig(**d)
 
 
+def adaptive_mesh_config(
+    requested: Union[MeshConfig, Mapping[str, int]],
+    n_devices: int,
+    shrink_axes: Sequence[str] = ("dp", "fsdp"),
+) -> MeshConfig:
+    """Fit `requested` to what `n_devices` can actually hold.
+
+    Elastic-training companion to `MeshConfig.resolved`: instead of
+    erroring when the device count no longer matches (a worker or host
+    was lost mid-run), shrink the `shrink_axes` — outermost data axes
+    first, the ones whose degree is a pure throughput knob — toward 1
+    until the mesh fits, and grow them back (up to the requested degree)
+    when capacity returns. Model-parallel axes (tp/pp/ep/sp) are never
+    changed: their degree is baked into parameter shapes, so a mesh that
+    cannot hold them is a hard error, same as before.
+
+    The returned config may use only a SUBSET of `n_devices` (odd
+    survivor counts); build the mesh over `devices[:cfg.resolved-total]`.
+    """
+    if isinstance(requested, Mapping):
+        requested = MeshConfig(**dict(requested))
+    d = requested.degrees()
+    if any(v == -1 for v in d.values()):
+        return requested.resolved(n_devices)
+    fixed = math.prod(v for a, v in d.items() if a not in shrink_axes)
+    if fixed <= 0 or n_devices < fixed:
+        raise ValueError(
+            f"{n_devices} devices cannot hold fixed axes "
+            f"{ {a: v for a, v in d.items() if a not in shrink_axes} } "
+            f"(product {fixed})")
+    # floor, don't reject: 3 survivors with tp=2 means a dp=1,tp=2 mesh on
+    # 2 of them — the caller slices devices[:cfg.total] (an odd survivor
+    # count mid-recovery must not hard-error the restart)
+    budget = n_devices // fixed
+    # shrink the LAST shrink axis first (innermost data axis) so the
+    # outer/data-parallel degree survives longest; grow in reverse
+    for axis in reversed(list(shrink_axes)):
+        while d[axis] > 1 and math.prod(d[a] for a in shrink_axes) > budget:
+            d[axis] = (d[axis] // 2) if d[axis] % 2 == 0 else 1
+    got = math.prod(d[a] for a in shrink_axes)
+    if got > budget:
+        raise ValueError(
+            f"cannot shrink {tuple(shrink_axes)} below {got} to fit "
+            f"budget {budget} ({n_devices} devices)")
+    # absorb leftover capacity into the FIRST shrink axis (grow-back on
+    # rejoin), never past the requested degree
+    first = list(shrink_axes)[0]
+    while (got * 2 <= budget
+           and d[first] * 2 <= requested.degrees()[first]):
+        d[first] *= 2
+        got *= 2
+    return MeshConfig(**d)
+
+
 def build_mesh(
     config: Union[MeshConfig, Mapping[str, int], None] = None,
     devices: Optional[Sequence[Any]] = None,

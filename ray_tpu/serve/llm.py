@@ -89,7 +89,7 @@ class LLMEngine:
                  enable_prefix_caching: bool = True,
                  kv_blocks: int = 64, kv_block_size: int = 16,
                  tensor_parallel_size: int = 1,
-                 params_override=None):
+                 params_override=None, cfg_override=None):
         import jax
         import jax.numpy as jnp
 
@@ -100,8 +100,13 @@ class LLMEngine:
         overrides = dict(model_overrides or {})
         overrides.setdefault("max_seq_len", max_seq_len)
         if params_override is not None:
-            # LoRA-merged (or otherwise prepared) weights from the caller
-            self.cfg = gpt2.GPT2Config.preset(preset, **overrides)
+            # LoRA-merged (or otherwise prepared) weights from the caller.
+            # The architecture must describe THOSE weights: callers that
+            # derived them from a checkpoint-loaded base pass the base's
+            # resolved cfg (re-deriving from the preset would mismatch
+            # when the checkpoint's architecture differs — ADVICE r5).
+            self.cfg = (cfg_override if cfg_override is not None
+                        else gpt2.GPT2Config.preset(preset, **overrides))
             self.params = params_override
             self.checkpoint = checkpoint
         elif checkpoint:
@@ -504,7 +509,11 @@ class OpenAIServer(LLMServer):
         merged = apply_lora(self.engine.params, load_lora_npz(path))
         kwargs = dict(self._engine_kwargs)
         kwargs.pop("checkpoint", None)
-        eng = LLMEngine(params_override=merged, **kwargs)
+        # the merged params have the BASE engine's architecture (which may
+        # come from a checkpoint sidecar, not the preset): hand its
+        # resolved cfg over instead of re-deriving from the preset
+        eng = LLMEngine(params_override=merged,
+                        cfg_override=self.engine.cfg, **kwargs)
         while len(self._lora_engines) >= self.max_loras:
             _, old = self._lora_engines.popitem(last=False)
             old.shutdown()   # LRU eviction must stop the engine thread

@@ -5,16 +5,21 @@ checkpoints). Data plane: `spmd.py` compiles sharded train steps (the part
 the reference leaves to user code).
 """
 
-from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
-from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
-                                  ScalingConfig)
+from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,
+                                      is_sharded_checkpoint, load_sharded,
+                                      read_sharded_manifest, save_sharded)
+from ray_tpu.train.config import (CheckpointConfig, ElasticConfig,
+                                  FailureConfig, RunConfig, ScalingConfig)
 from ray_tpu.train.session import (get_context, get_dataset_shard, report)
 from ray_tpu.train.spmd import (
     CompiledTrain,
     TrainState,
     compile_gpt2_train,
     compile_train,
+    cross_worker_grad_sync,
     default_optimizer,
+    restore_state_sharded,
+    save_state_sharded,
 )
 from ray_tpu.train.torch_trainer import (TorchBackend, TorchTrainer,
                                          maybe_init_torch_distributed,
@@ -24,10 +29,14 @@ from ray_tpu.train.trainer import (DataParallelTrainer, JaxBackend, JaxTrainer,
                                    maybe_init_jax_distributed)
 
 __all__ = [
-    "Checkpoint", "CheckpointManager", "CheckpointConfig", "FailureConfig",
+    "Checkpoint", "CheckpointManager", "CheckpointConfig", "ElasticConfig",
+    "FailureConfig",
     "RunConfig", "ScalingConfig", "get_context", "get_dataset_shard",
     "report", "CompiledTrain", "TrainState", "compile_gpt2_train",
-    "compile_train", "default_optimizer", "DataParallelTrainer", "JaxBackend",
+    "compile_train", "cross_worker_grad_sync", "default_optimizer",
+    "is_sharded_checkpoint", "load_sharded", "read_sharded_manifest",
+    "save_sharded", "save_state_sharded", "restore_state_sharded",
+    "DataParallelTrainer", "JaxBackend",
     "JaxTrainer", "Result", "TrainingFailedError", "TorchBackend",
     "TorchTrainer", "maybe_init_torch_distributed", "prepare_data_loader",
     "prepare_model",

@@ -55,6 +55,243 @@ class Checkpoint:
         return (Checkpoint, (self.path,))
 
 
+# ---------------------------------------------------------------------------
+# World-size-agnostic sharded checkpoints.
+#
+# Layout of a sharded checkpoint directory:
+#   manifest_p<process>.json   one per saving process
+#   shards_p<process>.npz      that process's chunks, keyed "<leaf>::<i>"
+#
+# The manifest records each parameter's GLOBAL shape/dtype plus, per chunk,
+# the global index window it covers — so a checkpoint saved at world size W
+# restores at any other world size: the reader gathers chunks into full
+# arrays (gather-on-restore) and the caller reshards them onto whatever
+# mesh the surviving capacity supports (train/spmd.py restore_state_sharded).
+# This is the portable-resharding half of the array-redistribution direction
+# in PAPERS.md, specialized to checkpoint round-trips.
+# ---------------------------------------------------------------------------
+
+SHARDED_FORMAT = "ray_tpu.sharded_ckpt.v1"
+
+
+def _leaf_key(key_path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in key_path)
+
+
+def _chunk_windows(arr) -> List[tuple]:
+    """[(index_window, numpy_chunk)] covering `arr`'s addressable data.
+
+    `index_window` is [[start, stop], ...] per dim in GLOBAL coordinates.
+    Replicated shards (several devices holding the same window) are
+    deduplicated; a plain numpy/unsharded array is one full-cover chunk.
+    """
+    import numpy as np
+
+    shards = getattr(arr, "addressable_shards", None)
+    shape = tuple(getattr(arr, "shape", np.shape(arr)))
+    if not shards:
+        return [([[0, s] for s in shape], np.asarray(arr))]
+    seen = {}
+    for shard in shards:
+        window = []
+        for dim, sl in enumerate(shard.index):
+            start = 0 if sl.start is None else int(sl.start)
+            stop = shape[dim] if sl.stop is None else int(sl.stop)
+            window.append([start, stop])
+        key = tuple((a, b) for a, b in window)
+        if key not in seen:
+            seen[key] = (window, np.asarray(shard.data))
+    return list(seen.values())
+
+
+def save_sharded(tree: Any, path: str, *, step: int = 0,
+                 world_size: int = 1, process_index: int = 0,
+                 extra: Optional[Dict[str, Any]] = None) -> str:
+    """Per-parameter save of a (possibly mesh-sharded) pytree.
+
+    Every process of a multi-host job calls this with its own
+    `process_index`; each writes only the chunks it can address, so no
+    host ever materializes another host's parameters. Single-process
+    callers (CI's virtual-device meshes) write the full set.
+    """
+    import jax
+    import numpy as np
+
+    _fs.makedirs(path)
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    params: Dict[str, Any] = {}
+    chunks: List[dict] = []
+    blobs: Dict[str, Any] = {}
+    for kp, leaf in leaves:
+        key = _leaf_key(kp)
+        arr_windows = _chunk_windows(leaf)
+        np_dtype = np.asarray(arr_windows[0][1]).dtype
+        params[key] = {"shape": list(np.shape(leaf)),
+                       "dtype": np_dtype.name}
+        for i, (window, data) in enumerate(arr_windows):
+            blob_key = f"{key}::{i}"
+            blobs[blob_key] = data
+            chunks.append({"leaf": key, "blob": blob_key, "index": window})
+    manifest = {"format": SHARDED_FORMAT, "step": int(step),
+                "world_size": int(world_size),
+                "process_index": int(process_index),
+                "params": params, "chunks": chunks, **(extra or {})}
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, **blobs)
+    with _fs.open(_fs.join(path, f"shards_p{process_index:05d}.npz"),
+                  "wb") as f:
+        f.write(buf.getvalue())
+    with _fs.open(_fs.join(path, f"manifest_p{process_index:05d}.json"),
+                  "w") as f:
+        json.dump(manifest, f)
+    return path
+
+
+def is_sharded_checkpoint(path: str) -> bool:
+    return _fs.exists(_fs.join(path, "manifest_p00000.json"))
+
+
+def _read_process_manifests(path: str) -> List[Dict[str, Any]]:
+    manifests = []
+    i = 0
+    while True:
+        mp = _fs.join(path, f"manifest_p{i:05d}.json")
+        if not _fs.exists(mp):
+            break
+        with _fs.open(mp, "r") as f:
+            manifests.append(json.load(f))
+        i += 1
+    if not manifests:
+        raise FileNotFoundError(f"no sharded-checkpoint manifest in {path}")
+    return manifests
+
+
+def read_sharded_manifest(path: str) -> Dict[str, Any]:
+    """The merged view across all saving processes (their global sections
+    are identical; chunk lists concatenate)."""
+    manifests = _read_process_manifests(path)
+    merged = dict(manifests[0])
+    merged["chunks"] = [c for m in manifests for c in m["chunks"]]
+    merged["num_save_processes"] = len(manifests)
+    return merged
+
+
+def load_sharded(path: str) -> tuple:
+    """Gather-on-restore: returns ({leaf_key: np.ndarray}, manifest) with
+    every parameter assembled to its GLOBAL shape, regardless of the
+    world size / mesh it was saved under."""
+    import numpy as np
+
+    import io
+
+    manifests = _read_process_manifests(path)
+    manifest = dict(manifests[0])
+    manifest["chunks"] = [c for m in manifests for c in m["chunks"]]
+    manifest["num_save_processes"] = len(manifests)
+    out: Dict[str, Any] = {}
+    windows: Dict[str, set] = {}   # leaf -> distinct index windows written
+    for p, proc_manifest in enumerate(manifests):
+        with _fs.open(_fs.join(path, f"shards_p{p:05d}.npz"), "rb") as f:
+            blob = io.BytesIO(f.read())
+        with np.load(blob) as z:
+            # ONLY this process's chunk list: blob keys ("<leaf>::<i>")
+            # repeat across processes, so matching the merged list against
+            # z.files would write one process's data into every process's
+            # windows
+            for chunk in proc_manifest["chunks"]:
+                if chunk["blob"] not in z.files:
+                    raise ValueError(
+                        f"shards_p{p:05d}.npz is missing {chunk['blob']} "
+                        f"declared by its manifest")
+                key = chunk["leaf"]
+                spec = manifest["params"][key]
+                if key not in out:
+                    out[key] = np.empty(tuple(spec["shape"]),
+                                        dtype=_np_dtype(spec["dtype"]))
+                    windows[key] = set()
+                window = tuple(slice(a, b) for a, b in chunk["index"])
+                data = z[chunk["blob"]]
+                if out[key][window].shape != data.shape:
+                    raise ValueError(
+                        f"chunk {chunk['blob']}: window {chunk['index']} "
+                        f"does not match data shape {data.shape}")
+                # replicated windows may arrive from several processes;
+                # last write wins (bitwise-identical by contract)
+                out[key][window] = data
+                windows[key].add(tuple((a, b) for a, b in chunk["index"]))
+    for key, spec in manifest["params"].items():
+        if key not in out or not _windows_cover(windows[key],
+                                                tuple(spec["shape"])):
+            raise ValueError(
+                f"sharded checkpoint {path} is missing data for {key!r} "
+                f"(windows {sorted(windows.get(key, ()))} do not cover "
+                f"shape {spec['shape']})")
+    return out, manifest
+
+
+def _windows_cover(windows: set, shape: tuple) -> bool:
+    """Whether axis-aligned index windows jointly cover `shape`, without
+    materializing a per-element mask (restore-time memory matters: the
+    gathered params already cost O(model size)). Full-cover and
+    disjoint-tile layouts — everything real shardings produce — resolve
+    by volume bookkeeping; genuinely overlapping partial windows fall
+    back to a coordinate-grid check over the distinct boundaries."""
+    import math
+
+    total = math.prod(shape) if shape else 1
+    if not shape:
+        return bool(windows)
+    full = tuple((0, s) for s in shape)
+    if full in windows:
+        return True
+
+    def volume(w):
+        return math.prod(b - a for a, b in w)
+
+    def overlaps(w1, w2):
+        return all(a1 < b2 and a2 < b1
+                   for (a1, b1), (a2, b2) in zip(w1, w2))
+
+    wins = sorted(windows)
+    disjoint = all(not overlaps(wins[i], wins[j])
+                   for i in range(len(wins)) for j in range(i + 1, len(wins)))
+    if disjoint:
+        return sum(volume(w) for w in wins) >= total
+    # overlapping partial windows: exact cover via the boundary grid —
+    # every grid cell (product of distinct per-axis intervals) must fall
+    # inside some window. Grid size is O(prod windows-per-axis), tiny
+    # next to element counts.
+    axes_cuts = []
+    for dim, size in enumerate(shape):
+        cuts = {0, size}
+        for w in wins:
+            cuts.update(w[dim])
+        axes_cuts.append(sorted(cuts))
+    from itertools import product as _product
+
+    for cell in _product(*([(lo, hi) for lo, hi in zip(cs, cs[1:])]
+                           for cs in axes_cuts)):
+        if not any(all(a <= lo and hi <= b
+                       for (lo, hi), (a, b) in zip(cell, w))
+                   for w in wins):
+            return False
+    return True
+
+
+def _np_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 class CheckpointManager:
     """Persists reported checkpoints under storage_path, keeps top-K."""
 

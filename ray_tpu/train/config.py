@@ -13,6 +13,39 @@ from typing import Dict, Optional
 
 
 @dataclasses.dataclass
+class ElasticConfig:
+    """Elastic fault-tolerance policy for a worker group with an elastic
+    range (`min_workers` < `num_workers`).
+
+    The controller subscribes to the head's death-event plane
+    (actor_state / node_state pubsub, the push side of the flight
+    recorder's lease-event stream) so a daemon or worker kill interrupts
+    the run in event time, not at the next poll timeout; the group is
+    fenced by the cluster epoch + a per-start generation, reshaped to
+    the surviving capacity, restored from the latest (resharding-capable)
+    checkpoint, and — once capacity returns — grown back to
+    `num_workers` at the next checkpoint boundary.
+    """
+
+    # how long a restart may wait for min_workers' worth of resources to
+    # appear before giving up to the normal failure path
+    schedule_wait_s: float = 60.0
+    # capacity-watcher cadence while running below num_workers
+    scale_up_check_interval_s: float = 2.0
+    # after a graceful-stop (resize) request, how long workers get to
+    # reach their next checkpoint boundary before being restarted anyway
+    resize_grace_s: float = 60.0
+    # grow back to num_workers at the next checkpoint boundary when the
+    # cluster regains capacity (False: finish the run at reduced size)
+    regrow: bool = True
+    # fenced restarts (cluster-epoch changed under the group — e.g. a
+    # head restart invalidated the grants it ran under) allowed before
+    # erroring; these are environmental, not training failures, so they
+    # have their own budget separate from FailureConfig.max_failures
+    max_fenced_restarts: int = 5
+
+
+@dataclasses.dataclass
 class ScalingConfig:
     """How many workers and what each one holds.
 
@@ -34,6 +67,15 @@ class ScalingConfig:
     chips_per_worker: Optional[int] = None  # default: all chips of a host
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # elastic policy knobs; defaults apply whenever min_workers is set
+    elastic: Optional[ElasticConfig] = None
+
+    def elastic_config(self) -> ElasticConfig:
+        return self.elastic or ElasticConfig()
+
+    @property
+    def is_elastic(self) -> bool:
+        return bool(self.min_workers) and self.min_workers < self.num_workers
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -18,13 +18,16 @@ from ray_tpu.train.checkpoint import Checkpoint
 class TrainContext:
     def __init__(self, rank: int, world_size: int, local_rank: int = 0,
                  node_rank: int = 0, resume_checkpoint: Optional[Checkpoint] = None,
-                 dataset_shards: Optional[dict] = None):
+                 dataset_shards: Optional[dict] = None, generation: int = 0):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
         self.node_rank = node_rank
         self.resume_checkpoint = resume_checkpoint
         self.dataset_shards = dataset_shards or {}
+        # which (re)start of the run this gang belongs to — elastic loops
+        # use it to scope collective-group names per membership change
+        self.generation = generation
         self.reports: List[Dict[str, Any]] = []
         self.lock = threading.Lock()
         self.stop_requested = False
@@ -41,6 +44,16 @@ class TrainContext:
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.resume_checkpoint
+
+    def get_generation(self) -> int:
+        return self.generation
+
+    def should_stop(self) -> bool:
+        """True once the controller has requested a graceful stop (elastic
+        resize at the next checkpoint boundary). Loops that checkpoint on
+        their own cadence can consult this to checkpoint NOW instead of
+        waiting for `report` to raise."""
+        return self.stop_requested
 
 
 _ctx = threading.local()

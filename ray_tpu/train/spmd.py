@@ -91,6 +91,11 @@ class CompiledTrain:
     step_fn: Callable[[TrainState, Any], tuple]       # (state, batch) -> (state, metrics)
     batch_sharding: Any
     state_sharding: Any
+    # split step for cross-worker DDP: grads leave the jit boundary so the
+    # gang can average them host-side (cross_worker_grad_sync) between the
+    # two calls; in-mesh training uses the fused step_fn
+    grad_fn: Optional[Callable[[TrainState, Any], tuple]] = None
+    apply_fn: Optional[Callable[[TrainState, Any], TrainState]] = None
 
 
 def compile_train(
@@ -140,8 +145,129 @@ def compile_train(
         out_shardings=(state_sharding, NamedSharding(mesh, P())),
         donate_argnums=(0,),
     )
+
+    rep = NamedSharding(mesh, P())
+
+    def _grads(state: TrainState, batch):
+        with mesh_lib.use_mesh(mesh, rules):
+            return jax.value_and_grad(loss_fn)(state.params, batch)
+
+    grad_fn = jax.jit(
+        _grads,
+        in_shardings=(state_sharding, batch_sharding),
+        out_shardings=(rep, state_sharding.params),
+    )
+
+    def _apply(state: TrainState, grads):
+        with mesh_lib.use_mesh(mesh, rules):
+            updates, opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(state.step + 1, params, opt_state)
+
+    apply_fn = jax.jit(
+        _apply,
+        in_shardings=(state_sharding, state_sharding.params),
+        out_shardings=state_sharding,
+        donate_argnums=(0,),
+    )
     return CompiledTrain(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
-                         batch_sharding=batch_sharding, state_sharding=state_sharding)
+                         batch_sharding=batch_sharding,
+                         state_sharding=state_sharding,
+                         grad_fn=grad_fn, apply_fn=apply_fn)
+
+
+# ---------------------------------------------------------------------------
+# World-size-agnostic state checkpoints (elastic fault tolerance).
+#
+# save: every process writes the chunks it can address, with global index
+# windows in the manifest (train/checkpoint.py save_sharded). restore:
+# gather-on-restore assembles full arrays and device_puts them under the
+# NEW mesh's shardings — a checkpoint saved at world size 4 restores at 2,
+# 1, or back at 4, bitwise-identically after gather.
+# ---------------------------------------------------------------------------
+
+def _state_as_tree(state: TrainState) -> dict:
+    # dict wrapper so manifest leaf keys are stable path strings
+    # ("params/wte", "opt_state/1/0/mu/...") rather than flatten indices
+    return {"step": state.step, "params": state.params,
+            "opt_state": state.opt_state}
+
+
+def save_state_sharded(state: TrainState, path: str, *,
+                       world_size: int = 1, process_index: int = 0) -> str:
+    from ray_tpu.train import checkpoint as ckpt_lib
+
+    return ckpt_lib.save_sharded(
+        _state_as_tree(state), path,
+        step=int(jax.device_get(state.step)),
+        world_size=world_size, process_index=process_index)
+
+
+def restore_state_sharded(path: str, compiled: CompiledTrain) -> TrainState:
+    """Restore a `save_state_sharded` checkpoint onto `compiled`'s mesh.
+
+    The target mesh may have a different shape / device count than the
+    save-time mesh: arrays are gathered to global form on the host, then
+    resharded by `compiled.state_sharding`.
+    """
+    from ray_tpu.train import checkpoint as ckpt_lib
+
+    flat, _ = ckpt_lib.load_sharded(path)
+    state_shape = jax.eval_shape(compiled.init_fn, jax.random.key(0))
+    template = jax.tree_util.tree_flatten_with_path(
+        _state_as_tree(state_shape))[0]
+    shard_leaves = {ckpt_lib._leaf_key(kp): leaf for kp, leaf in
+                    jax.tree_util.tree_flatten_with_path(
+                        _state_as_tree(compiled.state_sharding),
+                        is_leaf=lambda x: isinstance(x, NamedSharding))[0]}
+    restored = []
+    for kp, leaf in template:
+        key = ckpt_lib._leaf_key(kp)
+        if key not in flat:
+            raise KeyError(f"checkpoint {path} has no leaf {key!r}")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(f"leaf {key}: checkpoint shape {arr.shape} "
+                             f"!= program shape {leaf.shape}")
+        restored.append(jax.device_put(arr.astype(leaf.dtype),
+                                       shard_leaves[key]))
+    treedef = jax.tree_util.tree_structure(_state_as_tree(state_shape))
+    tree = jax.tree_util.tree_unflatten(treedef, restored)
+    return TrainState(step=tree["step"], params=tree["params"],
+                      opt_state=tree["opt_state"])
+
+
+def cross_worker_grad_sync(grads: Any, group_name: str, world_size: int,
+                           timeout: float = 60.0) -> Any:
+    """Average a gradient pytree across the worker gang (elastic DDP).
+
+    XLA meshes allreduce in-program over ICI; ACROSS worker processes the
+    gang uses the kv collective backend. One fused allreduce per step:
+    leaves are flattened into a single buffer so the rendezvous cost is
+    O(1) per step, not O(n_leaves). No-op at world size 1. `group_name`
+    should carry the group generation (e.g. "ddp:g3") so a rebuilt gang
+    never collides with a fenced predecessor's rendezvous keys.
+    """
+    if world_size <= 1:
+        return grads
+    import numpy as np
+
+    from ray_tpu.util import collective
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    arrs = [np.asarray(leaf) for leaf in leaves]
+    fused = np.concatenate([a.ravel().astype(np.float32) for a in arrs])
+    group = collective.get_group(group_name)
+    group.allreduce(fused, timeout=timeout)
+    fused /= world_size
+    out, offset = [], 0
+    for a, leaf in zip(arrs, leaves):
+        out.append(jnp.asarray(
+            fused[offset:offset + a.size].reshape(a.shape),
+            dtype=leaf.dtype))
+        offset += a.size
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def compile_model_train(model_mod, cfg, mesh: Mesh, optimizer=None,

@@ -26,6 +26,11 @@ class Result:
     path: Optional[str]
     error: Optional[str]
     restarts: int = 0
+    # elastic lifecycle counters: graceful grow-back restarts and
+    # epoch-fence restarts (neither consumes the failure budget)
+    resizes: int = 0
+    fenced_restarts: int = 0
+    final_world_size: Optional[int] = None
 
     @property
     def best_checkpoints(self) -> List[Checkpoint]:
@@ -126,6 +131,9 @@ class DataParallelTrainer:
             path=out["storage_path"],
             error=out["error"],
             restarts=out["restarts"],
+            resizes=out.get("resizes", 0),
+            fenced_restarts=out.get("fenced_restarts", 0),
+            final_world_size=out.get("final_world_size"),
         )
         if out["state"] == "ERRORED":
             raise TrainingFailedError(out["error"])

@@ -30,16 +30,19 @@ class TrainWorker:
 
     def setup_and_start(self, train_fn, train_config, rank, world_size,
                         local_rank, node_rank, resume_checkpoint_path,
-                        backend_env: Optional[Dict[str, str]] = None):
+                        backend_env: Optional[Dict[str, str]] = None,
+                        generation: int = 0):
         import os
 
         if backend_env:
             os.environ.update(backend_env)
         resume = (Checkpoint(resume_checkpoint_path)
                   if resume_checkpoint_path else None)
+        self._generation = generation
         self._ctx = session_lib.TrainContext(
             rank=rank, world_size=world_size, local_rank=local_rank,
-            node_rank=node_rank, resume_checkpoint=resume)
+            node_rank=node_rank, resume_checkpoint=resume,
+            generation=generation)
 
         def _run():
             session_lib._set_context(self._ctx)
@@ -62,11 +65,14 @@ class TrainWorker:
         return True
 
     def poll(self):
-        """Drain new reports; reference worker_group.poll_status :488."""
+        """Drain new reports; reference worker_group.poll_status :488.
+        Reports carry the group generation so a fenced group's late
+        reports are distinguishable from the live gang's."""
         with self._ctx.lock:
             reports = self._ctx.reports
             self._ctx.reports = []
-        return {"reports": reports, "done": self._done, "error": self._error}
+        return {"reports": reports, "done": self._done, "error": self._error,
+                "generation": getattr(self, "_generation", 0)}
 
     def request_stop(self):
         if self._ctx is not None:
@@ -114,14 +120,27 @@ class TrainWorker:
 
 
 class WorkerGroup:
-    """Creates and tracks the gang of TrainWorker actors."""
+    """Creates and tracks the gang of TrainWorker actors.
+
+    Each group carries a monotonically increasing `generation` (set by
+    the controller) — the train-level half of the fencing story: the
+    cluster epoch fences a group against control-plane restarts; the
+    generation scopes collective-group rendezvous names and tags every
+    polled status, so a zombie member of a killed gang can neither
+    rendezvous with its successor nor have its reports mistaken for the
+    live gang's (checkpoints only enter run storage via the controller
+    draining the group it currently polls).
+    """
 
     def __init__(self, scaling_config, label_selector: Optional[dict] = None,
-                 placement_group=None):
+                 placement_group=None, generation: int = 0):
         self.scaling = scaling_config
         self.label_selector = label_selector
         self.placement_group = placement_group
+        self.generation = generation
         self.workers: List[Any] = []
+        self.actor_ids: List[str] = []     # hex ids, index == rank
+        self.node_ids: List[str] = []      # hex node of each worker
 
     def start(self, train_fn: Callable, train_config: Any,
               resume_checkpoint: Optional[Checkpoint] = None,
@@ -136,6 +155,7 @@ class WorkerGroup:
         if self.scaling.placement_strategy in ("SPREAD", "STRICT_SPREAD"):
             opts["scheduling_strategy"] = "spread"
         self.workers = [TrainWorker.options(**opts).remote() for _ in range(n)]
+        self.actor_ids = [w._actor_id.hex() for w in self.workers]
         backend_envs = (backend.worker_envs(self) if backend is not None
                         else [{} for _ in range(n)])
         starts = []
@@ -143,11 +163,31 @@ class WorkerGroup:
             starts.append(w.setup_and_start.remote(
                 train_fn, train_config, rank, n, 0, rank,
                 resume_checkpoint.path if resume_checkpoint else None,
-                backend_envs[rank]))
+                backend_envs[rank], self.generation))
         ray_tpu.get(starts, timeout=120)
+        # node placement, recorded for the controller's death watch
+        # (a node_state DEAD event for any of these hosts fails the
+        # group immediately, without waiting for a poll RPC to time out)
+        self.node_ids = ray_tpu.get(
+            [w.node_id.remote() for w in self.workers], timeout=60)
 
     def poll(self) -> List[dict]:
         return ray_tpu.get([w.poll.remote() for w in self.workers], timeout=60)
+
+    def request_stop_all(self) -> None:
+        """Ask every worker to stop at its next report — the graceful
+        (checkpoint-boundary) half of an elastic resize. Best-effort:
+        a worker that died since the last poll is already stopping."""
+        refs = []
+        for w in self.workers:
+            try:
+                refs.append(w.request_stop.remote())
+            except Exception:
+                pass
+        try:
+            ray_tpu.get(refs, timeout=30)
+        except Exception:
+            pass
 
     def shutdown(self) -> None:
         for w in self.workers:

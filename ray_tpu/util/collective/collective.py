@@ -97,6 +97,45 @@ def _get_group(group_name: str) -> KVCollectiveGroup:
         return group
 
 
+def get_group(group_name: str = "default"):
+    """The live group object (attaching lazily if declared remotely) —
+    for callers that need backend-level knobs the module-level wrappers
+    don't expose (per-op timeouts, elastic rebuilds)."""
+    return _get_group(group_name)
+
+
+def rebuild_collective_group(world_size: int, rank: int, backend: str = "kv",
+                             group_name: str = "default") -> None:
+    """Tear down any existing local membership of `group_name` and re-init
+    at a NEW world size / rank — the membership-change path for elastic
+    training: after a gang shrinks or regrows, every surviving member
+    calls this with its new coordinates before the next collective.
+
+    Unlike `init_collective_group` this never raises on an existing
+    group; the previous incarnation's local state is destroyed first
+    (its rendezvous keys are garbage-collected by `destroy`). Callers
+    that rebuild across process *restarts* should put a generation tag
+    in `group_name` (e.g. "ddp:g3") so a zombie member of the fenced
+    gang can never rendezvous with the new one.
+    """
+    backend = Backend(backend)
+    # pop, destroy, and install under ONE lock hold: releasing between
+    # the pop and the install lets a concurrent rebuild/lazy-attach slip
+    # a group with DIFFERENT coordinates into the gap (the caller would
+    # then silently rendezvous with the wrong world_size/rank). destroy()
+    # runs inside the hold too so the old incarnation's key GC can't
+    # race the new group's first posts.
+    with _lock:
+        group = _groups.pop(group_name, None)
+        if group is not None:
+            try:
+                group.destroy()
+            except Exception:
+                pass
+        _groups[group_name] = _make_group(backend, group_name,
+                                          world_size, rank)
+
+
 def is_group_initialized(group_name: str = "default") -> bool:
     return group_name in _groups
 
@@ -170,6 +209,7 @@ def synchronize(device_or_group=None) -> None:
 
 __all__ = [
     "init_collective_group", "create_collective_group",
+    "rebuild_collective_group", "get_group",
     "destroy_collective_group", "is_group_initialized", "get_rank",
     "get_collective_group_size", "allreduce", "reduce", "broadcast",
     "allgather", "reducescatter", "barrier", "send", "recv", "synchronize",

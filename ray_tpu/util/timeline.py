@@ -11,6 +11,11 @@ lease-acquire[local|spillback|head] → dispatch → run as distinct
 sub-spans, with Chrome flow arrows (`s`/`f` events keyed by task id)
 connecting submit to the run slice — the two-level scheduler's warm path
 made visible per task.
+
+A `head-reconcile` row renders the head's reconciliation phases from the
+merged lease-event stream: node_dead→reregister/pool_reconcile windows,
+head_lost→head_reconnect outages, stale-epoch rejects, and the train
+controller's group_start/death_detected/restore/resize spans.
 """
 
 from __future__ import annotations
@@ -63,6 +68,70 @@ def _sched_phase_events(trace: List[dict]) -> None:
                       "ts": dst["t0"] * 1e6})
 
 
+def _reconcile_phase_events(trace: List[dict]) -> None:
+    """Head-side reconciliation phases from the merged flight-recorder
+    lease-event stream: epoch-fence / pool-reconcile windows and train
+    controller restarts become spans so 'why did the cluster pause here'
+    is answerable from the same trace as the task rows. Best-effort —
+    a head that predates these event kinds just contributes nothing."""
+    from ray_tpu.util.state import list_lease_events
+
+    try:
+        events = list_lease_events()
+    except Exception:
+        return
+    PID = "head-reconcile"
+    # windows opened by a loss event, closed by the matching recovery
+    open_windows = {}   # (kind_family, node_id) -> open event
+    pairs = {"node_dead": ("node_reregister", "pool_reconcile"),
+             "head_lost": ("head_reconnect",)}
+    closers = {c: fam for fam, cs in pairs.items() for c in cs}
+    for ev in events:
+        kind = ev.get("kind", "")
+        nid = (ev.get("node_id") or "")[:12]
+        if kind in pairs:
+            open_windows[(kind, nid)] = ev
+            continue
+        if kind in closers:
+            fam = closers[kind]
+            start = open_windows.pop((fam, nid), None)
+            if start is not None:
+                trace.append({
+                    "name": f"{fam}→{kind}", "cat": "reconcile", "ph": "X",
+                    "ts": start["ts"] * 1e6,
+                    "dur": max(ev["ts"] - start["ts"], 1e-7) * 1e6,
+                    "pid": PID, "tid": nid or "head",
+                    "args": {"node_id": ev.get("node_id")}})
+            continue
+        if kind.startswith("train_"):
+            t0, t1 = ev.get("t0"), ev.get("t1")
+            row = {"cat": "train", "pid": PID,
+                   "tid": f"train:{ev.get('run', '?')}",
+                   "args": {k: v for k, v in ev.items()
+                            if k not in ("t0", "t1") and v is not None}}
+            if t0 is not None and t1 is not None:
+                trace.append({**row, "name": kind, "ph": "X",
+                              "ts": t0 * 1e6,
+                              "dur": max(t1 - t0, 1e-7) * 1e6})
+            else:
+                trace.append({**row, "name": kind, "ph": "i",
+                              "ts": ev["ts"] * 1e6, "s": "t"})
+            continue
+        if kind == "stale_epoch":
+            trace.append({
+                "name": "stale_epoch", "cat": "reconcile", "ph": "i",
+                "ts": ev["ts"] * 1e6, "s": "t", "pid": PID,
+                "tid": nid or "head",
+                "args": {"method": ev.get("method"),
+                         "epoch": ev.get("epoch")}})
+    # still-open windows (node died, never came back): begin events
+    for (fam, nid), start in open_windows.items():
+        trace.append({"name": fam, "cat": "reconcile", "ph": "B",
+                      "ts": start["ts"] * 1e6, "pid": PID,
+                      "tid": nid or "head",
+                      "args": {"node_id": start.get("node_id")}})
+
+
 def timeline(filename: Optional[str] = None) -> List[dict]:
     """Build Chrome trace events; write to `filename` if given."""
     from ray_tpu.util.state import list_task_events
@@ -99,6 +168,7 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
                       "tid": start["worker_id"] or "worker",
                       "args": {"task_id": task_id}})
     _sched_phase_events(trace)
+    _reconcile_phase_events(trace)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -152,3 +152,36 @@ def test_autoscaler_loop_with_k8s_provider():
     assert kinds.count("cpu_worker") == 2 and kinds.count("tpu_worker") == 1
     prov.shutdown()
     assert not fake.pods
+
+
+def test_terminal_pods_deleted_on_reconcile():
+    """ADVICE r5 regression: restartPolicy=Never pods that reach
+    Succeeded/Failed must be DELETED during reconciliation (best-effort),
+    not just dropped from tracking — otherwise terminal pods accumulate
+    in the namespace forever as the autoscaler replaces them."""
+    fake = FakeK8s()
+    prov = make_provider(fake)
+    a = prov.create_node("cpu_worker")
+    b = prov.create_node("cpu_worker")
+    fake.pods[a]["status"]["phase"] = "Failed"
+    fake.pods[b]["status"]["phase"] = "Succeeded"
+    assert prov.non_terminated_nodes() == []
+    # both terminal pods were deleted from the API server, not leaked
+    assert a not in fake.pods and b not in fake.pods
+    # a DELETE failure stays best-effort: reconcile doesn't raise and the
+    # pod is retried on the next pass
+    c = prov.create_node("cpu_worker")
+    fake.pods[c]["status"]["phase"] = "Failed"
+    real = fake.__call__
+
+    def flaky(method, path, body):
+        if method == "DELETE":
+            return 500, {"error": "boom"}
+        return real(method, path, body)
+
+    prov.api.request_fn = flaky
+    assert prov.non_terminated_nodes() == []
+    assert c in fake.pods          # delete failed, pod still there
+    prov.api.request_fn = real
+    prov.non_terminated_nodes()    # next pass lists it again and retries
+    assert c not in fake.pods

@@ -32,3 +32,28 @@ def test_logical_to_spec_rules(devices8):
 def test_constrain_noop_without_mesh():
     x = jax.numpy.ones((4, 4))
     assert constrain(x, "batch", "embed") is x
+
+
+def test_adaptive_mesh_config_shrinks_and_regrows():
+    """Elastic mesh reshape (SNIPPETS create_adaptive_mesh pattern): data
+    axes shrink toward the surviving device count and grow back on
+    rejoin; model-parallel axes are never resized."""
+    from ray_tpu.parallel.mesh import adaptive_mesh_config
+
+    import pytest as _pytest
+
+    # shrink: dp halves toward what fits alongside fixed tp
+    assert adaptive_mesh_config(MeshConfig(dp=4, tp=2), 8).dp == 4
+    assert adaptive_mesh_config(MeshConfig(dp=4, tp=2), 4).dp == 2
+    assert adaptive_mesh_config(MeshConfig(dp=4, tp=2), 2).dp == 1
+    # innermost data axis (fsdp) gives way first
+    got = adaptive_mesh_config(MeshConfig(dp=2, fsdp=2, tp=2), 4)
+    assert (got.dp, got.fsdp) == (2, 1)
+    # grow-back absorbs returned capacity, never past the request
+    assert adaptive_mesh_config(MeshConfig(dp=4, tp=2), 16).dp == 4
+    # odd survivor counts floor to a usable subset, not a hard error
+    odd = adaptive_mesh_config(MeshConfig(dp=2, tp=2), 3)
+    assert (odd.dp, odd.tp) == (1, 2)
+    # model-parallel axes that no longer fit are a hard error
+    with _pytest.raises(ValueError):
+        adaptive_mesh_config(MeshConfig(dp=2, tp=4), 2)

@@ -420,3 +420,38 @@ def test_core_metrics_exported(cluster):
         assert series in body, f"missing {series}\n{body[:800]}"
     assert 'state="ALIVE"' in body
     ray_tpu.kill(h)
+
+
+def test_timeline_reconcile_and_train_phases(cluster, tmp_path):
+    """`ray_tpu.timeline()` renders head-side reconciliation phases from
+    the merged lease-event stream: train controller lifecycle spans (via
+    the train_event RPC) and epoch/reconcile markers land on the
+    head-reconcile row."""
+    client = ray_tpu.core.api._global_client()
+    t0 = time.time()
+    # a span-shaped phase (t0/t1) and an instant one, as the controller
+    # emits them
+    assert client.head_request(
+        "train_event", run="tl-run", phase="group_start",
+        t0=t0, t1=t0 + 0.25,
+        detail={"world": 2, "generation": 0}) is True
+    assert client.head_request(
+        "train_event", run="tl-run", phase="death_detected",
+        detail={"cause": "drill"}) is True
+    out = tmp_path / "trace.json"
+    events = ray_tpu.timeline(str(out))
+    train_rows = [e for e in events if e.get("cat") == "train"]
+    assert {e["name"] for e in train_rows} >= {"train_group_start",
+                                               "train_death_detected"}
+    span = next(e for e in train_rows if e["name"] == "train_group_start")
+    assert span["ph"] == "X" and span["pid"] == "head-reconcile"
+    assert span["args"]["world"] == 2
+    assert abs(span["dur"] - 0.25e6) < 1e3
+    inst = next(e for e in train_rows if e["name"] == "train_death_detected")
+    assert inst["ph"] == "i" and inst["args"]["cause"] == "drill"
+    # the events also surface through the state API (flight recorder)
+    from ray_tpu.util import state
+
+    kinds = {e["kind"] for e in state.list_lease_events()}
+    assert {"train_group_start", "train_death_detected"} <= kinds
+    assert json.load(open(out))

@@ -341,3 +341,53 @@ def test_kv_transfer_prefill_to_decode():
         prefill.shutdown()
         decode.shutdown()
         ref_eng.shutdown()
+
+
+def test_lora_engine_inherits_checkpoint_architecture(tmp_path):
+    """ADVICE r5 regression: when the BASE engine's architecture comes
+    from a checkpoint sidecar (not the preset), per-adapter LoRA engines
+    must be built from the base engine's RESOLVED config — re-deriving
+    from the preset would hand the merged (checkpoint-shaped) params to
+    a preset-shaped decode program."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.serve.llm import OpenAIServer
+    from ray_tpu.utils.platform import ensure_virtual_cpu
+
+    ensure_virtual_cpu(1)
+    # checkpoint architecture deliberately differs from the gpt2-tiny
+    # preset (n_layer 3 vs 2, d_model 64 vs 128)
+    cfg = gpt2.GPT2Config.preset("gpt2-tiny", n_layer=3, n_head=4,
+                                 d_model=64, d_ff=256, max_seq_len=96)
+    params = gpt2.init_params(jax.random.key(0), cfg)
+    ckpt = str(tmp_path / "ckpt")
+    gpt2.save_params(ckpt, params, cfg)
+    rng = np.random.default_rng(0)
+    L, D = cfg.n_layer, cfg.d_model
+    np.savez(str(tmp_path / "ad.npz"), **{
+        "blocks.attn.wqkv.A": rng.normal(size=(L, D, 4)).astype(np.float32),
+        "blocks.attn.wqkv.B": rng.normal(size=(L, 4, 3 * D)).astype(np.float32),
+    })
+    srv = OpenAIServer(model_id="tiny", lora_root=str(tmp_path),
+                       max_loras=2, preset="gpt2-tiny", max_batch=2,
+                       max_seq_len=96, checkpoint=ckpt,
+                       enable_prefix_caching=False)
+    try:
+        assert srv.engine.cfg.n_layer == 3      # sidecar won
+        body = {"prompt": "hello world", "max_tokens": 4,
+                "temperature": 0.0, "model": "tiny:ad"}
+        out = srv(body)                          # must not shape-error
+        assert out["usage"]["completion_tokens"] == 4
+        eng = srv._lora_engines["ad"]
+        # the adapter engine's architecture is the base's resolved one
+        assert eng.cfg == srv.engine.cfg
+        assert eng.params["blocks"]["attn"]["wqkv"].shape == \
+            srv.engine.params["blocks"]["attn"]["wqkv"].shape
+    finally:
+        srv.engine.shutdown()
+        for e in srv._lora_engines.values():
+            e.shutdown()

@@ -1,0 +1,31 @@
+"""CI wrapper for the chaos soak entrypoint (benchmarks/soak.py).
+
+Marked `slow` (excluded from the tier-1 budget) — the soak is the
+long-running belt-and-braces drill; the fast per-feature coverage lives
+in test_chaos.py / test_train_e2e.py. Kept short here: one warm-burst
+round and one elastic-train drill with the fixed default seed, exactly
+what `python benchmarks/soak.py` runs, so CI exercises the same
+single-command path an operator would.
+"""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+
+def test_soak_single_command(tmp_path):
+    import soak
+
+    out = str(tmp_path / "soak.json")
+    report = soak.main(seed=7, out=out, rounds=2, steps=18)
+    assert report["warm_burst"]["tasks_completed"] == 2 * 40
+    assert report["elastic_train"]["final_world_size"] == 1
+    assert report["elastic_train"]["restarts"] >= 1
+    assert report["elastic_train"]["recovery_s"] > 0
+    assert os.path.exists(out)
