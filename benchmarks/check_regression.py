@@ -1,15 +1,21 @@
-"""Control-plane performance gate.
+"""Control/data-plane performance gate.
 
-Compares a fresh run (or a provided JSON) of the control-plane
-microbenchmark rows against the checked-in artifact
-`benchmarks/control_plane_microbench.json` and FAILS (exit 1) if any row
+Compares a fresh run (or a provided JSON) of a microbenchmark suite's
+rows against its checked-in artifact and FAILS (exit 1) if any row
 dropped more than the tolerance (default 10%; rows suffixed `_s` are
 seconds and gate in the opposite direction — they fail when the time
 RISES past tolerance) — the CI guard that keeps the two-level-scheduler
-hot paths and the elastic-train recovery drill from silently regressing.
+hot paths, the elastic-train recovery drill, and the peer-to-peer data
+plane from silently regressing.
+
+Suites:
+  control (default) — benchmarks/control_plane_microbench.json
+  data              — benchmarks/data_plane_microbench.json
+                      (p2p_pull_mb_s, head_restart_large_object_recovery_s)
 
 Usage:
   python benchmarks/check_regression.py                # runs the bench
+  python benchmarks/check_regression.py --suite data
   python benchmarks/check_regression.py --current run.json
   python benchmarks/check_regression.py --tolerance 0.15
 """
@@ -25,7 +31,13 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, HERE)
 sys.path.insert(0, os.path.dirname(HERE))
 
-DEFAULT_BASELINE = os.path.join(HERE, "control_plane_microbench.json")
+SUITES = {
+    "control": {"baseline": "control_plane_microbench.json",
+                "runner": "control_plane"},
+    "data": {"baseline": "data_plane_microbench.json",
+             "runner": "data_plane"},
+}
+DEFAULT_BASELINE = os.path.join(HERE, SUITES["control"]["baseline"])
 
 
 def compare(baseline: dict, current: dict, tolerance: float) -> list[str]:
@@ -36,7 +48,9 @@ def compare(baseline: dict, current: dict, tolerance: float) -> list[str]:
             failures.append(f"{name}: missing from current run")
             continue
         delta = cur_val / base_val - 1.0
-        if name.endswith("_s") and not name.endswith("_per_s"):
+        # `_per_s` / `_mb_s` are RATES (higher is better) despite the _s
+        # suffix; bare `_s` rows are durations (lower is better)
+        if name.endswith("_s") and not name.endswith(("_per_s", "_mb_s")):
             # seconds rows (recovery/latency) are LOWER-is-better: the
             # gate fails when the time RISES past the tolerance ceiling
             ceiling = base_val * (1.0 + tolerance)
@@ -62,8 +76,11 @@ def compare(baseline: dict, current: dict, tolerance: float) -> list[str]:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
-                    help="committed artifact to compare against")
+    ap.add_argument("--suite", choices=sorted(SUITES), default="control",
+                    help="which gate suite to run (default: control)")
+    ap.add_argument("--baseline", default=None,
+                    help="committed artifact to compare against "
+                         "(default: the suite's artifact)")
     ap.add_argument("--current", default=None,
                     help="JSON of a finished run; omit to run the "
                          "benchmark now")
@@ -73,15 +90,18 @@ def main() -> int:
                     help="also write the fresh run's JSON here")
     args = ap.parse_args()
 
-    with open(args.baseline) as f:
+    suite = SUITES[args.suite]
+    baseline_path = args.baseline or os.path.join(HERE, suite["baseline"])
+    with open(baseline_path) as f:
         baseline = json.load(f)["metrics"]
     if args.current:
         with open(args.current) as f:
             current = json.load(f)["metrics"]
     else:
-        from microbenchmark import control_plane
+        import microbenchmark
 
-        current = control_plane(args.out)["metrics"]
+        current = getattr(microbenchmark, suite["runner"])(
+            args.out)["metrics"]
 
     failures = compare(baseline, current, args.tolerance)
     if failures:

@@ -314,6 +314,114 @@ def train_ft_metric() -> float:
     return out["recovery_s"]
 
 
+def data_plane(out_path: str | None = None) -> dict:
+    """Peer-to-peer data-plane gate rows (store isolation forces real
+    cross-node transfers on one machine):
+
+      p2p_pull_mb_s — MB/s of a driver pull of a 48 MiB object produced
+      on an isolated worker node, resolved via the gossiped object
+      directory (warm view, zero head RPCs on the pull path);
+
+      head_restart_large_object_recovery_s — SIGKILL the head while an
+      8 MiB shm object lives on a worker node, restart on the same port,
+      wipe every driver-side cache, and measure restart → successful
+      get(): covers daemon reconnect, the reconcile handshake
+      re-advertising the node's object inventory, the head directory
+      rebuild, and the peer-to-peer pull. Seconds, lower is better.
+    """
+    import numpy as np
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    saved = os.environ.get("RAY_TPU_STORE_ISOLATION")
+    os.environ["RAY_TPU_STORE_ISOLATION"] = "1"
+    cluster = Cluster(num_cpus=0, enable_snapshots=True)
+    cluster.add_node(num_cpus=2, resources={"nodeA": 4})
+    cluster.add_node(num_cpus=2, resources={"nodeB": 4})
+    results = {}
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+        client = ray_tpu.core.api._global_client()
+
+        @ray_tpu.remote
+        def make(mb, seed):
+            rng = np.random.default_rng(seed)
+            return rng.integers(0, 255, size=(mb * 1024 * 1024,),
+                                dtype=np.uint8)
+
+        def wait_warm(oid, timeout=30):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                locs = client.object_dir.locations(oid)
+                if locs and any(client.cluster_view.data_addr_of(h)
+                                for h in locs):
+                    return
+                time.sleep(0.05)
+            raise AssertionError("object directory never warmed")
+
+        phase("p2p_pull_mb_s")
+        mb = 48
+        rates = []
+        for i in range(3):
+            ref = make.options(resources={"nodeA": 1}).remote(mb, i)
+            ray_tpu.wait([ref], num_returns=1, timeout=120)
+            wait_warm(ref.id)
+            t0 = time.perf_counter()
+            arr = ray_tpu.get(ref, timeout=180)
+            rates.append(mb / (time.perf_counter() - t0))
+            assert arr.nbytes == mb * 1024 * 1024
+            del arr
+            ray_tpu.free([ref])
+        results["p2p_pull_mb_s"] = float(np.mean(rates))
+
+        phase("head_restart_large_object_recovery_s")
+        ref = make.options(resources={"nodeA": 1}).remote(8, 99)
+        ray_tpu.wait([ref], num_returns=1, timeout=120)
+        wait_warm(ref.id)
+        cluster.kill_head()
+        t0 = time.perf_counter()
+        cluster.restart_head(restore=True)
+        # wipe EVERY driver-side shortcut so recovery measures the real
+        # rebuild: daemon reconnect + inventory re-advertisement + head
+        # directory + P2P pull, not a cache hit
+        client._drop_pulled(ref.id)
+        client.local_metas.pop(ref.id, None)
+        client.object_dir.entries.pop(ref.id, None)
+        deadline = time.time() + 120
+        arr = None
+        while time.time() < deadline:
+            try:
+                arr = ray_tpu.get(ref, timeout=10)
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert arr is not None and arr.nbytes == 8 * 1024 * 1024, \
+            "large object never recovered after head restart"
+        results["head_restart_large_object_recovery_s"] = (
+            time.perf_counter() - t0)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        if saved is None:
+            os.environ.pop("RAY_TPU_STORE_ISOLATION", None)
+        else:
+            os.environ["RAY_TPU_STORE_ISOLATION"] = saved
+    report = {"metrics": {k: round(v, 2) for k, v in results.items()},
+              "unit": "p2p_pull_mb_s: MB/s (higher better); "
+                      "*_s rows: seconds (lower better)",
+              "host": {"cpus": os.cpu_count()}}
+    print(json.dumps(report, indent=2))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    return report
+
+
 def control_plane(out_path: str | None = None) -> dict:
     """Just the single-stream control-plane rows (the reference-parity
     gate): emitted as a small JSON artifact that `check_regression.py`
@@ -716,11 +824,17 @@ if __name__ == "__main__":
     p.add_argument("--control-plane", action="store_true",
                    help="run only the control-plane gate rows and emit "
                         "the regression artifact")
+    p.add_argument("--data-plane", action="store_true",
+                   help="run only the peer-to-peer data-plane gate rows "
+                        "(p2p_pull_mb_s, head_restart_large_object_"
+                        "recovery_s) and emit the regression artifact")
     p.add_argument("--train-ft", action="store_true",
                    help="run only the elastic-train recovery drill and "
                         "print its recovery time")
     args = p.parse_args()
-    if args.train_ft:
+    if args.data_plane:
+        data_plane(args.out)
+    elif args.train_ft:
         recovery = train_ft_metric()
         report = {"metrics": {"elastic_train_recovery_s": round(recovery, 2)},
                   "unit": "seconds (lower is better)",

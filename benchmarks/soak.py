@@ -9,7 +9,13 @@ FIXED seed, so a failure replays identically:
   all complete (the two-level warm path absorbs injected gossip delay and
   duplicated frames without dropping work).
 
-  phase 2 — elastic-train drill: a 2-worker GPT-2-DDP run
+  phase 2 — large-object data plane: an isolation-mode 2-node cluster
+  where the consumer node's processes run a seeded drop plan on their
+  data edges; workers repeatedly consume large remote objects, so every
+  round exercises the daemon pull manager's chunk retry + the gossiped
+  object directory under injected faults, bit-exactness asserted.
+
+  phase 3 — elastic-train drill: a 2-worker GPT-2-DDP run
   (microbenchmark._elastic_train_loop); once the gang makes progress, a
   `kill:*:n=1` plan is injected into one daemon over the chaos control
   plane (`set_node_chaos`), so the daemon SIGKILLs itself on its next
@@ -71,6 +77,70 @@ def warm_burst_soak(seed: int, rounds: int = 6, burst: int = 40) -> dict:
         cluster.shutdown()
 
 
+def large_object_soak(seed: int, rounds: int = 4, mb: int = 12) -> dict:
+    """Cross-node large-object traffic under a seeded drop/delay plan on
+    the data edge. Store isolation forces real transfers; the chaos env
+    is inherited by the consumer node's workers, so their pulls (routed
+    through the node daemon's pull manager) hit injected fetch_chunk
+    drops and must survive via chunk retry/backoff."""
+    import numpy as np
+
+    import ray_tpu
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ray_tpu.cluster_utils import Cluster
+
+    chaos = (f"seed={seed},drop:fetch_chunk@data-*:every=4,"
+             "delay:fetch_chunk@data-*:p=0.2:t=0.02")
+    saved = os.environ.get("RAY_TPU_STORE_ISOLATION")
+    os.environ["RAY_TPU_STORE_ISOLATION"] = "1"
+    cluster = Cluster(num_cpus=0)
+    cluster.add_node(num_cpus=2, resources={"src": 4})
+    cluster.add_node(num_cpus=2, resources={"dst": 4},
+                     env={"RAY_TPU_CHAOS": chaos})
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+
+        @ray_tpu.remote
+        def make(mb_, seed_):
+            rng = np.random.default_rng(seed_)
+            return rng.integers(0, 255, size=(mb_ * 1024 * 1024,),
+                                dtype=np.uint8)
+
+        @ray_tpu.remote
+        def digest(arr):
+            return int(arr[::4096].astype(np.uint64).sum()), arr.shape[0]
+
+        t0 = time.perf_counter()
+        moved = 0
+        for r in range(rounds):
+            ref = make.options(resources={"src": 1}).remote(mb, seed + r)
+            got_sum, got_n = ray_tpu.get(
+                digest.options(resources={"dst": 1}).remote(ref),
+                timeout=180)
+            expect = np.random.default_rng(seed + r).integers(
+                0, 255, size=(mb * 1024 * 1024,), dtype=np.uint8)
+            assert got_n == expect.shape[0]
+            assert got_sum == int(expect[::4096].astype(np.uint64).sum())
+            moved += mb
+            ray_tpu.free([ref])
+        elapsed = time.perf_counter() - t0
+        return {"rounds": rounds, "mb_moved": moved,
+                "elapsed_s": round(elapsed, 2),
+                "mb_per_s": round(moved / elapsed, 1), "chaos": chaos}
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        if saved is None:
+            os.environ.pop("RAY_TPU_STORE_ISOLATION", None)
+        else:
+            os.environ["RAY_TPU_STORE_ISOLATION"] = saved
+
+
 def elastic_train_drill(seed: int, steps: int = 30) -> dict:
     """The tentpole acceptance drill as a soak phase: the shared harness
     (`microbenchmark.run_elastic_drill`), with the kill delivered by the
@@ -93,6 +163,9 @@ def main(seed: int = 7, out: str | None = None, rounds: int = 6,
     report = {"seed": seed}
     print(f"[soak] warm burst under chaos (seed={seed})", file=sys.stderr)
     report["warm_burst"] = warm_burst_soak(seed, rounds=rounds)
+    print(f"[soak] large-object data plane under chaos (seed={seed})",
+          file=sys.stderr)
+    report["large_object"] = large_object_soak(seed)
     print(f"[soak] elastic train drill (seed={seed})", file=sys.stderr)
     report["elastic_train"] = elastic_train_drill(seed, steps=steps)
     print(json.dumps(report, indent=2))

@@ -155,6 +155,21 @@ class CoreClient:
         from ray_tpu.core.resource_view import ClusterView
 
         self.cluster_view = ClusterView()
+        # gossiped object directory: location announcements piggybacked on
+        # cluster_view pushes — a warm get() of a remote object resolves
+        # meta + serving node from cache, zero head RPCs
+        # (core/object_directory.py)
+        from ray_tpu.core.object_directory import ObjectDirectory
+
+        self.object_dir = ObjectDirectory()
+        # metas of copies the LOCAL node's pull manager fetched for us
+        # (daemon/head data server `pull_object`): the node owns the
+        # replica's lifetime, so these are plain pointers, never freed by
+        # this process (unlike _pulled, whose copies are ours to unlink)
+        self._daemon_pulled: "OrderedDict[ObjectID, ObjectMeta]" = OrderedDict()
+        data_port = os.environ.get("RAY_TPU_NODE_DATA_PORT")
+        self._node_data_addr = (("127.0.0.1", int(data_port))
+                                if data_port else None)
         self._sched_conns: Dict[Tuple[str, int], protocol.Connection] = {}
         self.lease_stats = {"daemon_grants": 0, "head_grants": 0,
                             "spills": 0}
@@ -273,6 +288,7 @@ class CoreClient:
         if channel == "cluster_view":
             self.cluster_view.adopt(msg)
             self.cluster_epoch = msg.get("epoch", self.cluster_epoch)
+            self.object_dir.apply(msg.get("objects"))
         if channel == "actor_state" and msg.get("state") in ("RESTARTING",
                                                              "DEAD"):
             aid = ActorID(msg["actor_id"])
@@ -1092,7 +1108,10 @@ class CoreClient:
         raise ObjectLostError(f"object {meta.object_id} vanished during read")
 
     def _drop_pulled(self, oid: ObjectID):
-        """Forget a pulled copy; returns its meta (caller frees storage)."""
+        """Forget a pulled copy; returns its meta (caller frees storage).
+        Node-pulled pointers are dropped too so a retry re-resolves
+        through the node pull manager (which re-pulls if it evicted)."""
+        self._daemon_pulled.pop(oid, None)
         with self._pulled_lock:
             stale = self._pulled.pop(oid, None)
             if stale is not None:
@@ -1112,6 +1131,60 @@ class CoreClient:
                 lambda t, o=oid: self._pull_tasks.pop(o, None))
         return await asyncio.shield(task)
 
+    def _probe_readable(self, meta: ObjectMeta) -> bool:
+        try:
+            view, rel = self.store.get_raw(meta, 0, 0)
+            view.release()
+            if rel is not None:
+                rel()
+            return True
+        except (FileNotFoundError, OSError):
+            return False
+
+    def _sources_from_view(self, meta: ObjectMeta) -> list:
+        """Candidate data-server addresses resolved ENTIRELY from cache:
+        the gossiped object directory's locations (primary first, then
+        advertised replicas) mapped through the cluster view's data_addr
+        entries — the warm path that keeps remote get() head-RPC-free."""
+        from ray_tpu.core.object_directory import resolve_addrs
+
+        return resolve_addrs(self.object_dir, meta,
+                             self.cluster_view.data_addr_of, self.head_host)
+
+    async def _pull_via_node(self, meta: ObjectMeta,
+                             sources: list) -> Optional[ObjectMeta]:
+        """Ask the LOCAL node's pull manager (daemon, or the head's for
+        head-node workers) to fetch the object into the node store: two
+        workers on one node pulling the same remote object then cost one
+        network crossing, not two. Returns None when no local manager is
+        configured or the node-level pull failed (caller falls back to a
+        direct pull)."""
+        if self._node_data_addr is None \
+                or not _config.get("node_pull_manager"):
+            return None
+        key = self._node_data_addr
+        conn = self._data_conns.get(key)
+        try:
+            if conn is None or conn.closed:
+                conn = await protocol.connect(key[0], key[1],
+                                              name=f"data-{key[1]}")
+                self._data_conns[key] = conn
+            # size-aware bound: a multi-GB pull must not be abandoned at a
+            # fixed wall time (the daemon would keep pulling while we
+            # redundantly re-pull direct); assume a conservative 4 MiB/s
+            # floor on top of a fixed grace
+            local = await asyncio.wait_for(
+                conn.request("pull_object", meta=meta, sources=sources),
+                timeout=120 + meta.size / (4 << 20))
+        except (protocol.RpcError, OSError, asyncio.TimeoutError):
+            return None
+        if local is None or not self._probe_readable(local):
+            return None
+        self._daemon_pulled[local.object_id] = local
+        while len(self._daemon_pulled) > 4096:  # metas only; node owns data
+            self._daemon_pulled.popitem(last=False)
+        return local
+
     async def _locate_or_pull(self, meta: ObjectMeta) -> ObjectMeta:
         oid = meta.object_id
         with self._pulled_lock:
@@ -1120,37 +1193,64 @@ class CoreClient:
                 self._pulled.move_to_end(oid)
         if cached is not None:
             return cached
-        # fast path: the meta names its node (always true for results) —
-        # go straight to that node's data server, skipping the directory
-        if meta.node_id is not None and meta.kind in ("shm", "arena", "spilled"):
+        node_local = self._daemon_pulled.get(oid)
+        if node_local is not None:
+            if self._probe_readable(node_local):
+                return node_local
+            self._daemon_pulled.pop(oid, None)
+        # warm path: fresh meta + serving nodes from the cached gossiped
+        # directory, data addresses from the cached cluster view — no
+        # head round trips at all
+        fresh = self.object_dir.lookup_meta(oid)
+        if fresh is not None:
+            meta = fresh
+            self.local_metas[oid] = fresh
+            if self._probe_readable(fresh):
+                return fresh  # e.g. retargeted spill file we can read
+        sources = self._sources_from_view(meta)
+        if sources or meta.node_id is not None:
+            local = await self._pull_via_node(meta, sources)
+            if local is not None:
+                return local
+        for addr in sources:  # direct pull with replica failover
+            try:
+                return await self._pull_from(addr, meta)
+            except (protocol.RpcError, OSError, FileNotFoundError):
+                continue  # node lost / object moved: next source or head
+        if (not sources and meta.node_id is not None
+                and meta.kind in ("shm", "arena", "spilled")):
+            # meta names its node but the cached view doesn't know that
+            # node's data server yet (cold driver): one head lookup
             addr = await self.conn.request(
                 "node_data_addr", node_id=meta.node_id.binary())
             if addr is not None:
                 try:
                     return await self._pull_from(tuple(addr), meta)
                 except (protocol.RpcError, OSError, FileNotFoundError):
-                    pass  # node lost / object moved: consult the directory
-        # directory path: refreshed meta + current location from the head
+                    pass
+        # cold miss / all cached routes failed: the head directory is the
+        # fallback — refreshed meta + every advertised source
         rep = await self.conn.request(
             "locate_object", object_id=oid.binary(), timeout=30)
         if rep is None:
             raise ObjectLostError(f"object {oid} is gone")
-        fresh, addr = rep["meta"], rep["data_addr"]
+        fresh = rep["meta"]
         self.local_metas[oid] = fresh
-        try:
-            view, rel = self.store.get_raw(fresh, 0, 0)  # probe readability
-            view.release()
-            if rel is not None:
-                rel()
+        if self._probe_readable(fresh):
             return fresh
-        except FileNotFoundError:
-            pass
-        if addr is not None:
+        head_sources = [tuple(s) for s in (rep.get("sources")
+                        or ([rep["data_addr"]] if rep.get("data_addr")
+                            else []))]
+        last_exc = None
+        for addr in head_sources:
             try:
-                return await self._pull_from(tuple(addr), fresh)
+                return await self._pull_from(addr, fresh)
             except (protocol.RpcError, OSError, FileNotFoundError) as e:
-                raise ObjectLostError(
-                    f"object {oid} unreachable on {addr}: {e!r}") from e
+                last_exc = e
+        if last_exc is not None:
+            raise ObjectLostError(
+                f"object {oid} unreachable on {head_sources}: "
+                f"{last_exc!r}") from last_exc
         raise ObjectLostError(f"object {oid} has no reachable location")
 
     async def _pull_from(self, addr, meta: ObjectMeta) -> ObjectMeta:
@@ -1165,8 +1265,15 @@ class CoreClient:
         if self._pull_sem is None:
             self._pull_sem = asyncio.Semaphore(int(os.environ.get(
                 "RAY_TPU_MAX_CONCURRENT_PULLS", "4")))
+        role = "driver" if self.is_driver else "worker"
+        t0 = time.perf_counter()
         async with self._pull_sem:  # pull admission control
-            local = await object_transfer.pull_object(conn, meta, self.store)
+            local = await object_transfer.pull_object(conn, meta, self.store,
+                                                      role=role)
+        m = object_transfer._get_metrics()
+        m["bytes"].inc(local.size, tags={"role": role})
+        m["pulls"].inc(tags={"role": role})
+        m["seconds"].observe(time.perf_counter() - t0, tags={"role": role})
         self._note_pulled(local)
         return local
 
@@ -1222,9 +1329,14 @@ class CoreClient:
                     if self._resolve_pending_call(ref.id, timeout=remaining):
                         meta = self.local_metas[ref.id]
                     else:
-                        meta = self.head_request(
-                            "get_meta", object_id=ref.id.binary(),
-                            timeout=remaining)
+                        # gossiped directory first: a sealed remote object
+                        # we never held a meta for resolves from cache —
+                        # the head only sees genuinely cold misses
+                        meta = self.object_dir.lookup_meta(ref.id)
+                        if meta is None:
+                            meta = self.head_request(
+                                "get_meta", object_id=ref.id.binary(),
+                                timeout=remaining)
                     if meta is None:
                         raise GetTimeoutError(f"get timed out on {ref}")
                     self.local_metas[ref.id] = meta
@@ -1250,9 +1362,13 @@ class CoreClient:
                         self._pending_calls.pop(ref.id, None)
                 if cfut is None or meta is None:
                     # no pending call, or a lease failover resubmitted the
-                    # task through the head: resolve via the directory
-                    meta = await self.conn.request(
-                        "get_meta", object_id=ref.id.binary(), timeout=None)
+                    # task through the head: cached gossiped directory
+                    # first, head get_meta as the cold-miss fallback
+                    meta = self.object_dir.lookup_meta(ref.id)
+                    if meta is None:
+                        meta = await self.conn.request(
+                            "get_meta", object_id=ref.id.binary(),
+                            timeout=None)
                 self.local_metas[ref.id] = meta
             self._note_complete(ref.id)
             value = await self._read_value_async(meta)

@@ -80,6 +80,28 @@ FLAGS: List[Flag] = [
          "In-flight chunks per pull (windowed transfer)."),
     Flag("transfer_server_reads", "RAY_TPU_TRANSFER_SERVER_READS", int, 8,
          "Concurrent chunk reads served per data server."),
+    Flag("transfer_chunk_retries", "RAY_TPU_TRANSFER_CHUNK_RETRIES", int, 4,
+         "Per-chunk retry budget inside one pull attempt (rides the "
+         "chaos plane: injected drops/delays on the data edge are "
+         "absorbed here before multi-source failover kicks in)."),
+    Flag("transfer_retry_backoff_s", "RAY_TPU_TRANSFER_RETRY_BACKOFF_S",
+         float, 0.05, "Base backoff between chunk retries (doubles per "
+         "attempt, capped at 1s)."),
+    Flag("object_directory", "RAY_TPU_OBJECT_DIRECTORY", bool, True,
+         "Gossip object locations on the cluster_view plane so daemons "
+         "and drivers resolve objects peer-to-peer; the head's "
+         "locate_object becomes the cold-miss fallback.", negotiated=True),
+    Flag("node_pull_manager", "RAY_TPU_NODE_PULL_MANAGER", bool, True,
+         "Workers route remote-object pulls through their node daemon's "
+         "pull manager so each object crosses the network once per node.",
+         negotiated=True),
+    Flag("replica_cache_bytes", "RAY_TPU_REPLICA_CACHE_BYTES", int, 1 << 30,
+         "Node-daemon LRU cache of pulled object replicas (advertised "
+         "in the gossiped object directory as pull sources)."),
+    Flag("device_dlpack", "RAY_TPU_DEVICE_DLPACK", bool, True,
+         "Rematerialize pulled device-object leaves via DLPack "
+         "(zero-copy adoption of the mapped shm view on CPU backends; "
+         "falls back to device_put)."),
     Flag("ici_fetch_timeout_s", "RAY_TPU_ICI_FETCH_TIMEOUT_S", float, 60.0,
          "Bound on a gang-ICI device fetch before the consumer surfaces "
          "ObjectLostError (a dead peer poisons the pair collective)."),

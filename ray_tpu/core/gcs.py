@@ -29,6 +29,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core import config as _config
+from ray_tpu.core import object_directory as objdir
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from ray_tpu.core.store import ObjectMeta, SharedMemoryStore
@@ -382,6 +383,16 @@ class Head:
         self._view_seq = 0
         self._last_view_snap: Optional[dict] = None
         self._view_wake: Optional[asyncio.Event] = None
+        # gossiped object directory (authoritative copy): seal/spill/free
+        # of non-inline objects and daemon replica announcements append
+        # delta records that ride the next cluster_view broadcast; daemons
+        # and drivers keep cached copies so warm pulls resolve peer-to-peer
+        # with zero head RPCs (core/object_directory.py)
+        from ray_tpu.core.object_directory import ObjectDirectory
+        self.object_dir = ObjectDirectory()
+        self._dir_seq = 0
+        self._dir_pending: List[dict] = []
+        self._dir_full_resync = False  # pending overflow: broadcast full
         self.obj_pins: Dict[ObjectID, int] = {}
         self.worker_holds: Dict[WorkerID, Set[ObjectID]] = {}
         self.lineage_dep_pins: Dict[ObjectID, int] = {}
@@ -533,6 +544,7 @@ class Head:
                      "node_id": nid.hex()})
                 self._kick()
                 self._view_changed()
+                self._push_full_view(conn_state["conn"])
                 return {"session": self.session,
                         "head_node_id": self.node_id.binary(),
                         "epoch": self.cluster_epoch}
@@ -547,6 +559,7 @@ class Head:
             self._publish("node_state", {"node_id": nid.binary(), "state": "ALIVE"})
             self._kick()
             self._view_changed()
+            self._push_full_view(conn_state["conn"])
             return {"session": self.session,
                     "head_node_id": self.node_id.binary(),
                     "epoch": self.cluster_epoch}
@@ -554,7 +567,7 @@ class Head:
         async def resource_view_delta(version, idle_workers, labels=None,
                                       events=None, stats=None, gossip=None,
                                       metrics=None, epoch=None,
-                                      leased_workers=None):
+                                      leased_workers=None, objects=None):
             """Node-daemon gossip: its lease-pool state changed. Stale
             versions (a reconnect replaying an old delta) are ignored.
             The reply acks the highest flight-recorder event seq merged —
@@ -587,6 +600,30 @@ class Head:
                 node.gossip_health = gossip
             if leased_workers is not None:
                 node.pool_leased = leased_workers
+            if objects:
+                # replica announcements from the daemon's pull manager
+                # (pull-replica created / cache-evicted): merge into the
+                # authoritative directory and rebroadcast so every
+                # consumer gains the extra pull source
+                nid_hex = node.node_id.hex()
+                for rec in objects:
+                    if rec.get("op") not in ("replica", "replica_gone") \
+                            or rec.get("node") != nid_hex:
+                        continue
+                    self._dir_announce(rec)
+                    if rec["op"] == "replica_gone":
+                        # the evicted replica may have been the LAST copy
+                        # of an object whose primary already died: run
+                        # loss handling (reconstruct / seal lost) now
+                        # instead of leaving a dangling meta forever
+                        oid = ObjectID(rec["oid"])
+                        m = self.objects.get(oid)
+                        if (m is not None and m.kind in ("shm", "arena")
+                                and m.node_id is not None
+                                and not self._node_alive(m.node_id)
+                                and not self.object_dir.locations(oid)):
+                            self._handle_lost_object(
+                                oid, f"last replica evicted on {nid_hex}")
             if metrics is not None:
                 # daemons have no CoreClient/pusher: their metrics registry
                 # snapshot rides the gossip into the same _metrics KV
@@ -693,7 +730,7 @@ class Head:
             self._view_changed()
             return True
 
-        async def pool_reconcile(inventory, epoch=None):
+        async def pool_reconcile(inventory, epoch=None, objects=None):
             """Reconciliation handshake: on every (re)connect the daemon
             reports its full pool inventory (idle entries + live local
             leases). The daemon is the source of truth for carved
@@ -730,6 +767,34 @@ class Head:
                     continue
                 self._adopt_pooled(node, w, item)
                 adopted += 1
+            adopted_objects = 0
+            stale_objects = []
+            if objects:
+                # spill-restore: the daemon re-advertises its node's
+                # surviving object inventory (shm/arena/spilled primaries
+                # from its cached directory + pulled replicas), and the
+                # head rebuilds the object directory from daemon truth —
+                # the ledger pattern applied to data. _seal is idempotent
+                # (first seal wins) so a live head's entries are untouched.
+                for meta in objects.get("metas") or ():
+                    if (meta.kind not in objdir.PULLABLE_KINDS
+                            or meta.node_id != node.node_id):
+                        continue
+                    if meta.object_id in self._tombstones:
+                        # freed while the daemon's free push was lost in a
+                        # connection flap: tell it to reclaim the storage
+                        # instead of resurrecting the object
+                        stale_objects.append(meta)
+                        continue
+                    if meta.object_id not in self.objects:
+                        self._seal(meta)
+                        adopted_objects += 1
+                nid_hex = node.node_id.hex()
+                for oid_b in objects.get("replicas") or ():
+                    oid = ObjectID(oid_b)
+                    if oid in self.objects:
+                        self._dir_announce(
+                            objdir.replica_record(oid, nid_hex))
             node.reconciled = True
             self.sched_totals["reconciles"] += 1
             for w in list(node.unadopted):
@@ -743,9 +808,15 @@ class Head:
             self.lease_events.append(
                 {"ts": time.time(), "kind": "pool_reconcile",
                  "node_id": node.node_id.hex(), "adopted": adopted,
-                 "released": released, "pending": len(node.pending_pool)})
+                 "released": released, "pending": len(node.pending_pool),
+                 "objects_readvertised": adopted_objects})
             self._view_changed()
             self._kick()
+            for meta in stale_objects:
+                try:
+                    node.conn.push("free_object", meta=meta)
+                except Exception:
+                    pass
             return {"epoch": self.cluster_epoch, "adopted": adopted,
                     "released": released}
 
@@ -922,6 +993,7 @@ class Head:
                 canonical.kind = meta.kind
                 canonical.spill_path = meta.spill_path
                 canonical.segment = meta.segment
+                self._dir_announce(objdir.spill_record(canonical))
             return True
 
         async def worker_address(worker_id):
@@ -941,18 +1013,28 @@ class Head:
             return n.data_addr
 
         async def locate_object(object_id, timeout=None):
-            """Object directory lookup: fresh meta + current data-server
-            address (reference ownership_object_directory semantics, with
-            the head as the directory)."""
+            """Object directory lookup — now the COLD-MISS fallback behind
+            the gossiped directory (reference ownership_object_directory
+            semantics). Returns the fresh meta, the primary's data-server
+            address, and every advertised replica address so the puller
+            can fail over without another round trip."""
             meta = await get_meta(object_id, timeout=timeout)
             if meta is None:
                 return None
             addr = None
-            if meta.kind in ("shm", "arena", "spilled") and meta.node_id is not None:
-                n = self.nodes.get(meta.node_id)
-                if n is not None and n.alive:
-                    addr = n.data_addr
-            return {"meta": meta, "data_addr": addr}
+            sources = []
+            if meta.kind in objdir.PULLABLE_KINDS:
+                for node_hex in (self.object_dir.locations(meta.object_id)
+                                 or ([meta.node_id.hex()]
+                                     if meta.node_id is not None else [])):
+                    try:
+                        n = self.nodes.get(NodeID.from_hex(node_hex))
+                    except Exception:
+                        n = None
+                    if n is not None and n.alive and n.data_addr:
+                        sources.append(n.data_addr)
+                addr = sources[0] if sources else None
+            return {"meta": meta, "data_addr": addr, "sources": sources}
 
         async def wait_objects(object_ids, num_returns, timeout):
             object_ids = [ObjectID(b) if not isinstance(b, ObjectID) else b
@@ -1065,10 +1147,9 @@ class Head:
             self.subscribers.setdefault(channel, []).append(conn_state["conn"])
             if channel == "cluster_view":
                 # late subscribers must not wait for the next view CHANGE
-                # to learn the current one
-                snap = self._last_view_snap or self._build_view_snapshot()
-                conn_state["conn"].push("pubsub", channel="cluster_view",
-                                        msg=snap)
+                # to learn the current one (object-directory payload
+                # included wholesale — deltas only carry recent history)
+                self._push_full_view(conn_state["conn"], pubsub=True)
             return True
 
         async def cluster_info():
@@ -1613,6 +1694,29 @@ class Head:
         """Remove an object entirely: storage, directory entry, lineage,
         and the pins it held on nested refs."""
         meta = self.objects.pop(oid, None)
+        if meta is not None and meta.kind in objdir.PULLABLE_KINDS:
+            # the head's own pull-manager replica dies with the object too
+            # (it is never directory-announced, so no push reaches it; a
+            # cached copy surviving here could be served stale)
+            pm = getattr(self, "pull_manager", None)
+            if pm is not None:
+                pm.drop(oid)
+            # replicas on other nodes die with the canonical object: tell
+            # their daemons to unlink before the location knowledge goes
+            for node_hex in self.object_dir.locations(oid):
+                if meta.node_id is not None \
+                        and node_hex == meta.node_id.hex():
+                    continue  # the primary; _free_meta reaches it below
+                try:
+                    n = self.nodes.get(NodeID.from_hex(node_hex))
+                except Exception:
+                    n = None
+                if n is not None and n.conn is not None and n.alive:
+                    try:
+                        n.conn.push("drop_replica", object_id=oid.binary())
+                    except Exception:
+                        pass
+            self._dir_announce(objdir.free_record(oid))
         self.obj_holders.pop(oid, None)
         for token in self.obj_borrows.pop(oid, set()):
             ent = self.borrow_pins.pop(token, None)
@@ -1731,6 +1835,8 @@ class Head:
                 self._free_meta(meta)  # a genuinely distinct duplicate copy
             return
         self.objects[meta.object_id] = meta
+        if meta.kind in objdir.PULLABLE_KINDS:
+            self._dir_announce(objdir.seal_record(meta))
         self._publish("object_state", {"object_id": meta.object_id.binary(),
                                        "state": "SEALED",
                                        "size": meta.size,
@@ -2007,6 +2113,9 @@ class Head:
         env["RAY_TPU_HEAD_PORT"] = str(self.port)
         env["RAY_TPU_SESSION"] = self.session
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        # head-node workers route remote pulls through the head's data
+        # server pull manager (same once-per-node contract as daemons)
+        env["RAY_TPU_NODE_DATA_PORT"] = str(self.data_port)
         if not pip:
             self._popen_worker(sys.executable, env)
             return
@@ -2237,6 +2346,43 @@ class Head:
                      if isinstance(a, (bytes, bytearray, memoryview)))
         return n
 
+    def _node_alive(self, node_id: NodeID) -> bool:
+        n = self.nodes.get(node_id)
+        return n is not None and n.alive
+
+    def _handle_lost_object(self, oid: ObjectID, where: str) -> None:
+        """Every reachable copy of a produced object is gone: drop the
+        meta and either reconstruct from lineage or seal an
+        ObjectLostError for parked/future consumers. Shared by direct
+        node death and last-replica loss (a replica-backed object whose
+        primary died earlier loses its final copy later — eviction of
+        the replica, or the replica node dying too)."""
+        meta = self.objects.pop(oid, None)
+        if meta is None:
+            return
+        self._evict_due.pop(oid, None)
+        for b in (meta.contained or []):
+            self._unpin(ObjectID(b))
+        try:
+            # unlink the dead copy's storage now: the meta is the only
+            # handle to the arena entry / shm segment, and nothing can
+            # free it once replaced by an error or a rebuilt copy
+            self.store.free(meta)
+        except Exception:
+            pass
+        entry = self.lineage.get(oid)
+        if entry is None or oid not in entry["produced"]:
+            # no lineage (ray.put / evicted entry): cannot rebuild —
+            # mark lost now so parked AND future consumers raise
+            # ObjectLostError instead of hanging forever
+            self._seal_lost(
+                oid, f"object {oid.hex()} lost with {where} "
+                     f"and has no lineage")
+        elif oid in self.object_waiters:
+            self._maybe_reconstruct(oid)
+        else:
+            self._lost_pending.add(oid)
+
     def _seal_lost(self, oid: ObjectID, cause: str) -> None:
         """Seal an error object so parked and future consumers raise
         ObjectLostError instead of hanging forever."""
@@ -2257,36 +2403,36 @@ class Head:
                      f"proc:node-{node.node_id.hex()[:12]}".encode()), None)
         self.lease_events.append({"ts": time.time(), "kind": "node_dead",
                                   "node_id": node.node_id.hex()})
+        # its primaries and replicas are unreachable: purge every cached
+        # directory's knowledge of them (lost primaries additionally go
+        # through _seal_lost/reconstruction below)
+        self._dir_announce(objdir.node_dead_record(node.node_id.hex()))
         # objects whose data lived on that node are gone; drop their metas
         # and lazily reconstruct from lineage when next requested (waiters
         # already parked get kicked now)
         lost = [oid for oid, m in self.objects.items()
                 if m.node_id == node.node_id
                 and m.kind in ("shm", "arena", "device")]
+        dead_hex = node.node_id.hex()
         for oid in lost:
-            meta = self.objects.pop(oid)
-            self._evict_due.pop(oid, None)
-            for b in (meta.contained or []):
-                self._unpin(ObjectID(b))
-            try:
-                # unlink the dead copy's storage now: the meta is the only
-                # handle to the arena entry / shm segment, and nothing can
-                # free it once replaced by an error or a rebuilt copy
-                self.store.free(meta)
-            except Exception:
-                pass
-            entry = self.lineage.get(oid)
-            if entry is None or oid not in entry["produced"]:
-                # no lineage (ray.put / evicted entry): cannot rebuild —
-                # mark lost now so parked AND future consumers raise
-                # ObjectLostError instead of hanging forever
-                self._seal_lost(
-                    oid, f"object {oid.hex()} lost with node "
-                         f"{node.node_id.hex()} and has no lineage")
-            elif oid in self.object_waiters:
-                self._maybe_reconstruct(oid)
-            else:
-                self._lost_pending.add(oid)
+            meta = self.objects[oid]
+            if meta.kind in ("shm", "arena") and any(
+                    h != dead_hex
+                    for h in self.object_dir.locations(oid)):
+                # a pulled replica on a surviving node still serves the
+                # bytes (the node_dead announcement above kept the entry
+                # for exactly this case): no loss, no reconstruction
+                continue
+            self._handle_lost_object(oid, f"node {dead_hex}")
+        # objects whose PRIMARY died earlier and that this node carried
+        # the LAST replica of just lost their final copy too
+        for oid in [o for o, m in self.objects.items()
+                    if m.kind in ("shm", "arena")
+                    and m.node_id is not None
+                    and m.node_id != node.node_id
+                    and not self._node_alive(m.node_id)
+                    and not self.object_dir.locations(o)]:
+            self._handle_lost_object(oid, f"last replica on {dead_hex}")
         self._publish("node_state", {"node_id": node.node_id.binary(),
                                      "state": "DEAD"})
         # PG bundles on that node lose their reservation; re-reserve
@@ -2366,6 +2512,35 @@ class Head:
         if self._view_wake is not None:
             self._view_wake.set()
 
+    # ------------------------------------------------- object directory
+    def _dir_announce(self, rec: dict) -> None:
+        """Apply a directory record locally and queue it for the next
+        cluster_view broadcast. Deliberately does NOT wake the broadcast
+        loop: object churn (a put storm) coalesces into one delta list
+        per `view_broadcast_s` tick instead of one push per object."""
+        if not _config.get("object_directory"):
+            return
+        self.object_dir.apply_record(rec)
+        self._dir_seq += 1
+        if len(self._dir_pending) >= 8192:
+            # overflow: consumers get a wholesale resync instead of a
+            # silently truncated delta stream
+            self._dir_pending.clear()
+            self._dir_full_resync = True
+        else:
+            self._dir_pending.append(rec)
+
+    def _dir_payload(self) -> Optional[dict]:
+        """Drain pending directory records into one broadcast payload."""
+        if self._dir_full_resync:
+            self._dir_full_resync = False
+            self._dir_pending.clear()
+            return self.object_dir.full_payload(self._dir_seq)
+        if not self._dir_pending:
+            return None
+        delta, self._dir_pending = self._dir_pending, []
+        return {"v": self._dir_seq, "delta": delta}
+
     def _build_view_snapshot(self) -> dict:
         from ray_tpu.core import resource_view as rv
 
@@ -2377,9 +2552,41 @@ class Head:
                 n.node_id.hex(), version=n.view_version, free=n.available,
                 total=n.resources, labels=n.labels,
                 idle_workers=n.pool_idle, sched_addr=n.sched_addr,
-                is_head=n.is_head))
+                data_addr=n.data_addr, is_head=n.is_head))
         return {"version": self._view_seq, "nodes": nodes,
                 "epoch": self.cluster_epoch}
+
+    async def _resolve_pull_sources(self, meta: ObjectMeta) -> list:
+        """Pull-source addresses for the head's own pull manager: the
+        authoritative directory's locations, primary first."""
+        def addr_of(node_hex: str):
+            try:
+                n = self.nodes.get(NodeID.from_hex(node_hex))
+            except Exception:
+                return None
+            return n.data_addr if n is not None and n.alive else None
+
+        return objdir.resolve_addrs(self.object_dir, meta, addr_of,
+                                    "127.0.0.1",
+                                    exclude=self.node_id.hex())
+
+    def _push_full_view(self, conn, pubsub: bool = False) -> None:
+        """Push the current view with a WHOLESALE object-directory payload
+        to one connection (a late subscriber or a (re)registered daemon):
+        delta broadcasts only carry changes since the last tick, and a
+        joiner that missed history must not cold-miss on every object.
+        Daemons take the raw `cluster_view` push; drivers/workers get the
+        pubsub-wrapped flavor their subscription expects."""
+        snap = dict(self._last_view_snap or self._build_view_snapshot())
+        if _config.get("object_directory"):
+            snap["objects"] = self.object_dir.full_payload(self._dir_seq)
+        try:
+            if pubsub:
+                conn.push("pubsub", channel="cluster_view", msg=snap)
+            else:
+                conn.push("cluster_view", snap=snap)
+        except Exception:
+            pass
 
     async def _view_broadcast_loop(self) -> None:
         """Debounced push of the compacted cluster view to every node
@@ -2397,12 +2604,23 @@ class Head:
                 pass
             self._view_wake.clear()
             snap = self._build_view_snapshot()
-            if (self._last_view_snap is not None
-                    and snap["nodes"] == self._last_view_snap["nodes"]):
+            nodes_changed = (self._last_view_snap is None
+                             or snap["nodes"] != self._last_view_snap["nodes"])
+            dir_payload = self._dir_payload()
+            if not nodes_changed and dir_payload is None:
                 continue
-            self._view_seq += 1
-            snap["version"] = self._view_seq
-            self._last_view_snap = snap
+            if nodes_changed:
+                self._view_seq += 1
+                snap["version"] = self._view_seq
+                self._last_view_snap = snap
+            else:
+                # object-directory-only tick: reuse the current view body
+                # (version unchanged — consumers' version bookkeeping is
+                # for the NODE entries; directory ordering rides dir v)
+                snap = dict(self._last_view_snap)
+            if dir_payload is not None:
+                snap = dict(snap)
+                snap["objects"] = dir_payload
             for node in self.nodes.values():
                 if node.conn is not None and node.alive and not node.conn.closed:
                     try:
@@ -2542,6 +2760,11 @@ class Head:
             "jobs": jobs,
             "job_counter": self.job_counter,
             "epoch": self.cluster_epoch,
+            # freed-object tombstones: the reconcile fence that stops a
+            # daemon's post-restart inventory re-advertisement from
+            # resurrecting an object freed just before the head died
+            # (bounded at 100k ids, ~1.6 MB worst case)
+            "tombstones": [o.binary() for o in self._tombstones],
         }
         self._write_snapshot(snap)
 
@@ -2635,7 +2858,9 @@ class Head:
                     # client (they resolve by the ADOPTED id) can map our
                     # arena — and replayed metas couldn't be opened here
                     cap = self.store.capacity
-                    self.store.shutdown()
+                    # keep spill files: surviving daemons/processes may
+                    # still re-advertise objects spilled under them
+                    self.store.shutdown(sweep_spill=False)
                     self.store = SharedMemoryStore(
                         self.session, capacity_bytes=cap, create_arena=True,
                         namespace=new_id.hex()[:8])
@@ -2644,6 +2869,10 @@ class Head:
         # grant/carve-out tag is verifiably stale
         self.cluster_epoch = max(self.cluster_epoch,
                                  int(snap.get("epoch", 0)) + 1)
+        for oid_b in snap.get("tombstones") or ():
+            # restore the freed-object fence so daemon inventory
+            # re-advertisement can't resurrect a pre-restart free
+            self._tombstones[ObjectID(oid_b)] = None
         self.kv.update(snap["kv"])
         # metrics snapshots are per-process and every pre-restart process's
         # connection died with the old head: restoring them would scrape
@@ -2850,8 +3079,15 @@ class Head:
         # pulls (reference object_manager over gRPC)
         from ray_tpu.core import object_transfer
 
+        # head-node pull manager: local workers route remote pulls through
+        # it (`pull_object` RPC) so an object crosses the network once per
+        # node — the daemon-side manager's twin for the head's own node
+        self.pull_manager = object_transfer.PullManager(
+            lambda: self.store, role="head",
+            resolve=self._resolve_pull_sources)
         self._data_server = protocol.Server(
-            object_transfer.make_data_handlers(lambda: self.store),
+            object_transfer.make_data_handlers(lambda: self.store,
+                                               lambda: self.pull_manager),
             name="head-data")
         self.data_port = await self._data_server.start(host=bind)
         self.head_node.data_addr = (None, self.data_port)
@@ -3047,4 +3283,6 @@ class Head:
             await self._server.stop()
         if getattr(self, "_data_server", None):
             await self._data_server.stop()
+        if getattr(self, "pull_manager", None) is not None:
+            await self.pull_manager.close()
         self.store.shutdown()

@@ -86,6 +86,18 @@ class NodeDaemon:
         self._reconnecting = False
         self._fr_pending: List[dict] = []
         self._pending_releases: List[dict] = []
+        # object data plane: cached copy of the gossiped object directory
+        # (applied from cluster_view broadcasts), the full metas of
+        # objects PRIMARY on this node (the spill-restore inventory the
+        # reconcile handshake re-advertises after a head restart), queued
+        # replica announcements for the next gossip delta, and the node
+        # pull manager (created with the store in start())
+        from ray_tpu.core.object_directory import ObjectDirectory
+
+        self.object_dir = ObjectDirectory()
+        self.local_objects: Dict[bytes, object] = {}   # oid bytes -> meta
+        self._dir_out: List[dict] = []
+        self.pull = None
         isolation = _config.get("store_isolation")
         self.store_ns = _config.get("store_namespace") or (
             self.node_id.hex()[:8] if isolation else "")
@@ -98,7 +110,8 @@ class NodeDaemon:
         _metrics.disable_pusher()  # daemon metrics ride gossip, not the KV
         flight_recorder.install("daemon")
         self._data_server = protocol.Server(
-            object_transfer.make_data_handlers(lambda: self.store),
+            object_transfer.make_data_handlers(lambda: self.store,
+                                               lambda: self.pull),
             name="node-data")
         self.data_port = await self._data_server.start(
             host=_config.get("bind_host"))
@@ -137,6 +150,15 @@ class NodeDaemon:
         # entry and must learn the new location
         self.store.on_spill = lambda m: self.conn.push("object_spilled",
                                                        meta=m)
+        # node pull manager: local workers' remote pulls funnel through
+        # here (`pull_object` on the data server) so each object crosses
+        # the network once per node; pulled replicas are announced into
+        # the gossiped directory as extra sources for everyone else
+        self.pull = object_transfer.PullManager(
+            lambda: self.store, role="daemon",
+            resolve=self._resolve_pull_sources,
+            on_replica=self._on_replica_created,
+            on_replica_gone=self._on_replica_dropped)
         # tail this node's worker log files; new lines ride the control
         # connection to the head, which fans them out to drivers and keeps
         # its ring for the CLI/dashboard (reference log_monitor.py role)
@@ -164,6 +186,7 @@ class NodeDaemon:
             "kill_worker": self._kill_worker,
             "shutdown_node": self._shutdown_node,
             "free_object": self._free_object,
+            "drop_replica": self._on_drop_replica,
             "adopt_object": self._adopt_object,
             "health_ping": self._health_ping,
             "cluster_view": self._on_cluster_view,
@@ -267,17 +290,29 @@ class NodeDaemon:
                 "seq": ent.get("seq")})
         if self.conn is None or self.conn.closed:
             return
+        # spill-restore: re-advertise this node's surviving object
+        # inventory (primary shm/arena/spilled metas cached from the
+        # directory gossip + our pulled replicas) so a restarted head
+        # rebuilds its object directory from daemon truth — shm objects
+        # no longer die with the head
+        objects = None
+        if _config.get("object_directory"):
+            objects = {
+                "metas": list(self.local_objects.values()),
+                "replicas": [oid.binary() for oid in
+                             (self.pull.replica_ids() if self.pull else ())]}
         try:
             rep = await self.conn.request(
                 "pool_reconcile", inventory=inventory,
-                epoch=self.head_epoch)
+                epoch=self.head_epoch, objects=objects)
         except protocol.RpcError:
             return
         if rep:
             self.head_epoch = rep.get("epoch", self.head_epoch)
             self._fr("pool_reconcile", reported=len(inventory),
                      adopted=rep.get("adopted"),
-                     released=rep.get("released"))
+                     released=rep.get("released"),
+                     objects=len(self.local_objects))
         # the rebuilt ledger covers releases queued under a dead epoch
         # (their workers are simply absent from the report) — drop them
         self._pending_releases = [p for p in self._pending_releases
@@ -493,7 +528,18 @@ class NodeDaemon:
         events = list(self._fr_pending)
         gossip = {"view_version": self.cluster_view.version,
                   "view_age_s": round(self.cluster_view.staleness_s(), 3),
+                  "dir_age_s": round(self.object_dir.staleness_s(), 3),
+                  "dir_v": self.object_dir.last_v,
                   "events_dropped": self.fr_events.dropped}
+        # replica announcements (pull-replica created / evicted) ride the
+        # same delta; a batch lost with a dying connection only delays an
+        # optimization, so no ack tracking — the reconcile handshake
+        # re-advertises surviving replicas wholesale anyway
+        dir_out, self._dir_out = self._dir_out, []
+        stats = dict(self.sched_stats)
+        if self.pull is not None:
+            stats.update(self.pull.stats)
+            stats["replica_count"] = self.pull.replica_count()
         metrics_snap = None
         now = time.monotonic()
         from ray_tpu.util import metrics as _metrics
@@ -508,10 +554,11 @@ class NodeDaemon:
                 "resource_view_delta", version=self._gossip_version,
                 idle_workers=len(self.pool_idle),
                 leased_workers=len(self.pool_leases),
-                events=events, stats=dict(self.sched_stats),
+                events=events, stats=stats,
                 gossip=gossip, metrics=metrics_snap,
-                epoch=self.head_epoch)
+                epoch=self.head_epoch, objects=dir_out or None)
         except Exception:
+            self._dir_out = dir_out + self._dir_out
             return  # events stay pending; the next heartbeat retries
 
         def _acked(f):
@@ -546,9 +593,86 @@ class NodeDaemon:
         prev_age = self.cluster_view.staleness_s()
         self.cluster_view.adopt(snap)
         self.head_epoch = snap.get("epoch", self.head_epoch)
+        self._adopt_directory(snap.get("objects"))
         self._fr("view_adopt", version=snap.get("version"),
                  nodes=len(snap.get("nodes", [])),
                  age_s=round(prev_age, 3))
+        return True
+
+    # ------------------------------------------------ object data plane
+    def _adopt_directory(self, payload) -> None:
+        """Apply an object-directory payload from a cluster_view push.
+
+        Alongside the shared cache, track full metas of objects PRIMARY
+        on this node in `local_objects` — the inventory the reconcile
+        handshake re-advertises so a restarted head rebuilds its object
+        directory from daemon truth. A FULL payload only ADDS to
+        local_objects (a freshly restarted head's wholesale snapshot is
+        empty — wiping here would destroy the very inventory the
+        handshake exists to restore); removals ride explicit free
+        records and head-pushed free_object."""
+        if not payload:
+            return
+        me = self.node_id.hex()
+        for rec in (payload.get("delta") or ()):
+            op = rec.get("op")
+            if op in ("seal", "spill"):
+                meta = rec["meta"]
+                if meta.node_id is not None and meta.node_id.hex() == me:
+                    self.local_objects[meta.object_id.binary()] = meta
+            elif op == "free":
+                self.local_objects.pop(rec["oid"], None)
+        for ent in (payload.get("full") or ()):
+            meta = ent["meta"]
+            if meta.node_id is not None and meta.node_id.hex() == me:
+                self.local_objects[meta.object_id.binary()] = meta
+        self.object_dir.apply(payload)
+
+    async def _resolve_pull_sources(self, meta) -> list:
+        """Pull sources for this node's pull manager: the cached gossiped
+        directory + cluster-view data addresses first (zero head RPCs on
+        the warm path); the head's locate_object only on a cold miss."""
+        from ray_tpu.core.object_directory import resolve_addrs
+
+        out = resolve_addrs(self.object_dir, meta,
+                            self.cluster_view.data_addr_of,
+                            self.head_host, exclude=self.node_id.hex())
+        if not out and self.conn is not None and not self.conn.closed:
+            try:
+                rep = await self.conn.request(
+                    "locate_object",
+                    object_id=meta.object_id.binary(), timeout=15)
+            except protocol.RpcError:
+                rep = None
+            if rep:
+                for s in (rep.get("sources")
+                          or ([rep["data_addr"]]
+                              if rep.get("data_addr") else [])):
+                    out.append((s[0] or self.head_host, s[1]))
+        return out
+
+    def _on_replica_created(self, local_meta) -> None:
+        from ray_tpu.core import object_directory as objdir
+
+        self._dir_out.append(objdir.replica_record(
+            local_meta.object_id, self.node_id.hex()))
+        self._gossip_soon()
+
+    def _on_replica_dropped(self, oid) -> None:
+        from ray_tpu.core import object_directory as objdir
+
+        self._dir_out.append(objdir.replica_gone_record(
+            oid, self.node_id.hex()))
+        self._gossip_soon()
+
+    async def _on_drop_replica(self, object_id):
+        """Head-pushed when the canonical object is freed: unlink our
+        pulled replica (the meta the head holds describes the primary's
+        storage, not our copy)."""
+        from ray_tpu.core.ids import ObjectID
+
+        if self.pull is not None:
+            self.pull.drop(ObjectID(object_id))
         return True
 
     async def _on_pool_worker_died(self, worker_id):
@@ -568,6 +692,9 @@ class NodeDaemon:
         env["RAY_TPU_HEAD_HOST"] = self.head_host
         env["RAY_TPU_SESSION"] = self.session
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        # local workers route remote-object pulls through this daemon's
+        # pull manager (each object crosses the network once per node)
+        env["RAY_TPU_NODE_DATA_PORT"] = str(self.data_port)
         if self.store_ns:
             env["RAY_TPU_STORE_NAMESPACE"] = self.store_ns
         python = sys.executable
@@ -619,6 +746,9 @@ class NodeDaemon:
 
     async def _free_object(self, meta):
         """Head-forwarded free of an object living on this node."""
+        self.local_objects.pop(meta.object_id.binary(), None)
+        if self.pull is not None:
+            self.pull.drop(meta.object_id)
         if self.store is not None:
             try:
                 self.store.free(meta)
@@ -643,6 +773,8 @@ class NodeDaemon:
             await self._sched_server.stop()
         if self._data_server is not None:
             await self._data_server.stop()
+        if self.pull is not None:
+            await self.pull.close()
         if self.store is not None:
             # node death takes its objects with it (reference: plasma dies
             # with the raylet); unlink what this store still maps

@@ -46,11 +46,15 @@ def fits(free: Dict[str, float], resources: Dict[str, float]) -> bool:
 def make_entry(node_id_hex: str, *, version: int, free: Dict[str, float],
                total: Dict[str, float], labels: Dict[str, str],
                idle_workers: int = 0, sched_addr=None,
-               is_head: bool = False) -> dict:
+               data_addr=None, is_head: bool = False) -> dict:
+    # data_addr: the node's object data server — consumers of the gossiped
+    # object directory resolve pull sources from the cached view instead
+    # of asking the head (host None = "the head's host", substituted by
+    # each consumer from its own route to the head)
     return {"node_id": node_id_hex, "version": version, "free": dict(free),
             "total": dict(total), "labels": dict(labels),
             "idle_workers": idle_workers, "sched_addr": sched_addr,
-            "is_head": is_head}
+            "data_addr": data_addr, "is_head": is_head}
 
 
 class ClusterView:
@@ -112,6 +116,13 @@ class ClusterView:
         self.version = snap.get("version", self.version)
         self.epoch = snap.get("epoch", self.epoch)
         self.adopted_ts = time.monotonic()
+
+    def data_addr_of(self, node_id_hex: str):
+        """Cached data-server address of a node, or None — the gossiped
+        object directory's companion lookup (zero head RPCs)."""
+        e = self.entries.get(node_id_hex)
+        addr = e.get("data_addr") if e else None
+        return tuple(addr) if addr else None
 
     # ------------------------------------------------------------ routing
     def select_node(self, resources: Dict[str, float],

@@ -160,6 +160,11 @@ class SharedMemoryStore:
                               STATE_DIR, session,
                               f"spill_{self.namespace}" if self.namespace
                               else "spill"))
+        # a config-provided dir (RAY_TPU_SPILL_DIR) is typically SHARED
+        # across every node of the cluster (and may hold user data):
+        # shutdown must never sweep it — only dirs this store derived (or
+        # was handed) for itself are its to destroy
+        self._sweepable_spill = bool(spill_dir) or not _config.get("spill_dir")
         self._segments: "OrderedDict[str, shared_memory.SharedMemory]" = OrderedDict()
         self._meta_by_segment: Dict[str, ObjectMeta] = {}
         self._pinned: Dict[str, int] = {}
@@ -530,7 +535,7 @@ class SharedMemoryStore:
                 if self.on_spill is not None:
                     self.on_spill(meta)
 
-    def shutdown(self) -> None:
+    def shutdown(self, sweep_spill: bool = True) -> None:
         with self._lock:
             for shm in self._segments.values():
                 shm.close()
@@ -546,3 +551,17 @@ class SharedMemoryStore:
             except Exception:
                 pass
             self._arena = False
+        if sweep_spill and self._sweepable_spill \
+                and (self.owns_arena or self.namespace):
+            # spill files are session-scoped storage this node owns: a
+            # shut-down node must not leak them on disk forever. Callers
+            # rebuilding a store mid-session (head snapshot restore) pass
+            # sweep_spill=False — those files are the data being
+            # restored. Only the node-owning store sweeps (the head's, or
+            # a namespaced per-node store): without a namespace the dir
+            # is SHARED across the session's processes, and a single
+            # daemon's teardown must not delete its neighbors' files.
+            try:
+                _fs.rmtree(self.spill_dir)
+            except OSError:
+                pass

@@ -111,6 +111,7 @@ def _scheduler_metrics_snapshot(head) -> list:
     now = _time.time()
     local_grants, spillbacks, staleness, lag, pool_idle = [], [], [], [], []
     pool_leased = []
+    dir_staleness, node_pulls, node_pull_bytes, node_replicas = [], [], [], []
     for n in head.nodes.values():
         if n.is_head or not n.alive:
             continue
@@ -122,6 +123,12 @@ def _scheduler_metrics_snapshot(head) -> list:
         view_age = (n.gossip_health or {}).get("view_age_s", -1)
         if view_age is not None and view_age >= 0:
             lag.append((tags, view_age))
+        dir_age = (n.gossip_health or {}).get("dir_age_s", -1)
+        if dir_age is not None and dir_age >= 0:
+            dir_staleness.append((tags, dir_age))
+        node_pulls.append((tags, stats.get("object_pulls", 0)))
+        node_pull_bytes.append((tags, stats.get("object_pull_bytes", 0)))
+        node_replicas.append((tags, stats.get("replica_count", 0)))
         pool_idle.append((tags, n.pool_idle))
         pool_leased.append((tags, getattr(n, "pool_leased", 0)))
     head_tags = {"node_id": "head"}
@@ -164,6 +171,31 @@ def _scheduler_metrics_snapshot(head) -> list:
             "gossip_lag_s", "gauge",
             "Each daemon's reported age of its cached head-broadcast "
             "cluster view", lag))
+    # ---- object data plane (gossiped directory + node pull managers)
+    out.append(series(
+        "object_directory_entries", "gauge",
+        "Objects the gossiped directory can resolve to a serving node",
+        [(head_tags, len(getattr(head, "object_dir", ())))]))
+    if dir_staleness:
+        out.append(series(
+            "object_directory_staleness_s", "gauge",
+            "Each daemon's reported age of its cached gossiped object "
+            "directory (how stale peer-to-peer location knowledge is)",
+            dir_staleness))
+    if node_pulls:
+        out.append(series(
+            "node_object_pulls_total", "counter",
+            "Cross-node object pulls completed by each node daemon's "
+            "pull manager (local workers share one network crossing)",
+            node_pulls))
+        out.append(series(
+            "node_object_pull_bytes_total", "counter",
+            "Bytes pulled by each node daemon's pull manager",
+            node_pull_bytes))
+        out.append(series(
+            "node_object_replicas", "gauge",
+            "Pulled replicas each node daemon caches and advertises as "
+            "extra pull sources", node_replicas))
     return out
 
 

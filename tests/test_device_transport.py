@@ -144,3 +144,49 @@ def test_same_process_get_is_zero_copy_identity(cluster):
     assert got is x
     del ref
     gc.collect()
+
+
+def test_remat_leaf_dlpack_adoption_on_cpu():
+    """Rematerializing a pulled snapshot leaf on a CPU backend ADOPTS the
+    mapped host view via DLPack — the jax array aliases the numpy
+    buffer's memory (zero-copy), with device_put as the fallback."""
+    import jax
+
+    from ray_tpu.core import device_transport as dt
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("zero-copy adoption is the CPU-backend path")
+    # 64-byte-aligned source, like a page-aligned shm mapping
+    raw = np.zeros(4096 * 4 + 64, dtype=np.uint8)
+    off = (-raw.ctypes.data) % 64
+    src = raw[off:off + 4096 * 4].view(np.float32)
+    src[:] = np.arange(4096, dtype=np.float32)
+    with dt.rematerialize_context():
+        arr = dt._remat_leaf(src)
+    assert isinstance(arr, jax.Array)
+    np.testing.assert_array_equal(np.asarray(arr), src)
+    # zero-copy proof: the jax array reads through the SAME pages the
+    # numpy view owns (unsafe_buffer_pointer inside the exporter's range)
+    try:
+        ptr = arr.unsafe_buffer_pointer()
+    except Exception:
+        pytest.skip("backend exposes no buffer pointer")
+    assert ptr == src.ctypes.data, "DLPack adoption copied the buffer"
+
+
+def test_remat_leaf_falls_back_without_dlpack(cluster):
+    """device_dlpack=0 keeps the device_put path working unchanged."""
+    import jax
+
+    from ray_tpu.core import config as _config
+    from ray_tpu.core import device_transport as dt
+
+    _config.GLOBAL.set("device_dlpack", False)
+    try:
+        src = np.arange(64, dtype=np.float32)
+        with dt.rematerialize_context():
+            arr = dt._remat_leaf(src)
+        assert isinstance(arr, jax.Array)
+        np.testing.assert_array_equal(np.asarray(arr), src)
+    finally:
+        _config.GLOBAL._overrides.pop("device_dlpack", None)

@@ -1,0 +1,187 @@
+"""Unit tests for the gossiped object directory cache + spill lifecycle.
+
+Pure in-process tests (no cluster): the consumer-side ObjectDirectory
+record/payload semantics every party relies on, and the spill-file
+lifecycle regression (free() must delete the spill file; shutdown() must
+sweep the session spill dir)."""
+
+import os
+
+import pytest
+
+from ray_tpu.core import object_directory as objdir
+from ray_tpu.core.ids import NodeID, ObjectID
+from ray_tpu.core.store import ObjectMeta, SharedMemoryStore
+
+
+def _meta(node: NodeID, kind="shm", size=1024, segment="seg_a") -> ObjectMeta:
+    m = ObjectMeta(ObjectID.generate(), size, kind, segment=segment)
+    m.node_id = node
+    return m
+
+
+def test_seal_free_lookup_roundtrip():
+    d = objdir.ObjectDirectory()
+    node = NodeID.generate()
+    m = _meta(node)
+    d.apply({"v": 1, "delta": [objdir.seal_record(m)]})
+    assert d.lookup_meta(m.object_id) is m
+    assert d.locations(m.object_id) == [node.hex()]
+    assert d.metas_on(node.hex()) == [m]
+    d.apply({"v": 2, "delta": [objdir.free_record(m.object_id)]})
+    assert d.lookup_meta(m.object_id) is None
+    assert d.locations(m.object_id) == []
+
+
+def test_inline_and_device_records_are_ignored():
+    d = objdir.ObjectDirectory()
+    node = NodeID.generate()
+    inline = ObjectMeta(ObjectID.generate(), 10, "inline", inline=b"x" * 10)
+    device = ObjectMeta(ObjectID.generate(), 10, "device")
+    device.node_id = node
+    d.apply({"v": 1, "delta": [objdir.seal_record(inline),
+                               objdir.seal_record(device)]})
+    assert len(d) == 0
+
+
+def test_replicas_extend_locations_primary_first():
+    d = objdir.ObjectDirectory()
+    node_a, node_b = NodeID.generate(), NodeID.generate()
+    m = _meta(node_a)
+    d.apply({"v": 1, "delta": [objdir.seal_record(m)]})
+    d.apply({"v": 2, "delta": [
+        objdir.replica_record(m.object_id, node_b.hex())]})
+    locs = d.locations(m.object_id)
+    assert locs[0] == node_a.hex() and node_b.hex() in locs
+    assert d.replicas_on(node_b.hex()) == [m.object_id]
+    d.apply({"v": 3, "delta": [
+        objdir.replica_gone_record(m.object_id, node_b.hex())]})
+    assert d.locations(m.object_id) == [node_a.hex()]
+
+
+def test_node_dead_purges_primaries_and_replicas():
+    d = objdir.ObjectDirectory()
+    node_a, node_b = NodeID.generate(), NodeID.generate()
+    on_a = _meta(node_a)
+    on_b = _meta(node_b, segment="seg_b")
+    d.apply({"v": 1, "delta": [objdir.seal_record(on_a),
+                               objdir.seal_record(on_b),
+                               objdir.replica_record(on_b.object_id,
+                                                     node_a.hex())]})
+    d.apply({"v": 2, "delta": [objdir.node_dead_record(node_a.hex())]})
+    assert d.lookup_meta(on_a.object_id) is None
+    assert d.locations(on_b.object_id) == [node_b.hex()]
+
+
+def test_node_dead_keeps_entry_with_surviving_replica():
+    """Losing the primary is when replica knowledge matters most: an
+    entry with a live replica elsewhere must survive the purge."""
+    d = objdir.ObjectDirectory()
+    node_a, node_b = NodeID.generate(), NodeID.generate()
+    m = _meta(node_a)
+    d.apply({"v": 1, "delta": [
+        objdir.seal_record(m),
+        objdir.replica_record(m.object_id, node_b.hex())]})
+    d.apply({"v": 2, "delta": [objdir.node_dead_record(node_a.hex())]})
+    assert d.lookup_meta(m.object_id) is m
+    assert node_b.hex() in d.locations(m.object_id)
+    # the replica dying too finally removes the entry
+    d.apply({"v": 3, "delta": [objdir.node_dead_record(node_b.hex())]})
+    assert d.lookup_meta(m.object_id) is None
+
+
+def test_replica_gone_removes_primary_dead_entry():
+    """LRU eviction of the LAST replica of a primary-dead object must
+    delete the entry (not leave an unreachable zombie forever)."""
+    d = objdir.ObjectDirectory()
+    node_a, node_b = NodeID.generate(), NodeID.generate()
+    m = _meta(node_a)
+    d.apply({"v": 1, "delta": [
+        objdir.seal_record(m),
+        objdir.replica_record(m.object_id, node_b.hex()),
+        objdir.node_dead_record(node_a.hex())]})
+    assert d.locations(m.object_id) == [node_b.hex()]  # dead primary hidden
+    d.apply({"v": 2, "delta": [
+        objdir.replica_gone_record(m.object_id, node_b.hex())]})
+    assert d.lookup_meta(m.object_id) is None
+
+
+def test_stale_delta_dropped_full_always_wins():
+    d = objdir.ObjectDirectory()
+    node = NodeID.generate()
+    m1, m2 = _meta(node), _meta(node, segment="seg_2")
+    assert d.apply({"v": 5, "delta": [objdir.seal_record(m1)]})
+    # a replayed older batch must not re-apply
+    assert not d.apply({"v": 4, "delta": [objdir.free_record(m1.object_id)]})
+    assert d.lookup_meta(m1.object_id) is m1
+    # full resync replaces wholesale, even at the same version
+    assert d.apply({"v": 5, "full": [{"meta": m2, "replicas": []}]})
+    assert d.lookup_meta(m1.object_id) is None
+    assert d.lookup_meta(m2.object_id) is m2
+
+
+def test_spill_record_retargets_meta_and_staleness_advances():
+    d = objdir.ObjectDirectory()
+    node = NodeID.generate()
+    m = _meta(node)
+    d.apply({"v": 1, "delta": [objdir.seal_record(m)]})
+    assert d.staleness_s() >= 0.0
+    spilled = ObjectMeta(m.object_id, m.size, "spilled",
+                         spill_path="/tmp/x")
+    spilled.node_id = node
+    d.apply({"v": 2, "delta": [objdir.spill_record(spilled)]})
+    assert d.lookup_meta(m.object_id).kind == "spilled"
+    assert d.last_v == 2
+
+
+# -------------------------------------------------- spill-file lifecycle
+def test_free_spilled_object_deletes_file_and_shutdown_sweeps(tmp_path):
+    spill = str(tmp_path / "spill")
+    store = SharedMemoryStore("spilltest", capacity_bytes=1 << 20,
+                              spill_dir=spill, namespace="t1")
+    try:
+        from ray_tpu.core.serialization import serialize
+
+        # two ~600 KiB objects against a 1 MiB cap: the second put spills
+        # the first (LRU) to disk
+        blobs = [os.urandom(600 * 1024), os.urandom(600 * 1024)]
+        metas = [store.put_serialized(ObjectID.generate(), serialize(b))
+                 for b in blobs]
+        spilled = [m for m in metas if m.kind == "spilled"]
+        assert spilled, [m.kind for m in metas]
+        for m in spilled:
+            assert os.path.exists(m.spill_path)
+            store.free(m)
+            # the regression: a freed spilled object must not leak its
+            # file on disk for the session's lifetime
+            assert not os.path.exists(m.spill_path), m.spill_path
+        # leave one spilled file behind, then shutdown: the session spill
+        # dir must be swept
+        third = store.put_serialized(ObjectID.generate(),
+                                     serialize(os.urandom(600 * 1024)))
+        fourth = store.put_serialized(ObjectID.generate(),
+                                      serialize(os.urandom(600 * 1024)))
+        assert any(m.kind == "spilled" for m in (third, fourth))
+    finally:
+        store.shutdown()
+    assert not os.path.exists(spill)
+
+
+def test_shutdown_sweep_optout_preserves_spill_files(tmp_path):
+    spill = str(tmp_path / "spill2")
+    store = SharedMemoryStore("spilltest2", capacity_bytes=1 << 20,
+                              spill_dir=spill, namespace="t2")
+    from ray_tpu.core.serialization import serialize
+
+    m1 = store.put_serialized(ObjectID.generate(),
+                              serialize(os.urandom(600 * 1024)))
+    m2 = store.put_serialized(ObjectID.generate(),
+                              serialize(os.urandom(600 * 1024)))
+    spilled = [m for m in (m1, m2) if m.kind == "spilled"]
+    assert spilled
+    store.shutdown(sweep_spill=False)  # mid-session rebuild keeps data
+    for m in spilled:
+        assert os.path.exists(m.spill_path)
+    import shutil
+
+    shutil.rmtree(spill, ignore_errors=True)
