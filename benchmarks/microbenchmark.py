@@ -422,6 +422,48 @@ def data_plane(out_path: str | None = None) -> dict:
     return report
 
 
+def _serve_rows(results: dict) -> None:
+    import secrets
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class _Echo:
+        def __call__(self, request):
+            return {"ok": True}
+
+    serve.run(_Echo.bind(), route_prefix="/bench")
+    port = serve.start()
+
+    def _post(headers: dict) -> None:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/bench", data=b'{"x": 1}',
+            headers={"Content-Type": "application/json", **headers})
+        urllib.request.urlopen(req, timeout=30).read()
+
+    def untraced(n=150):
+        for _ in range(n):
+            _post({})
+        return n
+
+    def traced(n=150):
+        for _ in range(n):
+            _post({"traceparent": f"00-{secrets.token_hex(16)}-"
+                                  f"{secrets.token_hex(8)}-01"})
+        return n
+
+    phase("serve_rps")
+    results["serve_rps"] = timeit(untraced)
+    phase("serve_traced_rps")
+    results["serve_traced_rps"] = timeit(traced)
+    overhead = 1.0 - results["serve_traced_rps"] / max(results["serve_rps"],
+                                                       1e-9)
+    print(f"[microbenchmark] serve tracing overhead: {overhead:+.1%} "
+          f"(budget 10%)", file=sys.stderr, flush=True)
+    serve.shutdown()
+
+
 def control_plane(out_path: str | None = None) -> dict:
     """Just the single-stream control-plane rows (the reference-parity
     gate): emitted as a small JSON artifact that `check_regression.py`
@@ -488,6 +530,14 @@ def control_plane(out_path: str | None = None) -> dict:
 
     phase("warm_path_tasks_instrumented")
     results["warm_path_tasks_instrumented"] = timeit(warm_burst)
+
+    # serve ingress round trips, untraced vs traced (client-supplied W3C
+    # traceparent forces the full workload flight-recorder path: proxy
+    # root span -> replica execute/serve spans -> span push + live-load
+    # telemetry). The serve_traced_rps row is the regression gate that
+    # keeps tracing+telemetry overhead within the 10% budget, mirroring
+    # the warm_path_tasks_instrumented discipline.
+    _serve_rows(results)
     ray_tpu.shutdown()
 
     # control-plane robustness row: head SIGKILL → restart → all daemons

@@ -783,6 +783,15 @@ class CoreClient:
             self._connected.wait(timeout=self._reconnect_s + 5)
 
     def shutdown(self) -> None:
+        # final metrics flush BEFORE the connection closes: a short-lived
+        # worker/driver otherwise silently loses its last
+        # <metrics_push_interval_s of counter increments
+        try:
+            from ray_tpu.util import metrics as _m
+
+            _m.flush(wait=True)
+        except Exception:
+            pass
         self._closing = True
         refcount.activate(None)
 
@@ -1172,10 +1181,19 @@ class CoreClient:
             # size-aware bound: a multi-GB pull must not be abandoned at a
             # fixed wall time (the daemon would keep pulling while we
             # redundantly re-pull direct); assume a conservative 4 MiB/s
-            # floor on top of a fixed grace
-            local = await asyncio.wait_for(
-                conn.request("pull_object", meta=meta, sources=sources),
-                timeout=120 + meta.size / (4 << 20))
+            # floor on top of a fixed grace. The trace carrier rides the
+            # RPC so the daemon's pull span parents to the consuming
+            # task's context.
+            trace = _tracing.inject_context()
+            with _tracing.start_span(
+                    "object_pull",
+                    attributes={"ray_tpu.op": "object_pull",
+                                "object_id": meta.object_id.hex()[:16],
+                                "size": meta.size, "via": "node"}):
+                local = await asyncio.wait_for(
+                    conn.request("pull_object", meta=meta, sources=sources,
+                                 **({"trace": trace} if trace else {})),
+                    timeout=120 + meta.size / (4 << 20))
         except (protocol.RpcError, OSError, asyncio.TimeoutError):
             return None
         if local is None or not self._probe_readable(local):
@@ -1267,9 +1285,14 @@ class CoreClient:
                 "RAY_TPU_MAX_CONCURRENT_PULLS", "4")))
         role = "driver" if self.is_driver else "worker"
         t0 = time.perf_counter()
-        async with self._pull_sem:  # pull admission control
-            local = await object_transfer.pull_object(conn, meta, self.store,
-                                                      role=role)
+        with _tracing.start_span(
+                "object_pull",
+                attributes={"ray_tpu.op": "object_pull",
+                            "object_id": meta.object_id.hex()[:16],
+                            "size": meta.size, "via": "direct"}):
+            async with self._pull_sem:  # pull admission control
+                local = await object_transfer.pull_object(
+                    conn, meta, self.store, role=role)
         m = object_transfer._get_metrics()
         m["bytes"].inc(local.size, tags={"role": role})
         m["pulls"].inc(tags={"role": role})
@@ -1995,7 +2018,8 @@ class CoreClient:
         return conn
 
     def _fast_actor_send(self, actor_id: ActorID, method: str, payload,
-                         deps, return_id: bytes, group, cfut) -> None:
+                         deps, return_id: bytes, group, cfut,
+                         trace=None) -> None:
         """Loop-side send without coroutine overhead. Falls back to the
         retrying coroutine path on a cold/poisoned connection, and resends
         through it when a reply is lost to a dropped connection (the same
@@ -2007,21 +2031,24 @@ class CoreClient:
             # FIFO instead. The counter (not the lock state) is the
             # guard: a just-created fallback task holds no lock yet.
             self._fallback_actor_send(actor_id, method, payload, deps,
-                                      return_id, group, cfut)
+                                      return_id, group, cfut, trace)
             return
         addr = self._actor_addr_cache.get(actor_id)
         conn = self._direct.get(addr) if addr is not None else None
         if conn is None or conn.closed:
             self._fallback_actor_send(actor_id, method, payload, deps,
-                                      return_id, group, cfut)
+                                      return_id, group, cfut, trace)
             return
         try:
-            fut = conn.request_future(
-                "actor_call", actor_id=actor_id.binary(), method=method,
-                args=payload, deps=deps, return_id=return_id, group=group)
+            kw = {"actor_id": actor_id.binary(), "method": method,
+                  "args": payload, "deps": deps, "return_id": return_id,
+                  "group": group}
+            if trace is not None:
+                kw["trace"] = trace
+            fut = conn.request_future("actor_call", **kw)
         except Exception:
             self._fallback_actor_send(actor_id, method, payload, deps,
-                                      return_id, group, cfut)
+                                      return_id, group, cfut, trace)
             return
 
         def _done(f):
@@ -2032,7 +2059,7 @@ class CoreClient:
                 # have restarted elsewhere)
                 self._actor_addr_cache.pop(actor_id, None)
                 self._fallback_actor_send(actor_id, method, payload, deps,
-                                          return_id, group, cfut)
+                                          return_id, group, cfut, trace)
                 return
             if cfut.cancelled():
                 return
@@ -2046,7 +2073,7 @@ class CoreClient:
         fut.add_done_callback(_done)
 
     def _fallback_actor_send(self, actor_id, method, payload, deps,
-                             return_id, group, cfut) -> None:
+                             return_id, group, cfut, trace=None) -> None:
         """Cold/failed path: run the full retrying coroutine, chain its
         outcome into the caller's concurrent future. The pending counter
         covers the task's whole lifetime (creation through completion) so
@@ -2055,7 +2082,8 @@ class CoreClient:
         self._fallbacks_pending[actor_id] = \
             self._fallbacks_pending.get(actor_id, 0) + 1
         task = asyncio.ensure_future(self._call_actor_async(
-            actor_id, method, payload, deps, return_id, group=group))
+            actor_id, method, payload, deps, return_id, group=group,
+            trace=trace))
 
         def _chain(t):
             n = self._fallbacks_pending.get(actor_id, 1) - 1
@@ -2076,7 +2104,7 @@ class CoreClient:
 
     async def _call_actor_async(self, actor_id: ActorID, method: str,
                                 payload, deps, return_id: bytes,
-                                retries: int = 30, group=None):
+                                retries: int = 30, group=None, trace=None):
         order_lock = self._actor_order_locks.setdefault(actor_id, asyncio.Lock())
         last_err = None
         for _ in range(retries):
@@ -2087,10 +2115,12 @@ class CoreClient:
                 # reference task_submission/actor_task_submitter.h:70)
                 async with order_lock:
                     conn = await self._actor_conn(actor_id)
-                    fut = conn.request_future(
-                        "actor_call", actor_id=actor_id.binary(), method=method,
-                        args=payload, deps=deps, return_id=return_id,
-                        group=group)
+                    kw = {"actor_id": actor_id.binary(), "method": method,
+                          "args": payload, "deps": deps,
+                          "return_id": return_id, "group": group}
+                    if trace is not None:
+                        kw["trace"] = trace
+                    fut = conn.request_future("actor_call", **kw)
                 return await fut
             except (protocol.ConnectionLost, ConnectionRefusedError, OSError) as e:
                 last_err = e
@@ -2118,9 +2148,14 @@ class CoreClient:
         # flagged); the coroutine machinery is only needed for connect /
         # retry, which _fast_actor_send falls back to.
         cfut = _cf.Future()
+        # W3C context captured on the CALLING thread (the loop callback
+        # below runs without this thread's contextvars): the receiving
+        # actor opens a child execution span, so serve proxy -> replica ->
+        # nested calls stay one trace (None when tracing is off)
+        trace = _tracing.inject_context()
         self._loop_call_soon(
             self._fast_actor_send, actor_id, method, payload, deps,
-            return_id.binary(), group, cfut)
+            return_id.binary(), group, cfut, trace)
         with self._pending_lock:
             self._pending_calls[return_id] = cfut
 

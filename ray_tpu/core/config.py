@@ -192,6 +192,21 @@ FLAGS: List[Flag] = [
     Flag("flight_recorder_head_events", "RAY_TPU_FLIGHT_RECORDER_HEAD_EVENTS",
          int, 5000, "Head-side merged lease-event buffer (state API "
          "list_lease_events) and driver-side scheduling-phase buffer."),
+    Flag("tracing_head_spans", "RAY_TPU_TRACING_HEAD_SPANS", int, 20_000,
+         "Head-side buffer of finished spans pushed by every process "
+         "(workload flight recorder); timeline(format='chrome') merges "
+         "them into one cross-process trace."),
+    Flag("workload_watchdog_interval_s", "RAY_TPU_WORKLOAD_WATCHDOG_INTERVAL_S",
+         float, 5.0, "Head-side anomaly pass cadence over the merged "
+         "workload telemetry (0 disables)."),
+    Flag("workload_slow_pull_s", "RAY_TPU_WORKLOAD_SLOW_PULL_S", float, 5.0,
+         "Object pulls slower than this flag a slow_pull anomaly."),
+    Flag("workload_straggler_factor", "RAY_TPU_WORKLOAD_STRAGGLER_FACTOR",
+         float, 2.0, "A train worker whose EWMA step time exceeds this "
+         "multiple of its gang's median is flagged a straggler."),
+    Flag("serve_p99_slo_s", "RAY_TPU_SERVE_P99_SLO_S", float, 0.0,
+         "Route-level p99 latency SLO for the workload watchdog "
+         "(0 disables the slo_route anomaly)."),
     # --------------------------------------------------------------- TPU
     Flag("num_chips", "RAY_TPU_NUM_CHIPS", int, -1,
          "Override TPU chip autodetection (-1 = autodetect)."),

@@ -344,6 +344,18 @@ class Head:
         # dashboard's /api/scheduler
         self.lease_events: deque = deque(
             maxlen=_config.get("flight_recorder_head_events"))
+        # workload flight recorder: finished spans pushed by every
+        # process (metrics_push for workers/drivers, resource_view_delta
+        # gossip for daemons) keyed by span id — timeline(format="chrome")
+        # merges them into one cross-process trace
+        self.trace_spans: "OrderedDict[str, dict]" = OrderedDict()
+        # parsed copy of each _metrics KV payload, decoded ONCE at push
+        # arrival (the watchdog + /api/workloads + span extraction would
+        # otherwise re-json.loads every process's snapshot on the event
+        # loop several times per interval); entries die with their KV key
+        self._metrics_parsed: Dict[bytes, list] = {}
+        self._watchdog_state: dict = {}
+        self._anomaly_counter = None
         self.sched_totals = {"head_grants": 0, "pool_acquires": 0,
                              "pool_releases": 0, "stale_epoch_rejects": 0,
                              "reconciles": 0}
@@ -631,9 +643,15 @@ class Head:
                 # the node on disconnect)
                 import json as _json
 
-                self.kv[("_metrics",
-                         f"proc:node-{node.node_id.hex()[:12]}".encode())] = \
-                    _json.dumps(metrics).encode()
+                mkey = f"proc:node-{node.node_id.hex()[:12]}".encode()
+                self.kv[("_metrics", mkey)] = _json.dumps(metrics).encode()
+                self._metrics_parsed[mkey] = metrics
+                for fam in metrics:
+                    if fam.get("name") == "__spans__":
+                        self._adopt_spans(
+                            fam.get("series") or (),
+                            proc=f"node-{node.node_id.hex()[:12]}",
+                            node=node.node_id.hex()[:12])
             if version > node.view_version:
                 node.view_version = version
                 node.pool_idle = idle_workers
@@ -651,8 +669,24 @@ class Head:
             w = conn_state.get("worker")
             if w is None:
                 return False
-            self.kv[("_metrics",
-                     f"proc:{w.worker_id.hex()}".encode())] = value
+            import json as _json
+
+            key = f"proc:{w.worker_id.hex()}".encode()
+            self.kv[("_metrics", key)] = value
+            try:
+                payload = _json.loads(value)
+            except Exception:
+                # kv now holds the bad bytes: a stale cache entry would
+                # serve the PREVIOUS snapshot forever
+                self._metrics_parsed.pop(key, None)
+                return False
+            self._metrics_parsed[key] = payload
+            for fam in payload:
+                if fam.get("name") == "__spans__":
+                    self._adopt_spans(
+                        fam.get("series") or (),
+                        proc=w.worker_id.hex()[:12],
+                        node=w.node_id.hex()[:12] if w.node_id else None)
             return True
 
         async def pool_acquire(resources, venv_key=None, epoch=None):
@@ -2239,7 +2273,9 @@ class Head:
         # a dead process's metrics snapshot must stop being scraped — the
         # pre-fix behavior left proc:<id> keys in the _metrics namespace
         # forever, so /metrics reported gauges of processes long gone
-        self.kv.pop(("_metrics", f"proc:{w.worker_id.hex()}".encode()), None)
+        mkey = f"proc:{w.worker_id.hex()}".encode()
+        self.kv.pop(("_metrics", mkey), None)
+        self._metrics_parsed.pop(mkey, None)
         node = self.nodes.get(w.node_id)
         if node is not None:
             node.workers.discard(w.worker_id)
@@ -2399,8 +2435,9 @@ class Head:
         path (node table update + pubsub + per-worker failure handling)."""
         node.alive = False
         self.nodes.pop(node.node_id, None)
-        self.kv.pop(("_metrics",
-                     f"proc:node-{node.node_id.hex()[:12]}".encode()), None)
+        mkey = f"proc:node-{node.node_id.hex()[:12]}".encode()
+        self.kv.pop(("_metrics", mkey), None)
+        self._metrics_parsed.pop(mkey, None)
         self.lease_events.append({"ts": time.time(), "kind": "node_dead",
                                   "node_id": node.node_id.hex()})
         # its primaries and replicas are unreachable: purge every cached
@@ -2880,6 +2917,7 @@ class Head:
         # processes re-push within one metrics interval of reconnecting
         for k in [k for k in self.kv if k[0] == "_metrics"]:
             del self.kv[k]
+        self._metrics_parsed.clear()
         self._restore_runtime_env_blobs()
         self.job_counter = snap.get("job_counter", 0)
         # PGs first: restored actors may be bound to a PG bundle — without
@@ -2989,6 +3027,13 @@ class Head:
             return list(self.lease_events)
         if kind == "scheduler_stats":
             return self._scheduler_stats()
+        if kind == "trace_spans":
+            return list(self.trace_spans.values())
+        if kind == "workload_stats":
+            return self._workload_rows()
+        if kind == "serve_stats":
+            return [r for r in self._workload_rows()
+                    if str(r.get("kind", "")).startswith("serve")]
         if kind == "nodes":
             return [{"node_id": n.node_id.hex(), "resources": n.resources,
                      "available": n.available, "labels": n.labels,
@@ -3038,6 +3083,108 @@ class Head:
             **{k: v for k, v in self.sched_totals.items()},
         })
         return rows
+
+    # ------------------------------------------- workload flight recorder
+    def _adopt_spans(self, spans, proc: str, node: Optional[str]) -> None:
+        cap = max(int(_config.get("tracing_head_spans")), 2)
+        for s in spans:
+            sid = s.get("span_id")
+            if not sid:
+                continue
+            self.trace_spans[sid] = {**s, "proc": proc,
+                                     "node": node or proc}
+        while len(self.trace_spans) > cap:
+            self.trace_spans.popitem(last=False)
+
+    def _parsed_snapshots(self):
+        """(key, parsed payload) for every live _metrics KV entry, via
+        the decode-once cache (cold entries — e.g. restored from disk —
+        are parsed and cached on first read)."""
+        import json as _json
+
+        for (ns, key), value in list(self.kv.items()):
+            if ns != "_metrics":
+                continue
+            payload = self._metrics_parsed.get(key)
+            if payload is None:
+                try:
+                    payload = _json.loads(value)
+                except Exception:
+                    continue
+                self._metrics_parsed[key] = payload
+            yield key, payload
+
+    def _workload_rows(self) -> List[dict]:
+        """Live-load telemetry merged from every process's pushed/gossiped
+        `__workloads__` family (serve replicas, proxies, train workers)."""
+        rows: List[dict] = []
+        for key, payload in self._parsed_snapshots():
+            for fam in payload:
+                if fam.get("name") != "__workloads__":
+                    continue
+                for row in fam.get("series") or ():
+                    rows.append({**row, "proc": key.decode()})
+        return rows
+
+    def _metric_families(self) -> Dict[str, list]:
+        """{metric_name: [(proc, series_dict), ...]} across every pushed
+        snapshot plus the head's own registry — the watchdog's histogram
+        source."""
+        from ray_tpu.util import metrics as _metrics
+
+        fams: Dict[str, list] = {}
+        snapshots = [("head", _metrics.snapshot_all())]
+        snapshots.extend((key.decode(), payload)
+                         for key, payload in self._parsed_snapshots())
+        for proc, payload in snapshots:
+            for fam in payload:
+                name = fam.get("name", "")
+                if name.startswith("__"):
+                    continue
+                for s in fam.get("series") or ():
+                    fams.setdefault(name, []).append((proc, s))
+        return fams
+
+    async def _workload_watchdog_loop(self) -> None:
+        """Flag slow pulls / train-step stragglers / p99-over-SLO routes
+        from the merged telemetry — flight-recorder events plus
+        `workload_anomalies_total{kind}` (see core/workload_watchdog)."""
+        from ray_tpu.core import workload_watchdog
+
+        interval = float(_config.get("workload_watchdog_interval_s"))
+        if interval <= 0:
+            return
+        while not self._shutdown:
+            await asyncio.sleep(interval)
+            try:
+                anomalies, self._watchdog_state = workload_watchdog.scan(
+                    self._workload_rows(), self._metric_families(),
+                    time.time(),
+                    slow_pull_s=float(_config.get("workload_slow_pull_s")),
+                    straggler_factor=float(
+                        _config.get("workload_straggler_factor")),
+                    p99_slo_s=float(_config.get("serve_p99_slo_s")),
+                    state=self._watchdog_state)
+            except Exception:
+                continue
+            for a in anomalies:
+                self.lease_events.append(
+                    {"ts": time.time(), "kind": "workload_anomaly", **a})
+                self._count_anomaly(a.get("anomaly", "?"))
+
+    def _count_anomaly(self, kind: str) -> None:
+        try:
+            if self._anomaly_counter is None:
+                from ray_tpu.util import metrics as _metrics
+
+                self._anomaly_counter = _metrics.Counter(
+                    "workload_anomalies_total",
+                    "Workload anomalies flagged by the head watchdog "
+                    "(slow_pull | train_straggler | slo_route)",
+                    tag_keys=("kind",))
+            self._anomaly_counter.inc(tags={"kind": kind})
+        except Exception:
+            pass
 
     # --------------------------------------------------------------- server
     async def start(self, port: int = 0) -> int:
@@ -3094,6 +3241,7 @@ class Head:
         asyncio.ensure_future(self._evict_loop())
         asyncio.ensure_future(self._health_loop())
         asyncio.ensure_future(self._view_broadcast_loop())
+        asyncio.ensure_future(self._workload_watchdog_loop())
         from ray_tpu.core.job_manager import JobManager
 
         self.job_manager = JobManager(self.session, self.port)

@@ -541,13 +541,20 @@ class NodeDaemon:
             stats.update(self.pull.stats)
             stats["replica_count"] = self.pull.replica_count()
         metrics_snap = None
+        drained_spans = None
         now = time.monotonic()
         from ray_tpu.util import metrics as _metrics
+        from ray_tpu.util import tracing as _tracing
 
         if now - self._fr_metrics_ts >= _config.get(
                 "metrics_push_interval_s"):
             self._fr_metrics_ts = now
-            metrics_snap = _metrics.snapshot_all()
+            # full telemetry payload: registry snapshot + piggybacked
+            # workload stats and drained spans (same channel, zero RPCs).
+            # Spans drained explicitly so a failed/nacked delta can put
+            # them back instead of holing the cross-process timeline.
+            drained_spans = _tracing.drain_push_spans()
+            metrics_snap = _metrics.push_payload(drained_spans)
         self._last_gossip_ts = now
         try:
             fut = self.conn.request_future(
@@ -559,17 +566,30 @@ class NodeDaemon:
                 epoch=self.head_epoch, objects=dir_out or None)
         except Exception:
             self._dir_out = dir_out + self._dir_out
+            if drained_spans:
+                _tracing.requeue_push_spans(drained_spans)
             return  # events stay pending; the next heartbeat retries
 
-        def _acked(f):
+        def _acked(f, spans=drained_spans):
             if f.cancelled() or f.exception() is not None:
+                if spans:
+                    _tracing.requeue_push_spans(spans)
                 return  # still pending; resent with the next delta
             rep = f.result()
             if not isinstance(rep, dict):
+                # head replied but didn't merge (e.g. our node record is
+                # mid-reconnect): the delta's telemetry never landed —
+                # resend the spans like the failure path does
+                if spans:
+                    _tracing.requeue_push_spans(spans)
                 return
             if rep.get("nack"):
-                # stale epoch: reconciliation (already requested by the
-                # head) will refresh it; events stay pending meanwhile
+                # stale epoch: the head dropped the whole delta before
+                # the telemetry merge; reconciliation (already requested
+                # by the head) will refresh the epoch — resend the spans
+                # with a later delta like the event batch
+                if spans:
+                    _tracing.requeue_push_spans(spans)
                 return
             ack = rep.get("acked_seq", 0)
             if ack:

@@ -142,11 +142,13 @@ def make_data_handlers(get_store: Callable[[], Optional[SharedMemoryStore]],
             # (the segment mapping stays alive via the store's cache)
             return pickle.PickleBuffer(view)
 
-    async def pull_object_rpc(meta: ObjectMeta, sources=None):
+    async def pull_object_rpc(meta: ObjectMeta, sources=None, trace=None):
         """Node-level pull on behalf of a co-located worker: the daemon's
         pull manager fetches the object into the NODE store once (in-flight
         dedup + replica cache), and every local worker maps the same copy —
-        each object crosses the network once per node."""
+        each object crosses the network once per node. `trace` carries the
+        consuming task's W3C context so the daemon-side pull span joins
+        that trace."""
         manager = get_pull_manager()
         if manager is None:
             raise FileNotFoundError("no pull manager on this node")
@@ -160,8 +162,11 @@ def make_data_handlers(get_store: Callable[[], Optional[SharedMemoryStore]],
                 return meta
             except FileNotFoundError:
                 pass
-        local = await manager.pull(
-            meta, sources=[tuple(s) for s in sources or ()])
+        from ray_tpu.util import tracing
+
+        with tracing.adopt_context(trace):
+            local = await manager.pull(
+                meta, sources=[tuple(s) for s in sources or ()])
         return local
 
     async def data_ping() -> bool:
@@ -392,6 +397,17 @@ class PullManager:
         if not candidates:
             raise FileNotFoundError(
                 f"object {meta.object_id} has no known source")
+        from ray_tpu.util import tracing
+
+        with tracing.start_span(
+                "object_pull",
+                attributes={"ray_tpu.op": "object_pull",
+                            "object_id": meta.object_id.hex()[:16],
+                            "size": meta.size, "via": self.role}):
+            return await self._pull_candidates(meta, store, candidates,
+                                               sources)
+
+    async def _pull_candidates(self, meta, store, candidates, sources):
         last_exc: Optional[BaseException] = None
         t0 = time.perf_counter()
         resolved_extra = False

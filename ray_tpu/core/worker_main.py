@@ -191,10 +191,14 @@ class WorkerRuntime:
         # fetches land in the dispatch phase, not in "run".
         prof = None
         try:
-            fn = self.client.fn_manager.load(spec["fn_key"])
-            args, kwargs = self._resolve_args(spec["args"])
             from ray_tpu.util import tracing
 
+            fn = self.client.fn_manager.load(spec["fn_key"])
+            # dependency fetches land in the dispatch phase (outside the
+            # run span) but still carry the task's trace context, so
+            # object-pull spans parent to the submitting trace
+            with tracing.adopt_context(opts.get("trace_ctx")):
+                args, kwargs = self._resolve_args(spec["args"])
             if opts.get("trace_ctx"):
                 prof = {"start": time.time()}
             with tracing.execute_span(opts.get("name", "task"),
@@ -250,10 +254,11 @@ class WorkerRuntime:
                 from ray_tpu.core.runtime_env import AppliedEnv
 
                 applied = AppliedEnv(self.client, opts["runtime_env"])
-            fn = self.client.fn_manager.load(spec["fn_key"])
-            args, kwargs = self._resolve_args(spec["args"])
             from ray_tpu.util import tracing
 
+            fn = self.client.fn_manager.load(spec["fn_key"])
+            with tracing.adopt_context(opts.get("trace_ctx")):
+                args, kwargs = self._resolve_args(spec["args"])
             with tracing.execute_span(opts.get("name", "task"),
                                       opts.get("trace_ctx")):
                 result = fn(*args, **kwargs)
@@ -367,11 +372,14 @@ class WorkerRuntime:
         return True
 
     async def _on_actor_call(self, actor_id, method, args, deps, return_id,
-                             group=None):
+                             group=None, trace=None):
         loop = asyncio.get_running_loop()
         rid = ObjectID(return_id)
         gname = group or self.actor_method_groups.get(method) or DEFAULT_GROUP
         fn = getattr(self.actor_instance, method, None)
+        from ray_tpu.util import tracing
+
+        span_name = f"{type(self.actor_instance).__name__}.{method}"
 
         is_coro = self._method_is_coro.get(method)
         if is_coro is None:
@@ -384,8 +392,9 @@ class WorkerRuntime:
                 self.actor_semaphores[DEFAULT_GROUP]
             async with sem:
                 try:
-                    a, kw = await self._resolve_args_async(args)
-                    result = await fn(*a, **kw)
+                    with tracing.execute_span(span_name, trace):
+                        a, kw = await self._resolve_args_async(args)
+                        result = await fn(*a, **kw)
                     if self.actor_method_transport.get(method) == "device":
                         meta = self.client.store_device_result(rid, result)
                     else:
@@ -410,8 +419,9 @@ class WorkerRuntime:
                     f = functools.partial(exec_dag_loop, self.actor_instance)
                 else:
                     f = getattr(self.actor_instance, method)
-                a, kw = self._resolve_args(args)
-                result = f(*a, **kw)
+                with tracing.execute_span(span_name, trace):
+                    a, kw = self._resolve_args(args)
+                    result = f(*a, **kw)
                 if self.actor_method_transport.get(method) == "device":
                     # result stays on-device in this process; only the
                     # meta rides the reply (RDT tensor_transport)

@@ -17,7 +17,9 @@ _INDEX_HTML = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
 <style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
 td,th{border:1px solid #ccc;padding:4px 8px;text-align:left}</style></head>
-<body><h2>ray_tpu cluster</h2><div id="out">loading…</div>
+<body><h2>ray_tpu cluster</h2>
+<p><a href="/workloads">scheduler &amp; workloads panel</a></p>
+<div id="out">loading…</div>
 <script>
 // user-controlled strings (entrypoints, actor names) must never reach
 // innerHTML raw — that's script injection into every dashboard viewer
@@ -44,6 +46,66 @@ async function refresh(){
   for (const j of jobs.slice(0, 50))
     h += `<tr><td>${esc(j.job_id)}</td><td>${esc(j.status)}</td><td>${esc(j.entrypoint.slice(0, 60))}</td></tr>`;
   h += '</table>';
+  document.getElementById('out').innerHTML = h;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_WORKLOADS_HTML = """<!doctype html>
+<html><head><title>ray_tpu scheduler &amp; workloads</title>
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse;margin-bottom:1em}
+td,th{border:1px solid #ccc;padding:4px 8px;text-align:left}
+.anom{color:#b00}</style></head>
+<body><h2>scheduler &amp; workloads</h2><p><a href="/">cluster</a></p>
+<div id="out">loading…</div>
+<script>
+function esc(v){ const d = document.createElement('div');
+  d.textContent = String(v ?? ''); return d.innerHTML; }
+function table(title, rows, cols){
+  if (!rows || !rows.length) return `<h3>${esc(title)}</h3><p>(none)</p>`;
+  let h = `<h3>${esc(title)}</h3><table><tr>` +
+    cols.map(c => `<th>${esc(c)}</th>`).join('') + '</tr>';
+  for (const r of rows)
+    h += '<tr>' + cols.map(c => `<td>${esc(
+      typeof r[c] === 'object' ? JSON.stringify(r[c]) : r[c])}</td>`)
+      .join('') + '</tr>';
+  return h + '</table>';
+}
+async function refresh(){
+  const [sched, wl] = await Promise.all(
+    ['/api/scheduler', '/api/workloads'].map(
+      u => fetch(u).then(r => r.json())));
+  let h = table('scheduler (per-node two-level stats)',
+    sched.stats.map(s => ({node: String(s.node_id).slice(0,12),
+      head: s.is_head, alive: s.alive, idle: s.idle_workers,
+      leased: s.leased_workers, local_grants: s.local_grants,
+      spillbacks: s.spillbacks, staleness_s: s.staleness_s})),
+    ['node','head','alive','idle','leased','local_grants','spillbacks',
+     'staleness_s']);
+  h += table('recent lease events', sched.recent_events.slice(-25).reverse()
+    .map(e => ({ts: new Date(e.ts*1000).toISOString().slice(11,23),
+      kind: e.kind, node: String(e.node_id ?? '').slice(0,12)})),
+    ['ts','kind','node']);
+  h += table('serve replicas (gossiped live load)',
+    wl.serve.map(r => ({replica: r.key, ...r.stats,
+      age_s: ((Date.now()/1000) - r.ts).toFixed(1)})),
+    ['replica','deployment','queue_depth','inflight','ewma_latency_s',
+     'total','age_s']);
+  h += table('train workers (gossiped step telemetry)',
+    wl.train.map(r => ({worker: r.key, ...r.stats,
+      age_s: ((Date.now()/1000) - r.ts).toFixed(1)})),
+    ['worker','run','rank','world_size','step','last_step_s',
+     'ewma_step_s','steps_per_s','age_s']);
+  h += '<h3 class="anom">anomalies (watchdog)</h3>';
+  h += table('', wl.anomalies.slice(-25).reverse().map(a => ({
+      ts: new Date(a.ts*1000).toISOString().slice(11,23),
+      anomaly: a.anomaly,
+      detail: JSON.stringify(Object.fromEntries(Object.entries(a)
+        .filter(([k]) => !['ts','kind','anomaly'].includes(k))))})),
+    ['ts','anomaly','detail']);
+  h += `<p>${wl.trace_spans_buffered} spans buffered for
+    timeline(format="chrome")</p>`;
   document.getElementById('out').innerHTML = h;
 }
 refresh(); setInterval(refresh, 2000);
@@ -237,13 +299,8 @@ def build_app(head) -> web.Application:
     async def metrics(_req):
         from ray_tpu.util.metrics import render_prometheus, snapshot_all
 
-        snapshots = {}
-        for (ns, key), value in list(head.kv.items()):
-            if ns == "_metrics":
-                try:
-                    snapshots[key.decode()] = json.loads(value)
-                except Exception:
-                    continue
+        snapshots = {key.decode(): payload
+                     for key, payload in head._parsed_snapshots()}
         # the head's own registry (its flight-recorder RPC series) is
         # read in-process — the dashboard runs on the head's loop
         snapshots["head"] = _core_metrics_snapshot(head) + snapshot_all()
@@ -256,11 +313,31 @@ def build_app(head) -> web.Application:
         return _json({"stats": head._list_state("scheduler_stats"),
                       "recent_events": list(head.lease_events)[-200:]})
 
+    async def workloads(_req):
+        """Workload flight recorder: live serve/train load merged from
+        the gossiped/pushed telemetry + recent watchdog anomalies."""
+        rows = head._workload_rows()
+        kind = lambda r: str(r.get("kind", ""))  # noqa: E731
+        return _json({
+            "serve": [r for r in rows if kind(r).startswith("serve")],
+            "train": [r for r in rows if kind(r) == "train_worker"],
+            "other": [r for r in rows
+                      if not kind(r).startswith(("serve", "train"))],
+            "anomalies": [e for e in head.lease_events
+                          if e.get("kind") == "workload_anomaly"][-100:],
+            "trace_spans_buffered": len(head.trace_spans)})
+
+    async def workloads_page(_req):
+        return web.Response(text=_WORKLOADS_HTML, content_type="text/html")
+
     app.router.add_get("/", index)
+    app.router.add_get("/workloads", workloads_page)
     app.router.add_get("/api/cluster", cluster)
     app.router.add_get("/api/scheduler", scheduler)
+    app.router.add_get("/api/workloads", workloads)
     for kind in ("nodes", "actors", "workers", "tasks", "task_events",
-                 "lease_events", "scheduler_stats",
+                 "lease_events", "scheduler_stats", "trace_spans",
+                 "workload_stats", "serve_stats",
                  "objects", "placement_groups"):
         app.router.add_get(f"/api/{kind}", state_route(kind))
     # ------------------------------------------------------ job REST API

@@ -193,7 +193,7 @@ def cmd_timeline(args) -> None:
     _connect(args)
     import ray_tpu
 
-    events = ray_tpu.timeline(args.output)
+    events = ray_tpu.timeline(args.output, format=args.format)
     print(f"wrote {len(events)} trace events to {args.output}")
 
 
@@ -386,6 +386,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("timeline", help="dump a Chrome trace")
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    sp.add_argument("--format", default=None, choices=["chrome"],
+                    help="'chrome' writes the Trace Event Object envelope "
+                         "(Perfetto-loadable) incl. cross-process workload "
+                         "spans; default is the legacy bare array")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
 

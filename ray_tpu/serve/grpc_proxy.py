@@ -66,6 +66,10 @@ class _GrpcServer:
                 metadata = dict(handler_call_details.invocation_metadata or ())
 
                 async def call(request_bytes, context):
+                    from ray_tpu.serve.proxy import (_get_serve_metrics,
+                                                     prompt_prefix_key)
+                    from ray_tpu.util import tracing
+
                     try:
                         body = json.loads(request_bytes) if request_bytes else None
                     except json.JSONDecodeError:
@@ -83,14 +87,32 @@ class _GrpcServer:
                     req = Request("GRPC", handler_call_details.method, {},
                                   metadata, request_bytes, body)
                     model_id = metadata.get("serve_multiplexed_model_id")
-                    from ray_tpu.serve.proxy import prompt_prefix_key
-
+                    # root span per RPC, honoring a W3C traceparent riding
+                    # the invocation metadata (same contract as HTTP)
+                    tp = metadata.get("traceparent")
+                    t0 = time.perf_counter()
+                    code = "OK"
                     try:
-                        result = await router.submit(
-                            "__call__", (req,), {}, model_id=model_id,
-                            prefix_key=prompt_prefix_key(body))
+                        with tracing.request_span(
+                                "grpc.request",
+                                {"traceparent": tp} if tp else None,
+                                attributes={"ray_tpu.op": "serve_request",
+                                            "rpc.method":
+                                                handler_call_details.method,
+                                            "rpc.app": dep}):
+                            result = await router.submit(
+                                "__call__", (req,), {}, model_id=model_id,
+                                prefix_key=prompt_prefix_key(body))
                     except Exception as e:  # surface detail like HTTP's 500
+                        code = "INTERNAL"
                         await context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                    finally:
+                        try:
+                            _get_serve_metrics()["request_seconds"].observe(
+                                time.perf_counter() - t0,
+                                tags={"route": f"grpc:{dep}", "code": code})
+                        except Exception:
+                            pass
                     if isinstance(result, bytes):
                         return result
                     return json.dumps(result, default=str).encode()

@@ -19,6 +19,10 @@ from ray_tpu.core.api import _global_client
 
 ROUTING_TABLE_REFRESH_S = 1.0
 
+import contextlib as _contextlib
+
+_NULL_CM = _contextlib.nullcontext()
+
 
 class DeploymentResponse:
     """Future-like wrapper over the result ObjectRef."""
@@ -77,12 +81,23 @@ class DeploymentHandle:
         return self._submit(self._method_name, args, kwargs)
 
     def _submit(self, method: str, args, kwargs) -> DeploymentResponse:
+        from ray_tpu.util import tracing
+
         replica_tag, handle = self._pick_replica()
         if self._model_id:
             kwargs = {**kwargs, "_multiplexed_model_id": self._model_id}
         with self._lock:
             self._inflight[replica_tag] = self._inflight.get(replica_tag, 0) + 1
-        ref = handle.handle_request.remote(method, args, kwargs)
+        # submission span (only when the caller traces): the replica-side
+        # execute span parents to it, so handle routing decisions are
+        # visible inside the request's trace
+        span_cm = (tracing.start_span(
+            f"serve.handle.{self.deployment_name}",
+            attributes={"ray_tpu.op": "serve_handle",
+                        "replica": replica_tag, "method": method})
+            if tracing.is_recording() else _NULL_CM)
+        with span_cm:
+            ref = handle.handle_request.remote(method, args, kwargs)
 
         def _done():
             with self._lock:

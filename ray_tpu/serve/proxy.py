@@ -19,6 +19,25 @@ import ray_tpu
 
 ROUTE_REFRESH_S = 1.0
 
+# ------------------------------------------------------- serve telemetry
+_serve_metrics = None
+
+
+def _get_serve_metrics():
+    """Lazy per-process serve metrics (proxy + gRPC ingress share them);
+    they ride the ordinary metrics pusher to the head's /metrics."""
+    global _serve_metrics
+    if _serve_metrics is None:
+        from ray_tpu.util import metrics as m
+
+        _serve_metrics = {
+            "request_seconds": m.Histogram(
+                "serve_request_seconds",
+                "Ingress request latency by matched route and status code",
+                tag_keys=("route", "code")),
+        }
+    return _serve_metrics
+
 
 class Request:
     """What a deployment callable receives for an HTTP request."""
@@ -152,11 +171,17 @@ class _AsyncRouter:
         self._inflight[tag] = self._inflight.get(tag, 0) + 1
         try:
             # .remote() can block on the head for large payloads (object
-            # registration); keep it off the event loop
+            # registration); keep it off the event loop. The contextvars
+            # copy carries the request's root span into the executor
+            # thread, where call_actor injects it toward the replica.
+            import contextvars
+
             loop = asyncio.get_running_loop()
+            ctx = contextvars.copy_context()
             ref = await loop.run_in_executor(
-                None, lambda: handle.handle_request.remote(
-                    method, args, kwargs))
+                None, lambda: ctx.run(
+                    lambda: handle.handle_request.remote(
+                        method, args, kwargs)))
             return await ref
         finally:
             self._inflight[tag] = max(0, self._inflight.get(tag, 1) - 1)
@@ -202,6 +227,34 @@ class ProxyActor:
         self._routes_ts = now
 
     async def _handle(self, request):
+        """Telemetry wrapper: one root span per request (honoring an
+        incoming W3C `traceparent`, so a client-supplied trace id follows
+        the request into the replica) + `serve_request_seconds` by
+        matched route and status code."""
+        from ray_tpu.util import tracing
+
+        t0 = time.perf_counter()
+        tp = request.headers.get("traceparent")
+        with tracing.request_span(
+                "http.request",
+                {"traceparent": tp} if tp else None,
+                attributes={"ray_tpu.op": "serve_request",
+                            "http.method": request.method,
+                            "http.path": "/" + request.match_info["tail"]}
+                ) as span:
+            resp = await self._handle_routed(request)
+            if span is not None:
+                span.attributes["http.status"] = resp.status
+        route = request.get("rtpu_route") or "(no_route)"
+        try:
+            _get_serve_metrics()["request_seconds"].observe(
+                time.perf_counter() - t0,
+                tags={"route": route, "code": str(resp.status)})
+        except Exception:
+            pass
+        return resp
+
+    async def _handle_routed(self, request):
         from aiohttp import web
 
         await self._refresh_routes()
@@ -221,6 +274,7 @@ class ProxyActor:
         if match is None:
             return web.json_response({"error": f"no route for {path}"},
                                      status=404)
+        request["rtpu_route"] = match
         deployment = self._routes[match]
         router = self._routers.get(deployment)
         if router is None:
@@ -300,6 +354,13 @@ class ProxyActor:
                 # chunk["text"] is the server-computed DELTA (derived from
                 # a cumulative decode, so multi-byte chars never split)
                 delta_text = chunk["text"]
+                if not delta_text and not done:
+                    # tokens arrived but decoded to nothing yet (the
+                    # server holds back a partial multi-byte char): the
+                    # text rides the next decodable delta, so emitting an
+                    # empty chunk here is pure noise — and makes the
+                    # first-chunk-has-content property timing-dependent
+                    continue
                 finish = chunk.get("finish_reason") if done else None
                 if chat:
                     payload = {

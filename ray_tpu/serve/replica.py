@@ -32,8 +32,12 @@ class ReplicaActor:
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
         self._total = 0
+        self._executing = 0
+        self._latency_samples = 0
+        self._ewma_latency_s = 0.0
         self._healthy = True
         self._draining = False
+        self._metrics = None
         if isinstance(cls_or_fn, type):
             self.callable = cls_or_fn(*(init_args or ()), **(init_kwargs or {}))
         else:
@@ -54,8 +58,10 @@ class ReplicaActor:
         with self._ongoing_lock:
             self._ongoing += 1
             self._total += 1
+        t0 = time.perf_counter()
         try:
             from ray_tpu.serve import multiplex
+            from ray_tpu.util import tracing
 
             if model_id is not None:
                 multiplex._set_request_model_id(model_id)
@@ -66,10 +72,70 @@ class ReplicaActor:
                       else None)
             if target is None or method != "__call__":
                 target = getattr(self.callable, method)
-            return target(*args, **kwargs)
+            # child of the actor-call execute span (which carried the
+            # proxy's root context across the process boundary)
+            with tracing.start_span(
+                    "serve.replica",
+                    attributes={"ray_tpu.op": "serve_replica",
+                                "deployment": self.deployment_name,
+                                "replica": self.replica_tag,
+                                "method": method}):
+                with self._ongoing_lock:
+                    self._executing += 1
+                try:
+                    return target(*args, **kwargs)
+                finally:
+                    with self._ongoing_lock:
+                        self._executing -= 1
         finally:
+            dur = time.perf_counter() - t0
             with self._ongoing_lock:
                 self._ongoing -= 1
+                # EWMA over the last ~10 requests: the live-load signal
+                # routers and the head watchdog read. Seeded on the first
+                # completed SAMPLE (a cold burst of N concurrent firsts
+                # must not seed at ~dur/N via an admissions count)
+                self._latency_samples += 1
+                self._ewma_latency_s = (
+                    dur if self._latency_samples == 1
+                    else 0.9 * self._ewma_latency_s + 0.1 * dur)
+            self._publish_load(dur)
+
+    def _publish_load(self, last_latency_s: float) -> None:
+        """Queue depth / in-flight / EWMA latency, published two ways on
+        the SAME existing telemetry channel (the per-process metrics
+        push — zero new RPCs): gauges for `/metrics` and a workload row
+        the head merges into `state.list_serve_stats()` and
+        `GET /api/workloads`."""
+        try:
+            from ray_tpu.util import metrics as m
+
+            if self._metrics is None:
+                tags = ("deployment", "replica")
+                self._metrics = {
+                    "queue": m.Gauge(
+                        "serve_replica_queue_depth",
+                        "Requests admitted to the replica and not yet "
+                        "finished (executing + waiting)", tag_keys=tags),
+                    "inflight": m.Gauge(
+                        "serve_replica_inflight",
+                        "Requests currently inside user code on the "
+                        "replica", tag_keys=tags),
+                }
+            tags = {"deployment": self.deployment_name,
+                    "replica": self.replica_tag}
+            self._metrics["queue"].set(self._ongoing, tags=tags)
+            self._metrics["inflight"].set(self._executing, tags=tags)
+            m.publish_workload("serve_replica", self.replica_tag, {
+                "deployment": self.deployment_name,
+                "queue_depth": self._ongoing,
+                "inflight": self._executing,
+                "ewma_latency_s": round(self._ewma_latency_s, 6),
+                "last_latency_s": round(last_latency_s, 6),
+                "total": self._total,
+            })
+        except Exception:
+            pass
 
     def _report_models(self, model_ids):
         """Push the loaded-model set so routers prefer warm replicas."""

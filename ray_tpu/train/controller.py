@@ -227,7 +227,8 @@ class TrainControllerLogic:
             self.state = "RESIZING"
         self.current_world_size = size
         return WorkerGroup(scaling, label_selector=label_selector,
-                           placement_group=pg, generation=self.generation)
+                           placement_group=pg, generation=self.generation,
+                           run_name=self._run_name)
 
     def _resume_checkpoint(self) -> Optional[Checkpoint]:
         # the run's OWN latest checkpoint wins over the user-supplied

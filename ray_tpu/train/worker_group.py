@@ -31,8 +31,10 @@ class TrainWorker:
     def setup_and_start(self, train_fn, train_config, rank, world_size,
                         local_rank, node_rank, resume_checkpoint_path,
                         backend_env: Optional[Dict[str, str]] = None,
-                        generation: int = 0):
+                        generation: int = 0, run_name: Optional[str] = None):
         import os
+
+        from ray_tpu.util import tracing
 
         if backend_env:
             os.environ.update(backend_env)
@@ -42,22 +44,38 @@ class TrainWorker:
         self._ctx = session_lib.TrainContext(
             rank=rank, world_size=world_size, local_rank=local_rank,
             node_rank=node_rank, resume_checkpoint=resume,
-            generation=generation)
+            generation=generation, run_name=run_name)
+        # this actor call's execute span carries the driver's trace when
+        # the driver traces: capture it NOW (the train thread outlives the
+        # call) so per-step spans join the run's trace
+        carrier = tracing.inject_context()
 
         def _run():
             session_lib._set_context(self._ctx)
             try:
-                if train_config is None:
-                    train_fn()
-                else:
-                    train_fn(train_config)
+                with tracing.adopt_context(carrier):
+                    if train_config is None:
+                        train_fn()
+                    else:
+                        train_fn(train_config)
             except StopIteration:
                 pass
             except BaseException:
                 self._error = traceback.format_exc()
             finally:
-                self._done = True
                 session_lib._set_context(None)
+                try:
+                    # the controller kills this actor shortly after it
+                    # polls done — flush synchronously BEFORE raising
+                    # _done so the final steps' spans/telemetry provably
+                    # beat the kill (the periodic pusher's next tick, or
+                    # a post-done flush, would race it)
+                    from ray_tpu.util import metrics as _m
+
+                    _m.flush(wait=True)
+                except Exception:
+                    pass
+                self._done = True
 
         self._thread = threading.Thread(target=_run, daemon=True,
                                         name=f"train-rank{rank}")
@@ -133,8 +151,10 @@ class WorkerGroup:
     """
 
     def __init__(self, scaling_config, label_selector: Optional[dict] = None,
-                 placement_group=None, generation: int = 0):
+                 placement_group=None, generation: int = 0,
+                 run_name: Optional[str] = None):
         self.scaling = scaling_config
+        self.run_name = run_name
         self.label_selector = label_selector
         self.placement_group = placement_group
         self.generation = generation
@@ -163,7 +183,7 @@ class WorkerGroup:
             starts.append(w.setup_and_start.remote(
                 train_fn, train_config, rank, n, 0, rank,
                 resume_checkpoint.path if resume_checkpoint else None,
-                backend_envs[rank], self.generation))
+                backend_envs[rank], self.generation, self.run_name))
         ray_tpu.get(starts, timeout=120)
         # node placement, recorded for the controller's death watch
         # (a node_state DEAD event for any of these hosts fails the

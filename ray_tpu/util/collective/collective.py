@@ -160,23 +160,42 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 
 # --------------------------------------------------------------- collectives
+def _op_span(op_name: str, group_name: str):
+    """Child span for one collective op when the calling context traces
+    (the span joins the consuming task's/train step's trace); a cheap
+    nullcontext otherwise — the warm path pays one is_enabled() check."""
+    import contextlib
+
+    from ray_tpu.util import tracing
+
+    if not tracing.is_recording():
+        return contextlib.nullcontext()
+    return tracing.start_span(
+        f"collective.{op_name}",
+        attributes={"ray_tpu.op": "collective", "group": group_name})
+
+
 def allreduce(tensor, op: ReduceOp = ReduceOp.SUM,
               group_name: str = "default"):
-    return _get_group(group_name).allreduce(tensor, op)
+    with _op_span("allreduce", group_name):
+        return _get_group(group_name).allreduce(tensor, op)
 
 
 def reduce(tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM,
            group_name: str = "default"):
-    return _get_group(group_name).reduce(tensor, dst_rank, op)
+    with _op_span("reduce", group_name):
+        return _get_group(group_name).reduce(tensor, dst_rank, op)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return _get_group(group_name).broadcast(tensor, src_rank)
+    with _op_span("broadcast", group_name):
+        return _get_group(group_name).broadcast(tensor, src_rank)
 
 
 def allgather(tensor_list: Optional[list], tensor, group_name: str = "default"):
     """Reference signature: fills tensor_list with world_size tensors."""
-    parts = _get_group(group_name).allgather(tensor)
+    with _op_span("allgather", group_name):
+        parts = _get_group(group_name).allgather(tensor)
     if tensor_list is not None:
         tensor_list[:] = parts
     return parts
@@ -184,11 +203,13 @@ def allgather(tensor_list: Optional[list], tensor, group_name: str = "default"):
 
 def reducescatter(tensor, op: ReduceOp = ReduceOp.SUM,
                   group_name: str = "default"):
-    return _get_group(group_name).reducescatter(tensor, op)
+    with _op_span("reducescatter", group_name):
+        return _get_group(group_name).reducescatter(tensor, op)
 
 
 def barrier(group_name: str = "default") -> None:
-    _get_group(group_name).barrier()
+    with _op_span("barrier", group_name):
+        _get_group(group_name).barrier()
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:

@@ -26,8 +26,19 @@ _pusher: Optional[threading.Thread] = None
 _pusher_stop = threading.Event()
 _pusher_enabled = True
 
+# sub-millisecond leading buckets: warm-path RPCs and span latencies sit
+# well under 1 ms on localhost — without them every warm observation
+# landed in one bucket and p50/p99 were indistinguishable
 DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.0001, 0.00025, 0.0005,
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0]
+
+# process-local live-load telemetry (serve replicas: queue depth /
+# in-flight / EWMA latency; train workers: step time / throughput).
+# Rides the SAME channel as metric snapshots — the pusher for processes
+# with a CoreClient, resource_view_delta gossip for node daemons — so
+# live load reaches the head with zero new RPC channels.
+_WORKLOADS: Dict[Tuple[str, str], dict] = {}
 
 
 class Metric:
@@ -117,6 +128,22 @@ class Histogram(Metric):
                     for k, v in self._hseries.items()]
 
 
+# ------------------------------------------------------ workload telemetry
+def publish_workload(kind: str, key: str, stats: Dict[str, object]) -> None:
+    """Publish one workload's live-load dict (e.g. kind="serve_replica",
+    key=replica_tag). Overwrites the previous value — this is a gauge-like
+    snapshot, not an event stream; the head merges the latest copy into
+    `state.list_serve_stats()` / `GET /api/workloads`."""
+    with _LOCK:
+        _WORKLOADS[(kind, key)] = {"kind": kind, "key": key,
+                                   "stats": dict(stats), "ts": time.time()}
+
+
+def workload_snapshot() -> List[dict]:
+    with _LOCK:
+        return [dict(v) for v in _WORKLOADS.values()]
+
+
 # ------------------------------------------------------------------ export
 def snapshot_all() -> List[dict]:
     with _LOCK:
@@ -125,7 +152,31 @@ def snapshot_all() -> List[dict]:
              "series": m._snapshot()} for m in metrics]
 
 
-def _push_once() -> bool:
+def push_payload(spans: Optional[List[dict]] = None) -> List[dict]:
+    """Full telemetry payload for one push/gossip tick: the metric
+    registry snapshot plus two reserved families (names starting "__",
+    skipped by the Prometheus renderer) — live workload stats and the
+    finished-span batch for the head's cross-process trace buffer.
+
+    Callers that can detect a failed send should drain spans themselves
+    and pass them in, so they can `tracing.requeue_push_spans` on
+    failure instead of silently losing the batch."""
+    payload = snapshot_all()
+    wl = workload_snapshot()
+    if wl:
+        payload.append({"name": "__workloads__", "kind": "workload",
+                        "description": "", "series": wl})
+    if spans is None:
+        from ray_tpu.util import tracing
+
+        spans = tracing.drain_push_spans()
+    if spans:
+        payload.append({"name": "__spans__", "kind": "spans",
+                        "description": "", "series": spans})
+    return payload
+
+
+def _push_once(wait: bool = False) -> bool:
     from ray_tpu.core import api as core_api
 
     if not core_api.is_initialized():
@@ -140,12 +191,40 @@ def _push_once() -> bool:
         # loses the old round trip's failure signal, so surface the one
         # observable failure mode — a dead head connection — explicitly.
         conn = getattr(client, "conn", None)
-        if conn is not None and conn.closed:
+        if conn is None or conn.closed:
             return False
-        client.head_push("metrics_push",
-                         value=json.dumps(snapshot_all()).encode())
+    except Exception:
+        return False
+    from ray_tpu.util import tracing
+
+    spans = tracing.drain_push_spans()
+    try:
+        value = json.dumps(push_payload(spans)).encode()
+        if wait:
+            # final flush before the connection closes: a push written
+            # just before close can die to a TCP RST (an unread inbound
+            # broadcast in our receive buffer at close() turns the FIN
+            # into RST, and the head discards undelivered frames) — one
+            # shutdown-time round trip guarantees the head PROCESSED the
+            # last snapshot/spans before we hang up. Bounded: a head
+            # that is ALREADY gone must not stall shutdown behind the
+            # reconnect window.
+            import asyncio as _asyncio
+
+            fut = _asyncio.run_coroutine_threadsafe(
+                conn.request("metrics_push", value=value), client.loop)
+            try:
+                fut.result(timeout=5)
+            except BaseException:
+                fut.cancel()
+                raise
+        else:
+            client.head_push("metrics_push", value=value)
         return True
     except Exception:
+        # transient head outage: the batch rides the next push instead
+        # of silently holing the cross-process timeline
+        tracing.requeue_push_spans(spans)
         return False
 
 
@@ -188,9 +267,11 @@ def stop_pusher() -> None:
         thread.join(timeout=2)
 
 
-def flush() -> bool:
-    """Push this process's metrics to the head immediately."""
-    return _push_once()
+def flush(wait: bool = False) -> bool:
+    """Push this process's metrics to the head immediately. `wait=True`
+    turns it into a round trip (used once at shutdown so the final
+    snapshot provably lands before the connection closes)."""
+    return _push_once(wait=wait)
 
 
 # -------------------------------------------------- Prometheus text format
@@ -219,6 +300,8 @@ def render_prometheus(snapshots: Dict[str, List[dict]]) -> str:
     families: Dict[str, dict] = {}
     for proc, metrics in sorted(snapshots.items()):
         for m in metrics:
+            if m["name"].startswith("__"):
+                continue  # reserved piggyback families (workloads, spans)
             fam = families.setdefault(
                 m["name"], {"kind": m["kind"],
                             "description": m["description"], "samples": []})

@@ -10,8 +10,11 @@ from ray_tpu.util.state.api import (get_actor, get_placement_group, list_actors,
                                     list_lease_events, list_nodes,
                                     list_objects,
                                     list_placement_groups,
-                                    list_scheduler_stats, list_task_events,
-                                    list_tasks, list_workers, summarize_actors,
+                                    list_scheduler_stats, list_serve_stats,
+                                    list_task_events,
+                                    list_tasks, list_trace_spans,
+                                    list_workers, list_workload_stats,
+                                    summarize_actors,
                                     summarize_objects, summarize_tasks)
 
 __all__ = [
@@ -19,6 +22,8 @@ __all__ = [
     "get_actor", "get_placement_group", "list_actors", "list_lease_events",
     "list_nodes",
     "list_objects", "list_placement_groups", "list_scheduler_stats",
-    "list_task_events", "list_tasks",
-    "list_workers", "summarize_actors", "summarize_objects", "summarize_tasks",
+    "list_serve_stats",
+    "list_task_events", "list_tasks", "list_trace_spans",
+    "list_workers", "list_workload_stats",
+    "summarize_actors", "summarize_objects", "summarize_tasks",
 ]

@@ -94,6 +94,32 @@ def list_scheduler_stats(filters=None, limit=None) -> List[dict]:
     return _list("scheduler_stats", filters, limit)
 
 
+def list_serve_stats(filters=None, limit=None) -> List[dict]:
+    """Live serve load merged at the head from telemetry piggybacked on
+    the existing metrics-push/gossip channel (zero new RPCs): one row per
+    replica (kind=serve_replica: queue_depth, inflight, ewma_latency_s,
+    total) plus any serve-scoped rows other publishers add. Row keys:
+    kind, key, stats, ts, proc."""
+    return _list("serve_stats", filters, limit)
+
+
+def list_workload_stats(filters=None, limit=None) -> List[dict]:
+    """Every workload telemetry row the head has merged — serve replicas
+    AND train workers (kind=train_worker: step, last_step_s,
+    ewma_step_s, steps_per_s per rank). Superset of
+    `list_serve_stats`."""
+    return _list("workload_stats", filters, limit)
+
+
+def list_trace_spans(filters=None, limit=None) -> List[dict]:
+    """Finished spans pushed by every process (workload flight
+    recorder), tagged with proc/node — `ray_tpu.timeline(
+    format="chrome")` merges them into one cross-process trace. Row
+    keys: name, trace_id, span_id, parent_id, start_ts, end_ts,
+    attributes, proc, node."""
+    return _list("trace_spans", filters, limit)
+
+
 def get_actor(actor_id: str) -> Optional[dict]:
     rows = list_actors(filters=[("actor_id", "=", actor_id)])
     return rows[0] if rows else None

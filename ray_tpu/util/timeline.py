@@ -132,8 +132,97 @@ def _reconcile_phase_events(trace: List[dict]) -> None:
                       "args": {"node_id": start.get("node_id")}})
 
 
-def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Build Chrome trace events; write to `filename` if given."""
+def _workload_span_events(trace: List[dict]) -> None:
+    """Merge the workload flight recorder's spans — serve request spans,
+    replica/actor execution, object pulls, collective ops, train steps —
+    into the trace: the driver's own finished spans plus every span other
+    processes pushed to the head, deduped by span id. pid = node,
+    tid = process; Chrome flow arrows (`s`/`f`, keyed by the child span
+    id) connect parent→child across process boundaries so one request's
+    proxy → replica → task path reads as one connected lane."""
+    spans = {}
+    # label local spans exactly as the head labels this process's pushed
+    # copies, and let head copies win on overlap — otherwise one process
+    # renders as two lanes ("driver" + its worker id) with false
+    # cross-process flow arrows between them
+    proc = node = "driver"
+    try:
+        from ray_tpu.core.api import _global_client, is_initialized
+
+        if is_initialized():
+            client = _global_client()
+            proc = client.worker_id.hex()[:12]
+            nid = (client.node_info or {}).get("node_id")
+            if nid is not None:
+                node = nid.hex()[:12]
+    except Exception:
+        pass
+    try:
+        from ray_tpu.util import tracing
+
+        for s in tracing.get_finished_spans():
+            spans[s.span_id] = {**s.to_dict(), "proc": proc, "node": node}
+    except Exception:
+        pass
+    try:
+        from ray_tpu.util.state import list_trace_spans
+
+        for row in list_trace_spans():
+            if row.get("span_id"):
+                spans[row["span_id"]] = row
+    except Exception:
+        pass
+
+    def _pid(sd):
+        return sd.get("node") or sd.get("proc") or "?"
+
+    def _tid(sd):
+        return sd.get("proc") or "?"
+
+    for sd in spans.values():
+        start, end = sd.get("start_ts"), sd.get("end_ts")
+        if not start:
+            continue
+        trace.append({
+            "name": sd.get("name", "span"), "cat": "span", "ph": "X",
+            "ts": start * 1e6,
+            "dur": max((end or start) - start, 1e-7) * 1e6,
+            "pid": _pid(sd), "tid": _tid(sd),
+            "args": {"trace_id": sd.get("trace_id"),
+                     "span_id": sd.get("span_id"),
+                     "parent_id": sd.get("parent_id"),
+                     **(sd.get("attributes") or {})},
+        })
+    # flow arrows only where BOTH ends exist (every flow event must pair)
+    for sd in spans.values():
+        parent = spans.get(sd.get("parent_id"))
+        if parent is None or not sd.get("start_ts") \
+                or not parent.get("start_ts"):
+            continue
+        if _pid(parent) == _pid(sd) and _tid(parent) == _tid(sd):
+            continue  # same lane: nesting is already visible
+        common = {"cat": "span-flow", "name": "trace-flow",
+                  "id": sd["span_id"]}
+        trace.append({**common, "ph": "s", "pid": _pid(parent),
+                      "tid": _tid(parent),
+                      "ts": parent["start_ts"] * 1e6})
+        trace.append({**common, "ph": "f", "bp": "e", "pid": _pid(sd),
+                      "tid": _tid(sd),
+                      "ts": max(sd["start_ts"], parent["start_ts"]) * 1e6})
+
+
+def timeline(filename: Optional[str] = None, *,
+             format: Optional[str] = None) -> List[dict]:
+    """Build Chrome trace events; write to `filename` if given.
+
+    `format="chrome"` writes the JSON *Object* envelope
+    (`{"traceEvents": [...]}`) that Perfetto/chrome://tracing load
+    directly; the default (legacy) writes the bare event array. Both
+    carry the same merged content: task phases, driver scheduling
+    phases, head-reconcile windows, and the workload flight recorder's
+    cross-process spans (serve requests, replica execution, object
+    pulls, collective ops, train steps) with flow arrows across
+    processes."""
     from ray_tpu.util.state import list_task_events
 
     events = list_task_events()
@@ -169,7 +258,10 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
                       "args": {"task_id": task_id}})
     _sched_phase_events(trace)
     _reconcile_phase_events(trace)
+    _workload_span_events(trace)
     if filename:
+        payload = ({"traceEvents": trace, "displayTimeUnit": "ms"}
+                   if format == "chrome" else trace)
         with open(filename, "w") as f:
-            json.dump(trace, f)
+            json.dump(payload, f)
     return trace

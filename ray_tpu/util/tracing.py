@@ -29,6 +29,11 @@ from typing import Dict, List, Optional
 _enabled = False
 _lock = threading.Lock()
 _finished: List["Span"] = []
+# spans waiting to ride the next metrics push to the head (workload
+# tracing: the head accumulates every process's spans so timeline() can
+# merge one cross-process trace) — bounded separately from _finished
+_push_queue: List[dict] = []
+_dropped_counter = None
 _exporter = None
 _current: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "ray_tpu_span", default=None)
@@ -51,6 +56,15 @@ class Span:
     def traceparent(self) -> str:
         return f"00-{self.trace_id}-{self.span_id}-01"
 
+    def to_dict(self) -> dict:
+        """JSON-safe form (rides the metrics push to the head)."""
+        attrs = {k: (v if isinstance(v, (str, int, float, bool)) else str(v))
+                 for k, v in self.attributes.items()}
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "start_ts": self.start_ts, "end_ts": self.end_ts,
+                "attributes": attrs}
+
 
 def enable_tracing(exporter=None) -> None:
     """Turn tracing on (idempotent). `exporter`: optional object with
@@ -72,6 +86,14 @@ def current_span() -> Optional[Span]:
     return _current.get()
 
 
+def is_recording() -> bool:
+    """True when a span opened now would record: tracing is enabled
+    process-wide, or we are inside an active trace context (a remote
+    caller's context adopted per-request — the OTel sampling model:
+    the root decides, children follow the parent)."""
+    return is_enabled() or _current.get() is not None
+
+
 def get_finished_spans(clear: bool = False) -> List[Span]:
     with _lock:
         out = list(_finished)
@@ -84,16 +106,29 @@ def get_finished_spans(clear: bool = False) -> List[Span]:
 def start_span(name: str, *, carrier: Optional[Dict[str, str]] = None,
                attributes: Optional[dict] = None):
     """Open a span as current; parents to `carrier` (W3C traceparent dict)
-    if given, else to the current in-process span."""
-    if not is_enabled():
+    if given, else to the current in-process span.
+
+    Records when tracing is enabled process-wide, OR when a parent
+    context exists (a carrier, or an in-process current span): a traced
+    request's children record in every process it crosses without
+    flipping any process-wide switch — per-request tracing stays
+    per-request."""
+    parent_trace = parent_span = None
+    carrier_sampled = False
+    if carrier and "traceparent" in carrier:
+        # strict parse: a malformed header (LBs and APM agents inject
+        # these freely) must NOT force recording, and neither must a
+        # valid one whose W3C sampled flag is 00
+        try:
+            _, t, s, flags = carrier["traceparent"].split("-")
+        except ValueError:
+            t = s = flags = None
+        if t and len(t) == 32 and s and len(s) == 16:
+            parent_trace, parent_span = t, s
+            carrier_sampled = flags != "00"
+    if not (is_enabled() or _current.get() is not None or carrier_sampled):
         yield None
         return
-    parent_trace = parent_span = None
-    if carrier and "traceparent" in carrier:
-        try:
-            _, parent_trace, parent_span, _ = carrier["traceparent"].split("-")
-        except ValueError:
-            parent_trace = None
     if parent_trace is None:
         cur = _current.get()
         if cur is not None:
@@ -111,12 +146,19 @@ def start_span(name: str, *, carrier: Optional[Dict[str, str]] = None,
         _current.reset(token)
         span.end_ts = time.time()
         cap = max(int(_config.get("tracing_buffer_spans")), 2)
+        dropped = 0
         with _lock:
             _finished.append(span)
             if len(_finished) > cap:
                 # drop the oldest half: amortized O(1) per span, and the
                 # newest spans are the ones a live debugging session needs
                 del _finished[:cap // 2]
+            _push_queue.append(span.to_dict())
+            if len(_push_queue) > cap:
+                dropped = cap // 2
+                del _push_queue[:dropped]
+        if dropped:
+            _count_dropped(dropped)
         if _exporter is not None:
             try:
                 _exporter.export([span])
@@ -124,10 +166,77 @@ def start_span(name: str, *, carrier: Optional[Dict[str, str]] = None,
                 pass
 
 
+def _count_dropped(n: int) -> None:
+    """Spans dropped before reaching the head are invisible losses unless
+    counted — `trace_spans_dropped_total` makes the budget observable."""
+    global _dropped_counter
+    try:
+        if _dropped_counter is None:
+            from ray_tpu.util import metrics as _m
+
+            _dropped_counter = _m.Counter(
+                "trace_spans_dropped_total",
+                "Finished spans dropped from the push buffer before the "
+                "head could collect them (raise tracing_buffer_spans)")
+        _dropped_counter.inc(n)
+    except Exception:
+        pass
+
+
+def drain_push_spans(limit: int = 512) -> List[dict]:
+    """Pop up to `limit` finished-span dicts for the metrics push (the
+    head accumulates them for cross-process timeline export)."""
+    with _lock:
+        out = _push_queue[:limit]
+        del _push_queue[:limit]
+    return out
+
+
+def requeue_push_spans(spans: List[dict]) -> None:
+    """Put drained spans back after a failed push so a transient head
+    outage doesn't silently hole the cross-process timeline; overflow
+    (oldest first) is counted as dropped like any other loss."""
+    if not spans:
+        return
+    cap = max(int(_config.get("tracing_buffer_spans")), 2)
+    with _lock:
+        _push_queue[:0] = spans
+        overflow = len(_push_queue) - cap
+        if overflow > 0:
+            del _push_queue[:overflow]
+    if overflow > 0:
+        _count_dropped(overflow)
+
+
+@contextlib.contextmanager
+def adopt_context(carrier: Optional[Dict[str, str]]):
+    """Make `carrier`'s span current WITHOUT recording a new span: code
+    that runs on behalf of a remote caller (dependency fetches before the
+    execute span opens, a daemon serving a pull) parents any spans it
+    opens to the caller's context. A carrier's presence means the origin
+    traces, so tracing is enabled here (same contract as execute_span)."""
+    if not carrier or "traceparent" not in carrier:
+        yield None
+        return
+    try:
+        _, trace_id, span_id, _ = carrier["traceparent"].split("-")
+    except ValueError:
+        yield None
+        return
+    synthetic = Span(name="(remote)", trace_id=trace_id, span_id=span_id,
+                     parent_id=None, attributes={})
+    token = _current.set(synthetic)
+    try:
+        yield synthetic
+    finally:
+        _current.reset(token)
+
+
 def inject_context() -> Optional[Dict[str, str]]:
-    """Current span context as a W3C carrier (rides in the task spec)."""
-    if not is_enabled():
-        return None
+    """Current span context as a W3C carrier (rides in the task spec).
+    Keyed on the CURRENT span, not the process-wide switch: a span only
+    becomes current when it recorded, so per-request traces propagate
+    without enabling tracing for unrelated work."""
     cur = _current.get()
     if cur is None:
         return None
@@ -135,7 +244,7 @@ def inject_context() -> Optional[Dict[str, str]]:
 
 
 def submit_span(task_name: str):
-    if not is_enabled():
+    if not is_recording():
         return contextlib.nullcontext()
     return start_span(f"{task_name}.remote",
                       attributes={"ray_tpu.op": "submit"})
@@ -144,10 +253,21 @@ def submit_span(task_name: str):
 def execute_span(task_name: str, carrier: Optional[Dict[str, str]]):
     if carrier is None:
         return contextlib.nullcontext()
-    # the presence of a carrier means the DRIVER has tracing on (maybe via
-    # enable_tracing(), not the env var) — enable here so the trace isn't a
-    # dangling submit span with no child
-    enable_tracing()
+    # the carrier's presence means the ORIGIN traces this operation;
+    # start_span records on it without flipping this process's switch,
+    # so one traced request doesn't turn tracing on for everything else
     return start_span(task_name, carrier=carrier,
                       attributes={"ray_tpu.op": "execute",
                                   "ray_tpu.pid": os.getpid()})
+
+
+def request_span(name: str, carrier: Optional[Dict[str, str]],
+                 attributes: Optional[dict] = None):
+    """Root/continuation span for an ingress request (serve HTTP/gRPC
+    proxies): a client-supplied W3C `traceparent` traces THIS request
+    even when the cluster flag is off (the carrier clause in start_span
+    — no process-wide state changes); without a carrier this opens a
+    root span only when tracing is already enabled."""
+    if not carrier and not is_enabled():
+        return contextlib.nullcontext()
+    return start_span(name, carrier=carrier, attributes=attributes)

@@ -455,3 +455,164 @@ def test_timeline_reconcile_and_train_phases(cluster, tmp_path):
     kinds = {e["kind"] for e in state.list_lease_events()}
     assert {"train_group_start", "train_death_detected"} <= kinds
     assert json.load(open(out))
+
+
+def test_default_histogram_boundaries_start_sub_ms():
+    """Warm-path RPC and span latencies sit well under 1 ms; the default
+    buckets must resolve them instead of collapsing everything into the
+    first bucket (satellite: sub-millisecond histogram boundaries)."""
+    from ray_tpu.util import metrics
+
+    b = metrics.DEFAULT_HISTOGRAM_BOUNDARIES
+    assert b[:3] == [0.0001, 0.00025, 0.0005]
+    assert 0.001 in b and 100.0 in b  # legacy boundaries kept compatible
+    h = metrics.Histogram("test_subms_hist", "t")
+    h.observe(0.0002)
+    h.observe(0.0004)
+    snap = h._snapshot()[0]
+    # the two observations land in DIFFERENT buckets now
+    assert snap["histogram"]["buckets"][1] == 1
+    assert snap["histogram"]["buckets"][2] == 1
+
+
+def test_push_payload_reserved_families_skip_prometheus():
+    """Workload rows and drained spans ride the metrics push as reserved
+    `__`-prefixed families; the Prometheus renderer must not leak them
+    as (invalid) metric families."""
+    from ray_tpu.util import metrics, tracing
+
+    metrics.Counter("test_payload_counter", "t").inc()
+    metrics.publish_workload("serve_replica", "r#1", {"queue_depth": 3})
+    tracing.enable_tracing()
+    with tracing.start_span("payload-span"):
+        pass
+    payload = metrics.push_payload()
+    names = {m["name"] for m in payload}
+    assert "__workloads__" in names and "__spans__" in names
+    wl = next(m for m in payload if m["name"] == "__workloads__")
+    assert wl["series"][0]["stats"]["queue_depth"] == 3
+    text = metrics.render_prometheus({"p1": payload})
+    assert "__workloads__" not in text and "__spans__" not in text
+    assert "test_payload_counter" in text
+    # spans drain exactly once per push
+    assert not any(m["name"] == "__spans__"
+                   for m in metrics.push_payload())
+
+
+def test_workload_watchdog_scan_policies():
+    """Pure-policy unit for the head's anomaly pass: straggler outliers
+    (median_low so a 2-gang can flag), slow pulls delta-counted from
+    histogram buckets, p99-over-SLO routes, and re-flag rate limiting."""
+    from ray_tpu.core import workload_watchdog as wd
+
+    now = 1000.0
+
+    def train_row(rank, ewma, run="r1"):
+        return {"kind": "train_worker", "key": f"{run}:rank{rank}",
+                "ts": now - 1,
+                "stats": {"run": run, "rank": rank, "ewma_step_s": ewma}}
+
+    rows = [train_row(0, 0.05), train_row(1, 0.5)]
+    anomalies, state = wd.scan(rows, {}, now, slow_pull_s=5.0,
+                               straggler_factor=2.0, p99_slo_s=0.0)
+    assert [a["anomaly"] for a in anomalies] == ["train_straggler"]
+    assert anomalies[0]["rank"] == 1
+
+    # re-flag rate limit: the same straggler is not flagged again within
+    # the interval, and IS after it
+    again, state = wd.scan(rows, {}, now + 5, slow_pull_s=5.0,
+                           straggler_factor=2.0, p99_slo_s=0.0, state=state)
+    assert not again
+    t_later = now + wd.REFLAG_INTERVAL_S + 6
+    fresh_rows = [dict(r, ts=t_later - 1) for r in rows]
+    later, state = wd.scan(fresh_rows, {}, t_later,
+                           slow_pull_s=5.0, straggler_factor=2.0,
+                           p99_slo_s=0.0, state=state)
+    assert len(later) == 1
+
+    # stale rows are never judged
+    stale = [dict(r, ts=now - 2 * wd.FRESH_S) for r in rows]
+    none, _ = wd.scan(stale, {}, now, slow_pull_s=5.0,
+                      straggler_factor=2.0, p99_slo_s=0.0)
+    assert not none
+
+    # slow pulls: delta-counted from histogram buckets above threshold.
+    # A FRESH state's first pass only baselines (a restarted head must
+    # not re-flag the workers' whole cumulative history)...
+    hist = {"tags": {"role": "node"},
+            "boundaries": [1.0, 5.0, 10.0],
+            "histogram": {"buckets": [4, 0, 2, 1], "sum": 40.0,
+                          "count": 7}}
+    anomalies, pstate = wd.scan([], {"object_pull_seconds": [("p", hist)]},
+                                now, slow_pull_s=5.0, straggler_factor=2.0,
+                                p99_slo_s=0.0)
+    assert not anomalies  # baseline pass
+    # ...a NEW slow pull after the baseline flags with its exact delta
+    hist2 = {**hist, "histogram": {"buckets": [4, 0, 3, 1], "sum": 48.0,
+                                   "count": 8}}
+    more, pstate = wd.scan([], {"object_pull_seconds": [("p", hist2)]},
+                           now + 1, slow_pull_s=5.0, straggler_factor=2.0,
+                           p99_slo_s=0.0, state=pstate)
+    assert len(more) == 1 and more[0]["count"] == 1
+    assert more[0]["anomaly"] == "slow_pull"
+    # unchanged counts on the next pass -> no re-flag
+    again, pstate = wd.scan([], {"object_pull_seconds": [("p", hist2)]},
+                            now + 2, slow_pull_s=5.0, straggler_factor=2.0,
+                            p99_slo_s=0.0, state=pstate)
+    assert not again
+
+    # p99-over-SLO route: judged over the WINDOW between passes (a
+    # recovered route must not keep flagging on cumulative counts), and
+    # only when the SLO is configured
+    def route_hist(slow_count, fast_count):
+        return {"tags": {"route": "/slow", "code": "200"},
+                "boundaries": [0.1, 0.5, 2.0],
+                "histogram": {"buckets": [fast_count, 0, slow_count, 0],
+                              "sum": 0.0,
+                              "count": slow_count + fast_count}}
+
+    fams0 = {"serve_request_seconds": [("p", route_hist(0, 0))]}
+    fams1 = {"serve_request_seconds": [("p", route_hist(100, 0))]}
+    off, _ = wd.scan([], fams1, now, slow_pull_s=5.0, straggler_factor=2.0,
+                     p99_slo_s=0.0)
+    assert not off  # SLO disabled
+    _, rstate = wd.scan([], fams0, now, slow_pull_s=5.0,
+                        straggler_factor=2.0, p99_slo_s=1.0)
+    on, rstate = wd.scan([], fams1, now + 1, slow_pull_s=5.0,
+                         straggler_factor=2.0, p99_slo_s=1.0, state=rstate)
+    assert [a["anomaly"] for a in on] == ["slo_route"]
+    assert on[0]["route"] == "/slow" and on[0]["p99_s"] == 2.0
+    assert on[0]["window_requests"] == 100
+    # the route recovers: later windows are fast (or empty) -> no
+    # re-flag even though the cumulative buckets still hold the burst
+    fams2 = {"serve_request_seconds": [("p", route_hist(100, 1000))]}
+    rec, rstate = wd.scan([], fams2,
+                          now + 2 * wd.REFLAG_INTERVAL_S, slow_pull_s=5.0,
+                          straggler_factor=2.0, p99_slo_s=1.0, state=rstate)
+    assert not rec
+
+
+def test_workload_rows_and_serve_stats_surface(cluster):
+    """publish_workload rows reach state.list_workload_stats (and the
+    serve-scoped list_serve_stats view) via the ordinary metrics push."""
+    from ray_tpu.util import metrics, state
+
+    metrics.publish_workload("serve_replica", "obs#1",
+                             {"deployment": "obs", "queue_depth": 2,
+                              "inflight": 1, "ewma_latency_s": 0.01})
+    metrics.publish_workload("custom_kind", "k1", {"x": 1})
+    assert metrics.flush()
+    deadline = time.time() + 15
+    rows = []
+    while time.time() < deadline:
+        rows = state.list_workload_stats()
+        if {"obs#1", "k1"} <= {r["key"] for r in rows}:
+            break
+        time.sleep(0.3)
+    keys = {r["key"] for r in rows}
+    assert {"obs#1", "k1"} <= keys, keys
+    serve_rows = state.list_serve_stats()
+    serve_keys = {r["key"] for r in serve_rows}
+    assert "obs#1" in serve_keys and "k1" not in serve_keys
+    row = next(r for r in serve_rows if r["key"] == "obs#1")
+    assert row["stats"]["queue_depth"] == 2 and row["ts"] > 0

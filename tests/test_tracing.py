@@ -21,6 +21,44 @@ def cluster():
     os.environ.pop("RAY_TPU_TRACING", None)
 
 
+def test_per_request_tracing_is_not_sticky():
+    """A carrier-bearing span (client traceparent / task execute) records
+    WITHOUT flipping the process-wide switch: one traced request must not
+    turn tracing on for all subsequent untraced work (review fix).
+    Runs FIRST in this module, before the cluster fixture exports
+    RAY_TPU_TRACING=1 — but other MODULES in a full-suite run may have
+    latched the process-global switch already, so snapshot/clear it."""
+    saved_enabled = tracing._enabled
+    saved_env = os.environ.pop("RAY_TPU_TRACING", None)
+    tracing._enabled = False
+    try:
+        _assert_not_sticky()
+    finally:
+        tracing._enabled = saved_enabled
+        if saved_env is not None:
+            os.environ["RAY_TPU_TRACING"] = saved_env
+
+
+def _assert_not_sticky():
+    assert not tracing.is_enabled()
+    with tracing.start_span(
+            "forced", carrier={"traceparent":
+                               f"00-{'ab' * 16}-{'cd' * 8}-01"}) as sp:
+        assert sp is not None and sp.trace_id == "ab" * 16
+        # children of an active context record too (is_recording), and
+        # propagation works from the current span alone
+        assert tracing.is_recording()
+        assert tracing.inject_context()["traceparent"].startswith(
+            f"00-{'ab' * 16}")
+        with tracing.start_span("child") as child:
+            assert child is not None and child.parent_id == sp.span_id
+    # ...but the process-wide switch never flipped: carrier-less spans
+    # outside the request record nothing
+    assert not tracing.is_enabled() and not tracing.is_recording()
+    with tracing.start_span("untraced") as sp2:
+        assert sp2 is None
+
+
 def test_trace_context_propagates_to_worker(cluster):
     tracing.enable_tracing()
 
@@ -42,6 +80,38 @@ def test_trace_context_propagates_to_worker(cluster):
     assert submit and submit[0].trace_id == driver_trace_id
     assert worker_parent == submit[0].span_id
     assert submit[0].duration_s >= 0
+
+
+def test_trace_propagates_through_nested_actor_call(cluster):
+    """One trace id across THREE processes: driver submit → task execute
+    → nested actor method call. The actor-call path injects the current
+    span (the task's execute span) so the actor-side execution span
+    parents to it — the chain a serve request rides proxy→replica."""
+    tracing.enable_tracing()
+
+    @ray_tpu.remote
+    class Probe:
+        def snap(self):
+            span = tracing.current_span()
+            return (span.trace_id, span.parent_id) if span else (None, None)
+
+    @ray_tpu.remote
+    def outer(h):
+        span = tracing.current_span()
+        inner = ray_tpu.get(h.snap.remote(), timeout=60)
+        return (span.trace_id if span else None,
+                span.span_id if span else None, inner)
+
+    h = Probe.remote()
+    ray_tpu.get(h.snap.remote(), timeout=60)  # actor warm-up
+    with tracing.start_span("nested-root") as root:
+        task_trace, task_span, (actor_trace, actor_parent) = ray_tpu.get(
+            outer.remote(h), timeout=60)
+    assert task_trace == root.trace_id
+    # the actor execution span continues the SAME trace and parents to
+    # the in-task caller's span (the task's execute span)
+    assert actor_trace == root.trace_id
+    assert actor_parent == task_span
 
 
 def test_span_exporter(cluster):
@@ -68,3 +138,4 @@ def test_traceparent_roundtrip():
     with tracing.start_span("child", carrier=carrier) as child:
         assert child.trace_id == outer.trace_id
         assert child.parent_id == outer.span_id
+
