@@ -12,10 +12,14 @@ Suites:
   control (default) — benchmarks/control_plane_microbench.json
   data              — benchmarks/data_plane_microbench.json
                       (p2p_pull_mb_s, head_restart_large_object_recovery_s)
+  serve             — benchmarks/serve_microbench.json
+                      (serve_sustained_rps, serve_fixed_batch_rps,
+                       serve_p99_s, disagg_ttft_s)
 
 Usage:
   python benchmarks/check_regression.py                # runs the bench
   python benchmarks/check_regression.py --suite data
+  python benchmarks/check_regression.py --suite serve
   python benchmarks/check_regression.py --current run.json
   python benchmarks/check_regression.py --tolerance 0.15
 """
@@ -36,6 +40,8 @@ SUITES = {
                 "runner": "control_plane"},
     "data": {"baseline": "data_plane_microbench.json",
              "runner": "data_plane"},
+    "serve": {"baseline": "serve_microbench.json",
+              "runner": "serve_plane"},
 }
 DEFAULT_BASELINE = os.path.join(HERE, SUITES["control"]["baseline"])
 

@@ -15,7 +15,14 @@ FIXED seed, so a failure replays identically:
   round exercises the daemon pull manager's chunk retry + the gossiped
   object directory under injected faults, bit-exactness asserted.
 
-  phase 3 — elastic-train drill: a 2-worker GPT-2-DDP run
+  phase 3 — serve plane: an autoscaled deployment behind the HTTP proxy
+  takes sustained multi-client load; mid-load a replica arms a seeded
+  `kill:*:n=1` chaos plan in its own process and SIGKILLs itself on its
+  next outbound telemetry push. The proxy's failover retry, admission
+  control, and the controller's health loop must hold ZERO non-shed
+  failures (429s are allowed and counted; 5xx are not).
+
+  phase 4 — elastic-train drill: a 2-worker GPT-2-DDP run
   (microbenchmark._elastic_train_loop); once the gang makes progress, a
   `kill:*:n=1` plan is injected into one daemon over the chaos control
   plane (`set_node_chaos`), so the daemon SIGKILLs itself on its next
@@ -141,6 +148,103 @@ def large_object_soak(seed: int, rounds: int = 4, mb: int = 12) -> dict:
             os.environ["RAY_TPU_STORE_ISOLATION"] = saved
 
 
+def serve_soak(seed: int, duration_s: float = 8.0, clients: int = 6) -> dict:
+    """Sustained-QPS serve phase: an autoscaled deployment behind the
+    HTTP proxy (SLO admission control armed); mid-load one replica arms
+    a seeded chaos self-kill via the chaos plane
+    (`protocol.configure_chaos("kill:*:n=1")` inside the replica process
+    — the replica SIGKILLs itself on its next outbound telemetry push, a
+    chaos-injected replica kill, not a harness kill). The proxy's
+    failover retry + the controller's health loop must hold ZERO
+    non-shed failures while the autoscaler keeps capacity; reports
+    rps / p99 / sheds."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+
+    @serve.deployment
+    class SoakTarget:
+        def __call__(self, request):
+            time.sleep(0.02)
+            return {"ok": True}
+
+        def arm_chaos(self, spec: str) -> bool:
+            from ray_tpu.core import protocol
+
+            protocol.configure_chaos(spec)
+            return True
+
+    handle = serve.run(
+        SoakTarget.options(
+            max_ongoing_requests=16,
+            autoscaling_config=serve.AutoscalingConfig(
+                min_replicas=2, max_replicas=3, target_ongoing_requests=4),
+            slo_config=serve.SLOConfig(slo_s=5.0, max_queue=64,
+                                       retry_after_s=1.0)).bind(),
+        name="soak-serve", route_prefix="/soak")
+    port = serve.start()
+    url = f"http://127.0.0.1:{port}/soak"
+    codes, lats = [], []
+    lock = threading.Lock()
+    stop = time.monotonic() + duration_s
+
+    def client():
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    url, data=b'{"x": 1}',
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except Exception:
+                code = -1
+            with lock:
+                codes.append(code)
+                if code == 200:
+                    lats.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s / 3)
+    # chaos-inject the replica kill mid-load (whichever replica the
+    # handle routes this to dies within one telemetry-push interval)
+    assert handle.arm_chaos.remote(
+        f"seed={seed},kill:*:n=1").result(timeout=30) is True
+    for t in threads:
+        t.join(duration_s + 60)
+    elapsed = time.perf_counter() - t_start
+    served = sum(1 for c in codes if c == 200)
+    shed = sum(1 for c in codes if c == 429)
+    failed = len(codes) - served - shed
+    try:
+        final = serve.status().get("soak-serve", {})
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+    assert failed == 0, f"{failed} non-shed failures (codes={set(codes)})"
+    assert served > 0
+    return {"duration_s": round(elapsed, 2), "served": served,
+            "shed": shed, "failed": failed,
+            "rps": round(served / elapsed, 1),
+            "p99_s": round(float(np.percentile(lats, 99)), 4),
+            "final_replicas": final.get("running"),
+            "chaos": f"seed={seed},kill:*:n=1 (replica self-kill)"}
+
+
 def elastic_train_drill(seed: int, steps: int = 30) -> dict:
     """The tentpole acceptance drill as a soak phase: the shared harness
     (`microbenchmark.run_elastic_drill`), with the kill delivered by the
@@ -166,6 +270,9 @@ def main(seed: int = 7, out: str | None = None, rounds: int = 6,
     print(f"[soak] large-object data plane under chaos (seed={seed})",
           file=sys.stderr)
     report["large_object"] = large_object_soak(seed)
+    print(f"[soak] serve plane under replica chaos kill (seed={seed})",
+          file=sys.stderr)
+    report["serve"] = serve_soak(seed)
     print(f"[soak] elastic train drill (seed={seed})", file=sys.stderr)
     report["elastic_train"] = elastic_train_drill(seed, steps=steps)
     print(json.dumps(report, indent=2))

@@ -1536,8 +1536,13 @@ class CoreClient:
             self._blocked_depth += 1 if value else -1
             depth = self._blocked_depth
         if (value and depth == 1) or (not value and depth == 0):
+            # push, not round trip: the head's handler is fire-and-forget
+            # (flip the flag, release the CPU, kick the scheduler) and
+            # pushes keep same-connection FIFO ordering — waiting for the
+            # ack bought nothing but two head round trips on EVERY
+            # worker-side blocking get (warm paths must stay head-free)
             try:
-                self._call(self.conn.request("blocked", value=value))
+                self.head_push("blocked", value=value)
             except Exception:
                 pass
 

@@ -207,6 +207,15 @@ FLAGS: List[Flag] = [
     Flag("serve_p99_slo_s", "RAY_TPU_SERVE_P99_SLO_S", float, 0.0,
          "Route-level p99 latency SLO for the workload watchdog "
          "(0 disables the slo_route anomaly)."),
+    Flag("serve_live_signal_refresh_s", "RAY_TPU_SERVE_LIVE_SIGNAL_REFRESH_S",
+         float, 1.0, "Serve routers/autoscaler re-pull the merged "
+         "gossiped replica-load rows (state.list_serve_stats) at most "
+         "this often (0 disables live-signal consumption; routing falls "
+         "back to local in-flight counts)."),
+    Flag("serve_live_signal_max_age_s", "RAY_TPU_SERVE_LIVE_SIGNAL_MAX_AGE_S",
+         float, 5.0, "Gossiped replica-load rows older than this are "
+         "ignored by live-signal routing and admission control (local "
+         "in-flight counts take over)."),
     # --------------------------------------------------------------- TPU
     Flag("num_chips", "RAY_TPU_NUM_CHIPS", int, -1,
          "Override TPU chip autodetection (-1 = autodetect)."),

@@ -395,6 +395,11 @@ class Head:
         self._view_seq = 0
         self._last_view_snap: Optional[dict] = None
         self._view_wake: Optional[asyncio.Event] = None
+        # serve-replica live-load rows piggybacked on the cluster_view
+        # broadcast (changed-only): routers/handles/autoscalers read the
+        # gossiped queue depth / EWMA latency with ZERO head RPCs on the
+        # request path (serve/live_signals.py)
+        self._last_serve_rows: List[dict] = []
         # gossiped object directory (authoritative copy): seal/spill/free
         # of non-inline objects and daemon replica announcements append
         # delta records that ride the next cluster_view broadcast; daemons
@@ -2567,6 +2572,22 @@ class Head:
         else:
             self._dir_pending.append(rec)
 
+    def _serve_loads_payload(self) -> Optional[list]:
+        """Changed-only serve-replica load rows for the cluster_view
+        broadcast: [{key, ts, stats}] drawn from the same merged
+        `__workloads__` telemetry `list_serve_stats` serves. Returns None
+        when nothing changed since the last broadcast (idle serve plane
+        costs the broadcast nothing)."""
+        rows = [{"key": r.get("key"), "ts": r.get("ts"),
+                 "stats": r.get("stats")}
+                for r in self._workload_rows()
+                if r.get("kind") == "serve_replica"]
+        rows.sort(key=lambda r: r.get("key") or "")
+        if rows == self._last_serve_rows:
+            return None
+        self._last_serve_rows = rows
+        return rows
+
     def _dir_payload(self) -> Optional[dict]:
         """Drain pending directory records into one broadcast payload."""
         if self._dir_full_resync:
@@ -2617,6 +2638,10 @@ class Head:
         snap = dict(self._last_view_snap or self._build_view_snapshot())
         if _config.get("object_directory"):
             snap["objects"] = self.object_dir.full_payload(self._dir_seq)
+        if self._last_serve_rows:
+            # late joiners get the current serve-load rows immediately
+            # instead of waiting for the next row change
+            snap["workloads"] = self._last_serve_rows
         try:
             if pubsub:
                 conn.push("pubsub", channel="cluster_view", msg=snap)
@@ -2644,7 +2669,9 @@ class Head:
             nodes_changed = (self._last_view_snap is None
                              or snap["nodes"] != self._last_view_snap["nodes"])
             dir_payload = self._dir_payload()
-            if not nodes_changed and dir_payload is None:
+            serve_payload = self._serve_loads_payload()
+            if (not nodes_changed and dir_payload is None
+                    and serve_payload is None):
                 continue
             if nodes_changed:
                 self._view_seq += 1
@@ -2658,6 +2685,9 @@ class Head:
             if dir_payload is not None:
                 snap = dict(snap)
                 snap["objects"] = dir_payload
+            if serve_payload is not None:
+                snap = dict(snap)
+                snap["workloads"] = serve_payload
             for node in self.nodes.values():
                 if node.conn is not None and node.alive and not node.conn.closed:
                     try:
@@ -3146,8 +3176,8 @@ class Head:
         return fams
 
     async def _workload_watchdog_loop(self) -> None:
-        """Flag slow pulls / train-step stragglers / p99-over-SLO routes
-        from the merged telemetry — flight-recorder events plus
+        """Flag slow pulls / train-step stragglers / p99-over-SLO routes /
+        sustained admission-control shedding from the merged telemetry — flight-recorder events plus
         `workload_anomalies_total{kind}` (see core/workload_watchdog)."""
         from ray_tpu.core import workload_watchdog
 
@@ -3180,7 +3210,8 @@ class Head:
                 self._anomaly_counter = _metrics.Counter(
                     "workload_anomalies_total",
                     "Workload anomalies flagged by the head watchdog "
-                    "(slow_pull | train_straggler | slo_route)",
+                    "(slow_pull | train_straggler | slo_route | "
+                    "serve_shedding)",
                     tag_keys=("kind",))
             self._anomaly_counter.inc(tags={"kind": kind})
         except Exception:

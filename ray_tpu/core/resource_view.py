@@ -77,6 +77,12 @@ class ClusterView:
         # the age of the cached view, gossiped back to the head as
         # per-node `gossip_lag_s`
         self.adopted_ts: float = 0.0
+        # serve-replica live-load rows piggybacked on head snapshots
+        # (changed-only, so absence in a snapshot means "unchanged");
+        # None until the first row batch arrives — consumers
+        # (serve/live_signals.py) distinguish "no serve plane yet" from
+        # "idle serve plane" and fall back to the state API for the former
+        self.serve_loads: Optional[list] = None
 
     def staleness_s(self) -> float:
         """Seconds since the last adopted snapshot; -1 = never adopted."""
@@ -115,6 +121,9 @@ class ClusterView:
         self.entries = {e["node_id"]: e for e in snap.get("nodes", [])}
         self.version = snap.get("version", self.version)
         self.epoch = snap.get("epoch", self.epoch)
+        wl = snap.get("workloads")
+        if wl is not None:
+            self.serve_loads = wl
         self.adopted_ts = time.monotonic()
 
     def data_addr_of(self, node_id_hex: str):

@@ -10,7 +10,11 @@ plane and the metrics pusher already delivered) flags:
   ``workload_straggler_factor`` x its gang's median (per-run grouping of
   the gossiped train-worker rows);
 - **slo_route** — a serve route whose estimated p99 latency (from the
-  merged ``serve_request_seconds`` buckets) exceeds ``serve_p99_slo_s``.
+  merged ``serve_request_seconds`` buckets) exceeds ``serve_p99_slo_s``;
+- **serve_shedding** — a route whose admission control kept shedding
+  (``serve_shed_total`` deltas positive across consecutive passes): one
+  shedding pass is a burst absorber doing its job; sustained shedding is
+  capacity starvation the autoscaler/operator should see.
 
 Anomalies land in the flight-recorder event stream
 (``kind="workload_anomaly"``, visible in ``state.list_lease_events()``
@@ -39,6 +43,11 @@ FRESH_S = 30.0
 # (cumulative-since-process-start buckets would keep a recovered route
 # flagging forever); windows with too few samples are skipped
 MIN_WINDOW_SAMPLES = 20
+
+# admission-control shedding must persist for this many consecutive
+# passes before it's flagged (a single-pass shed burst is the bounded
+# queue absorbing a spike, not an anomaly)
+SHED_SUSTAIN_PASSES = 2
 
 
 def _count_above(series: dict, threshold: float) -> int:
@@ -195,6 +204,34 @@ def scan(workload_rows: List[dict],
                     "p99_s": p99, "slo_s": p99_slo_s,
                     "window_requests": window["count"]})
     state["route_hist"] = new_routes
+
+    # ---- sustained load shedding (proxy admission control): judged on
+    # serve_shed_total deltas per route, summed across processes and shed
+    # reasons; flagged only after SHED_SUSTAIN_PASSES consecutive passes
+    # with fresh sheds (a replica restart's counter reset reads as a
+    # non-positive delta and clears the streak)
+    prev_shed: Dict = dict(state.get("shed_seen") or {})
+    streaks: Dict = dict(state.get("shed_streak") or {})
+    shed_totals: Dict[str, float] = {}
+    for _proc, s in families.get("serve_shed_total", ()):
+        route = (s.get("tags") or {}).get("route", "?")
+        shed_totals[route] = shed_totals.get(route, 0.0) + (
+            s.get("value") or 0.0)
+    for route, total in shed_totals.items():
+        if route not in prev_shed:
+            streaks[route] = 0        # baseline pass for a new route
+        elif total - prev_shed[route] > 0:
+            streaks[route] = streaks.get(route, 0) + 1
+            if streaks[route] >= SHED_SUSTAIN_PASSES:
+                flag(("serve_shedding", route), {
+                    "anomaly": "serve_shedding", "route": route,
+                    "shed_in_window": int(total - prev_shed[route]),
+                    "sustained_passes": streaks[route]})
+        else:
+            streaks[route] = 0
+    state["shed_seen"] = shed_totals
+    state["shed_streak"] = {k: v for k, v in streaks.items()
+                            if k in shed_totals}
 
     # prune the carry so a long-lived head doesn't accumulate state for
     # every process/run/route that ever existed: slow-pull high-waters

@@ -388,6 +388,86 @@ def decode_step(params: Params, cache, tokens: jax.Array, pos: jax.Array,
     return logits, {"k": new_k, "v": new_v}
 
 
+def prefill_chunk(params: Params, cache, tokens: jax.Array, pos0: jax.Array,
+                  length: jax.Array, active: jax.Array, cfg: GPT2Config):
+    """Process up to C prompt tokens per slot in ONE fused step (chunked
+    prefill for the continuous-batching engine: a long prompt advances C
+    positions per engine tick instead of 1, while decode slots ride along
+    as length-1 lanes).
+
+    tokens [B, C] int32 (left-aligned chunk per slot), pos0 [B] int32 (the
+    chunk's first cache position), length [B] int32 (valid tokens in the
+    chunk, 0..C), active [B] bool. Returns (logits [B, vocab] taken at
+    each slot's LAST valid chunk token, new_cache). Inactive/zero-length
+    slots' caches are untouched and their logits are garbage. Cache
+    writes are lane-masked read-modify-writes: dynamic_update_slice
+    clamps its start near the sequence end, so an unmasked block write
+    would smear garbage lanes over valid earlier positions. Callers
+    guarantee pos0 + length <= T and C <= T.
+    """
+    B, C = tokens.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    T = cache["k"].shape[3]
+    wte = params["wte"]
+    lane = jnp.arange(C)
+    pos = pos0[:, None] + lane[None, :]                           # [B, C]
+    valid = lane[None, :] < length[:, None]                       # [B, C]
+    x = wte[tokens] + params["wpe"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+    x = x.astype(cfg.dtype)                                       # [B, C, D]
+
+    def upd_chunk(c_b, val_b, p0_b, valid_b):
+        # c_b [H, T, Dh], val_b [H, C, Dh]: write val lane i at position
+        # p0_b + i for VALID lanes only. Window lane w (at absolute
+        # position start + w) takes val lane w - off, where off is the
+        # clamp shift; everything else keeps the old cache content.
+        start = jnp.clip(p0_b, 0, T - C)
+        off = p0_b - start
+        old = jax.lax.dynamic_slice(c_b, (0, start, 0), (H, C, Dh))
+        src = lane - off
+        srcc = jnp.clip(src, 0, C - 1)
+        take = (src >= 0) & (src < C) & valid_b[srcc]
+        blended = jnp.where(take[None, :, None], val_b[:, srcc, :], old)
+        return jax.lax.dynamic_update_slice(c_b, blended, (0, start, 0))
+
+    def layer(x, scanned):
+        bp, ck, cv = scanned                                # ck/cv [B,H,T,Dh]
+        h = _layer_norm(x, bp["ln1"])
+        qkv = h @ bp["attn"]["wqkv"].astype(cfg.dtype) + \
+            bp["attn"]["bqkv"].astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, C, H, Dh).transpose(0, 2, 1, 3)    # [B, H, C, Dh]
+        k = k.reshape(B, C, H, Dh).transpose(0, 2, 1, 3)
+        v = v.reshape(B, C, H, Dh).transpose(0, 2, 1, 3)
+        ck_new = jax.vmap(upd_chunk)(ck, k, pos0, valid)
+        cv_new = jax.vmap(upd_chunk)(cv, v, pos0, valid)
+        ck = jnp.where(active[:, None, None, None], ck_new, ck)
+        cv = jnp.where(active[:, None, None, None], cv_new, cv)
+        # chunk lanes attend to everything written up to their own
+        # position (the chunk's k/v are already in the cache, so this is
+        # causal intra-chunk attention + full attention to the prefix)
+        scores = jnp.einsum("bhcd,bhtd->bhct", q, ck,
+                            preferred_element_type=jnp.float32)
+        scores = scores / math.sqrt(Dh)
+        t_idx = jnp.arange(T)[None, None, None, :]
+        scores = jnp.where(t_idx <= pos[:, None, :, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhct,bhtd->bhcd", probs, cv)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, C, H * Dh)
+        attn = attn @ bp["attn"]["wo"].astype(cfg.dtype) + \
+            bp["attn"]["bo"].astype(cfg.dtype)
+        x = x + attn
+        x = x + _mlp(_layer_norm(x, bp["ln2"]), bp["mlp"], cfg)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(layer, x,
+                                 (params["blocks"], cache["k"], cache["v"]))
+    last = jnp.clip(length - 1, 0, C - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    x_last = _layer_norm(x_last, params["ln_f"])
+    logits = (x_last @ wte.T.astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def num_params(cfg: GPT2Config) -> int:
     d, f, L, V, S = cfg.d_model, cfg.d_ff, cfg.n_layer, cfg.vocab_size, cfg.max_seq_len
     per_block = (3 * d * d + 3 * d) + (d * d + d) + (2 * d * f + f + d) + 4 * d

@@ -9,12 +9,14 @@ from ray_tpu.serve.autoscaling import AutoscalingConfig
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.grpc_proxy import start_grpc
+from ray_tpu.serve.live_signals import SLOConfig
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Deployment", "deployment", "run", "delete", "shutdown", "start",
     "start_grpc",
-    "status", "get_deployment_handle", "AutoscalingConfig", "batch",
+    "status", "get_deployment_handle", "AutoscalingConfig", "SLOConfig",
+    "batch",
     "DeploymentHandle", "DeploymentResponse", "multiplexed",
     "get_multiplexed_model_id",
 ]

@@ -31,6 +31,11 @@ class Deployment:
     init_args: tuple = ()
     init_kwargs: Optional[dict] = None
     visible_chips: Optional[list] = None
+    # admission policy (serve/live_signals.SLOConfig or dict): the proxies
+    # shed (429 / RESOURCE_EXHAUSTED + Retry-After) when the route's
+    # EWMA-projected wait exceeds slo_s or every replica queue is at
+    # max_queue
+    slo_config: Optional[Any] = None
 
     def bind(self, *args, **kwargs) -> "Deployment":
         return dataclasses.replace(self, init_args=args, init_kwargs=kwargs)
@@ -43,6 +48,9 @@ class Deployment:
         auto = self.autoscaling_config
         if isinstance(auto, dict):
             auto = AutoscalingConfig(**auto)
+        from ray_tpu.serve.live_signals import as_slo
+
+        slo = as_slo(self.slo_config)
         return {
             "callable": self.func_or_class,
             "num_replicas": num,
@@ -53,6 +61,7 @@ class Deployment:
             "init_args": self.init_args,
             "init_kwargs": self.init_kwargs,
             "visible_chips": self.visible_chips,
+            "slo_config": slo.to_dict() if slo is not None else None,
         }
 
 
@@ -61,7 +70,8 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
                ray_actor_options: Optional[dict] = None,
                max_ongoing_requests: int = 8,
                user_config: Any = None,
-               autoscaling_config: Optional[Any] = None):
+               autoscaling_config: Optional[Any] = None,
+               slo_config: Optional[Any] = None):
     def deco(obj):
         return Deployment(
             func_or_class=obj,
@@ -70,7 +80,8 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
             ray_actor_options=ray_actor_options,
             max_ongoing_requests=max_ongoing_requests,
             user_config=user_config,
-            autoscaling_config=autoscaling_config)
+            autoscaling_config=autoscaling_config,
+            slo_config=slo_config)
 
     if _func_or_class is not None:
         return deco(_func_or_class)

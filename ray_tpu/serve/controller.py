@@ -16,11 +16,19 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.serve.autoscaling import (AutoscalingConfig,
-                                       calculate_desired_num_replicas)
+                                       calculate_desired_num_replicas,
+                                       desired_from_live_load)
 from ray_tpu.serve.replica import ReplicaActor
 
 RECONCILE_INTERVAL_S = 0.25
 HEALTH_CHECK_INTERVAL_S = 2.0
+# a replica that has never answered a health check gets this long before
+# an unresponsive probe is treated as death: model-serving replicas spend
+# tens of seconds in __init__ (engine build + XLA compile) with actor
+# calls queued behind it, and killing them mid-compile just restarts the
+# compile forever. A provably-dead actor (ActorDiedError) is replaced
+# immediately regardless.
+REPLICA_INIT_GRACE_S = 120.0
 
 
 class DeploymentInfo:
@@ -90,9 +98,13 @@ class ServeController:
             info = self.deployments.get(name)
             if info is None:
                 return None
+            slo = info.config.get("slo_config")
+            if slo is not None and not isinstance(slo, dict):
+                slo = slo.to_dict()
             return {"version": info.version,
                     "replicas": {tag: h for tag, h in info.replicas.items()},
-                    "models": dict(self.multiplexed.get(name, {}))}
+                    "models": dict(self.multiplexed.get(name, {})),
+                    "slo": slo}
 
     # ------------------------------------------------------- routes / proxy
     def set_route(self, route_prefix: str, deployment_name: str):
@@ -222,6 +234,8 @@ class ServeController:
         threading.Thread(target=_drain_and_kill, daemon=True).start()
 
     def _health_check(self, info: DeploymentInfo):
+        from ray_tpu.core.exceptions import ActorDiedError
+
         dead = []
         with self._lock:
             replicas = dict(info.replicas)
@@ -233,8 +247,19 @@ class ServeController:
                 else:
                     with self._lock:
                         info.replica_meta[tag] = {**info.replica_meta.get(tag, {}),
-                                                  "ongoing": status["ongoing"]}
-            except Exception:
+                                                  "ongoing": status["ongoing"],
+                                                  "ready": True}
+            except Exception as e:
+                with self._lock:
+                    meta = info.replica_meta.get(tag, {})
+                if (not meta.get("ready")
+                        and not isinstance(e, ActorDiedError)
+                        and time.time() - meta.get("started", 0)
+                        < REPLICA_INIT_GRACE_S):
+                    # probe timed out but the replica is still in its init
+                    # window (probes queue behind a long __init__): give it
+                    # the grace period before declaring death
+                    continue
                 dead.append(tag)
         if dead:
             with self._lock:
@@ -253,6 +278,22 @@ class ServeController:
     def _autoscale(self, info: DeploymentInfo):
         if info.autoscaling is None:
             return
+        # primary signal: the gossiped live-load rows (queue depth + EWMA
+        # latency via state.list_serve_stats) — scale-up reacts at gossip
+        # latency instead of the health-check poll cadence. Controller-
+        # polled counts stay as the fallback when the signal plane is
+        # cold/stale (fresh deployment, head restart, idle).
+        desired = None
+        rows = self._live_serve_rows().get(info.name, {})
+        if rows:
+            with self._lock:
+                live = [r for tag, r in rows.items() if tag in info.replicas]
+                current = max(len(info.replicas), 1)
+            desired = desired_from_live_load(info.autoscaling, live, current)
+        if desired is not None:
+            with self._lock:
+                info.target_replicas = desired
+            return
         with self._lock:
             ongoing = sum(m.get("ongoing", 0)
                           for m in info.replica_meta.values())
@@ -263,3 +304,15 @@ class ServeController:
             desired = calculate_desired_num_replicas(
                 info.autoscaling, ongoing, max(len(info.replicas), 1))
             info.target_replicas = desired
+
+    def _live_serve_rows(self) -> dict:
+        """{deployment: {tag: load_row}} from the shared live-signal
+        cache; {} when the telemetry plane is unreachable."""
+        try:
+            from ray_tpu.serve import live_signals
+
+            cache = live_signals.get_cache()
+            cache.refresh()
+            return cache.snapshot()
+        except Exception:
+            return {}

@@ -1,0 +1,306 @@
+"""Disaggregated prefill/decode serving over the P2P object data plane.
+
+Reference: serve.llm's prefill/decode disaggregation behind KV-transfer
+connectors (nixl/lmcache). Here the serve controller places TWO replica
+sets — a prefill pool and a decode pool — as separate deployments with
+distinct resource labels (`ray_actor_options["resources"]`), so the
+two-level scheduler lands them on separately-provisioned nodes. The KV
+path is the PR 7 object data plane, NOT the head:
+
+  decode replica --(actor call)--> prefill replica
+      prefill runs the prompt pass, `kv_cache.export_prefix` serializes
+      the pooled blocks, `ray_tpu.put` seals the blob into the prefill
+      node's store; the ObjectRef travels back in the reply.
+  decode replica --(P2P pull)--> prefill node
+      the decode side waits for the gossiped object directory to learn
+      the blob's location (bounded), then `ray_tpu.get` pulls it through
+      its node's PullManager — one network crossing, zero head RPCs on
+      the warm path — and `kv_cache.import_prefix` installs the blocks,
+      so decode skips prefill for the covered span.
+
+Every step degrades gracefully: a dead prefill pool, a lost blob, or a
+mismatched architecture just means the decode engine runs the prefill
+locally (correctness never depends on the transfer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.llm import LLMServer
+
+
+class _RpcAudit:
+    """Head-RPC audit hooks for acceptance drills and ops debugging:
+    records this process's head-connection traffic between start/stop
+    (the zero-head-RPCs-on-the-warm-path contract is interposer-verified
+    from inside the replica, where the KV shipping actually happens)."""
+
+    def __init__(self):
+        self._events: List[tuple] = []
+        self._hook = None
+
+    def start(self) -> bool:
+        from ray_tpu.core import protocol
+
+        if self._hook is not None:
+            return False
+        events = self._events = []
+
+        def hook(conn_name, kind, method):
+            if conn_name == "head":
+                events.append((kind, method))
+
+        protocol.add_rpc_interposer(hook)
+        self._hook = hook
+        return True
+
+    def stop(self) -> List[tuple]:
+        from ray_tpu.core import protocol
+
+        if self._hook is not None:
+            protocol.remove_rpc_interposer(self._hook)
+            self._hook = None
+        events, self._events = self._events, []
+        return events
+
+
+class PrefillServer:
+    """Prefill-pool replica: runs prompt passes and exports KV blobs into
+    the object store for decode replicas to pull."""
+
+    def __init__(self, **engine_kwargs):
+        from ray_tpu.serve.llm import LLMEngine
+
+        engine_kwargs.setdefault("enable_prefix_caching", True)
+        self.engine = LLMEngine(**engine_kwargs)
+        self._lock = threading.Lock()
+        self.prefills = 0
+        self.blobs_exported = 0
+        self.tokens_exported = 0
+        self._audit = _RpcAudit()
+
+    def prefill(self, prompt_ids: List[int]) -> Dict[str, Any]:
+        """Run (or reuse) the prompt's prefill and ship its KV blocks to
+        the decode caller. Production-sized blobs seal into the object
+        store (the ref rides back in the reply; the bytes stay on this
+        node until the decode replica pulls them P2P through the data
+        plane). Blobs under the store's inline threshold ride the direct
+        actor reply itself — inline objects never enter the gossiped
+        directory, so a store round trip for them would route through
+        the head for nothing."""
+        with self._lock:
+            self.prefills += 1
+        blob = self.engine.export_prefix(prompt_ids=list(prompt_ids))
+        if blob is None or not blob.get("ids"):
+            return {"ref": None, "n_tokens": 0}
+        with self._lock:
+            self.blobs_exported += 1
+            self.tokens_exported += len(blob["ids"])
+        out = {"n_tokens": len(blob["ids"]), "block_size": blob["block_size"]}
+        from ray_tpu.core.store import INLINE_THRESHOLD
+
+        if blob["k"].nbytes + blob["v"].nbytes <= INLINE_THRESHOLD:
+            return {**out, "blob": blob}
+        return {**out, "ref": ray_tpu.put(blob)}
+
+    def stats(self) -> dict:
+        out = self.engine.engine_stats()
+        with self._lock:
+            out.update({"role": "prefill", "prefills": self.prefills,
+                        "blobs_exported": self.blobs_exported,
+                        "tokens_exported": self.tokens_exported})
+        if self.engine.kv is not None:
+            out["kv_cache"] = self.engine.kv.stats()
+        return out
+
+    def rpc_audit_start(self) -> bool:
+        return self._audit.start()
+
+    def rpc_audit_stop(self) -> List[tuple]:
+        return self._audit.stop()
+
+    def check_health(self):
+        if not self.engine._thread.is_alive():
+            raise RuntimeError("prefill engine loop died")
+
+
+class DisaggLLMServer(LLMServer):
+    """Decode-pool replica: completions API surface; prompts whose KV
+    isn't resident are prefilled by the prefill pool and imported over
+    the data plane before decoding."""
+
+    def __init__(self, prefill_handle=None, directory_wait_s: float = 2.0,
+                 prefill_timeout_s: float = 120.0, **engine_kwargs):
+        engine_kwargs.setdefault("enable_prefix_caching", True)
+        super().__init__(**engine_kwargs)
+        # arrives as a live DeploymentHandle via deployment composition
+        self.prefill_handle = prefill_handle
+        self.directory_wait_s = directory_wait_s
+        self.prefill_timeout_s = prefill_timeout_s
+        self._lock = threading.Lock()
+        self.prefill_fetches = 0
+        self.plane_fetches = 0      # blobs pulled via the object data plane
+        self.blocks_imported = 0
+        self.tokens_imported = 0
+        self.local_prefix_hits = 0
+        self.fetch_errors = 0
+        self._audit = _RpcAudit()
+
+    # ------------------------------------------------------- KV fetching
+    def _wait_directory(self, ref) -> bool:
+        """Bounded wait for the gossiped object directory to resolve the
+        blob to a serving node: the announcement rides the cluster_view
+        broadcast, so a beat of patience buys a head-free P2P pull
+        (timeout falls back to the cold-miss path inside get())."""
+        try:
+            client = ray_tpu.core.api._global_client()
+        except Exception:
+            return False
+        deadline = time.monotonic() + self.directory_wait_s
+        while time.monotonic() < deadline:
+            try:
+                if ref.id in client.local_metas:
+                    return True     # same-node blob: already local
+                locs = client.object_dir.locations(ref.id)
+                if locs and any(client.cluster_view.data_addr_of(h)
+                                for h in locs):
+                    return True
+            except Exception:
+                return False
+            time.sleep(0.01)
+        return False
+
+    def _ensure_prefix(self, ids: List[int]) -> int:
+        """Fetch+import the prompt's KV from the prefill pool unless the
+        local pool already covers it (a full block of gain is the bar —
+        below that the fetch costs more than the prefill it saves).
+        Returns imported block count; 0 means decode prefills locally."""
+        if (self.prefill_handle is None or self.engine.kv is None
+                or len(ids) < 2):
+            return 0
+        kv = self.engine.kv
+        covered = kv.peek_prefix_len(ids[:-1])
+        if (len(ids) - 1) - covered < kv.block_size:
+            with self._lock:
+                self.local_prefix_hits += 1
+            return 0
+        try:
+            res = self.prefill_handle.options(
+                method_name="prefill").remote(list(ids)).result(
+                    timeout=self.prefill_timeout_s)
+            blob = res.get("blob")
+            via_plane = blob is None
+            if via_plane:
+                ref = res.get("ref")
+                if ref is None:
+                    return 0
+                self._wait_directory(ref)
+                blob = ray_tpu.get(ref, timeout=self.prefill_timeout_s)
+            installed = self.engine.import_prefix(blob)
+            with self._lock:
+                self.prefill_fetches += 1
+                self.plane_fetches += 1 if via_plane else 0
+                self.blocks_imported += installed
+                self.tokens_imported += installed * kv.block_size
+            # the blob ref is dropped here, not free()d: free is a head
+            # round trip, while a dropped borrow GCs through the refcount
+            # plane's batched pushes — the warm path stays head-RPC-free
+            return installed
+        except Exception:
+            # degraded mode: decode-side prefill (correct, just slower)
+            with self._lock:
+                self.fetch_errors += 1
+            return 0
+
+    # ---------------------------------------------------------- requests
+    def __call__(self, request: Any) -> dict:
+        body = request if isinstance(request, dict) else getattr(
+            request, "json", None) or {}
+        ids = body.get("prompt_ids")
+        if ids is None:
+            ids = self.engine.tokenizer.encode(body.get("prompt", ""))
+        ids = (ids or [self.engine.tokenizer.eos_id])
+        ids = ids[-(self.engine.max_seq_len - 2):]
+        self._ensure_prefix(ids)
+        out = self.engine.generate(
+            prompt_ids=ids,
+            max_tokens=int(body.get("max_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)))
+        return {
+            "object": "text_completion",
+            "choices": [{"text": out["text"], "index": 0,
+                         "token_ids": out["token_ids"],
+                         "finish_reason": "length"}],
+            "usage": {"prompt_tokens": out["prompt_tokens"],
+                      "completion_tokens": len(out["token_ids"])},
+        }
+
+    def stats(self) -> dict:
+        out = super().stats()
+        with self._lock:
+            out.update({"role": "decode",
+                        "prefill_fetches": self.prefill_fetches,
+                        "plane_fetches": self.plane_fetches,
+                        "blocks_imported": self.blocks_imported,
+                        "tokens_imported": self.tokens_imported,
+                        "local_prefix_hits": self.local_prefix_hits,
+                        "fetch_errors": self.fetch_errors})
+        return out
+
+    def rpc_audit_start(self) -> bool:
+        return self._audit.start()
+
+    def rpc_audit_stop(self) -> List[tuple]:
+        return self._audit.stop()
+
+
+def build_disagg_llm_deployment(
+        preset: str = "gpt2-tiny", max_seq_len: int = 128,
+        name: str = "llm-disagg",
+        prefill_replicas: int = 1, decode_replicas: int = 1,
+        prefill_resources: Optional[dict] = None,
+        decode_resources: Optional[dict] = None,
+        prefill_max_batch: int = 2, decode_max_batch: int = 4,
+        model_overrides: Optional[dict] = None,
+        checkpoint: Optional[str] = None, seed: int = 0,
+        kv_blocks: int = 64, kv_block_size: int = 16,
+        num_tpu_chips: int = 0,
+        autoscaling_config=None, slo_config=None,
+        **engine_kwargs):
+    """Two-pool deployment graph: `{name}-prefill` and `{name}` (decode,
+    the routable front). Distinct `*_resources` labels steer each pool's
+    replicas through the two-level scheduler (e.g. prefill on
+    compute-heavy nodes, decode on HBM-heavy nodes). Run with
+    `serve.run(app, route_prefix=...)`; the returned handle fronts the
+    decode pool."""
+    from ray_tpu.serve.api import deployment
+
+    shared = dict(preset=preset, max_seq_len=max_seq_len, seed=seed,
+                  model_overrides=model_overrides, checkpoint=checkpoint,
+                  kv_blocks=kv_blocks, kv_block_size=kv_block_size,
+                  **engine_kwargs)
+    pre_opts: Dict[str, Any] = {"num_cpus": 1}
+    dec_opts: Dict[str, Any] = {"num_cpus": 1}
+    if num_tpu_chips:
+        pre_opts["num_tpu_chips"] = num_tpu_chips
+        dec_opts["num_tpu_chips"] = num_tpu_chips
+    if prefill_resources:
+        pre_opts["resources"] = dict(prefill_resources)
+    if decode_resources:
+        dec_opts["resources"] = dict(decode_resources)
+    prefill = deployment(
+        PrefillServer, name=f"{name}-prefill",
+        num_replicas=prefill_replicas, ray_actor_options=pre_opts,
+        max_ongoing_requests=prefill_max_batch * 2,
+    ).bind(max_batch=prefill_max_batch, **shared)
+    decode = deployment(
+        DisaggLLMServer, name=name, num_replicas=decode_replicas,
+        ray_actor_options=dec_opts,
+        max_ongoing_requests=decode_max_batch * 2,
+        autoscaling_config=autoscaling_config, slo_config=slo_config,
+    ).bind(prefill_handle=prefill, max_batch=decode_max_batch, **shared)
+    return decode

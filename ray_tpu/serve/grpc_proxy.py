@@ -84,6 +84,24 @@ class _GrpcServer:
                     if router is None:
                         router = outer._routers[dep] = _AsyncRouter(
                             outer._controller, dep)
+                    # SLO-aware admission control (HTTP 429's gRPC
+                    # sibling): RESOURCE_EXHAUSTED + a retry-after hint
+                    # in the trailing metadata
+                    try:
+                        shed = await router.admission_check()
+                    except Exception:
+                        shed = None
+                    from ray_tpu.serve.proxy import note_admission
+
+                    retry_after = note_admission(f"grpc:{dep}", shed)
+                    if shed is not None:
+                        context.set_trailing_metadata((
+                            ("retry-after", str(retry_after)),))
+                        await context.abort(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED,
+                            f"deployment over capacity "
+                            f"({shed['reason']}); retry after "
+                            f"{retry_after}s")
                     req = Request("GRPC", handler_call_details.method, {},
                                   metadata, request_bytes, body)
                     model_id = metadata.get("serve_multiplexed_model_id")

@@ -3,13 +3,15 @@
 Parity with `python/ray/serve/handle.py` (DeploymentHandle/DeploymentResponse)
 and `_private/router.py:368` + `request_router/pow_2_router.py`: the handle
 tracks per-replica in-flight counts locally, samples two replicas and picks
-the shorter queue — queue-length probes are replaced by completion callbacks
-on the submitted calls (same staleness tradeoff the reference accepts).
+the shorter queue. The queue each choice compares is the LIVE one — the
+gossiped replica load rows (queue depth / EWMA latency from
+`state.list_serve_stats()`, cached ~1s in serve/live_signals.py) blended
+with the local in-flight counts, so a handle sees load other routers and
+proxies put on a replica, not just its own.
 """
 
 from __future__ import annotations
 
-import random
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -133,6 +135,17 @@ class DeploymentHandle:
                     f"no replicas for deployment {self.deployment_name!r}")
             time.sleep(0.1)
             self._refresh_table(force=True)
+        from ray_tpu.serve import live_signals
+
+        # TTL-cached head fetch OUTSIDE the lock (it can be a round trip)
+        live = live_signals.get_cache()
+        try:
+            live.refresh()
+        except Exception:
+            pass
+        now = time.time()
+        max_age = live_signals._flag("serve_live_signal_max_age_s", 5.0)
+
         with self._lock:
             tags = list(self._table)
             if self._model_id:
@@ -141,11 +154,15 @@ class DeploymentHandle:
                         if self._model_id in self._models.get(t, [])]
                 if warm:
                     tags = warm
-            if len(tags) == 1:
-                tag = tags[0]
-            else:  # power of two choices on local in-flight counts
-                a, b = random.sample(tags, 2)
-                tag = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+            # power of two choices on LIVE queue depth (gossiped rows
+            # blended with local in-flight; EWMA latency breaks ties)
+            tag = live_signals.pick_pow2(
+                tags,
+                lambda t: live_signals.replica_score(
+                    self._inflight.get(t, 0),
+                    live.row(self.deployment_name, t), now, max_age),
+                lambda t: live_signals.ewma_of(
+                    live.row(self.deployment_name, t)))
             return tag, self._table[tag]
 
     def _maybe_push_metrics(self):

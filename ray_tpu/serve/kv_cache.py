@@ -92,6 +92,18 @@ class PagedKVCache:
             yield h, blk
 
     # ------------------------------------------------------------- lookup
+    def peek_prefix_len(self, ids: List[int]) -> int:
+        """Cached-token count for `ids`' prefix WITHOUT touching the LRU
+        order or the hit/miss counters — the disagg decode side uses this
+        to decide whether fetching remote KV would gain anything before
+        it commits to a prefill RPC."""
+        n = 0
+        for h, _blk in self._chains(ids):
+            if h not in self._table:
+                break
+            n += self.block_size
+        return n
+
     def match_prefix(self, ids: List[int]) -> Tuple[int, List[int]]:
         blocks: List[int] = []
         for h, _blk in self._chains(ids):

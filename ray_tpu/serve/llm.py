@@ -76,10 +76,56 @@ class _Request:
         # streaming consumers: wakes on every appended token batch
         self.progress = threading.Condition()
         self._sent_text = ""  # cumulative text already shipped to the consumer
+        self.t_enqueue = time.time()
+        self.t_first: Optional[float] = None   # first generated token (TTFT)
+
+
+def plan_chunk_budget(pending_lens: List[int], decoding: List[bool],
+                      chunk_size: int, budget: int) -> List[int]:
+    """Token-budget step plan for one continuous-batching tick: how many
+    tokens each slot processes this step.
+
+    Decode slots are reserved FIRST and unconditionally (one token each:
+    a prefilling long prompt can never starve running generations), then
+    the remaining budget is dealt to prefilling slots in slot order,
+    capped at `chunk_size` per slot. When only prefills are live, at
+    least one slot always makes progress regardless of budget (no
+    livelock on a tiny budget). Pure — unit-tested directly.
+    """
+    n = len(pending_lens)
+    takes = [0] * n
+    for i in range(n):
+        if decoding[i]:
+            takes[i] = 1
+            budget -= 1
+    any_progress = any(takes)
+    for i in range(n):
+        if decoding[i] or pending_lens[i] <= 0:
+            continue
+        take = min(pending_lens[i], chunk_size, max(budget, 0))
+        if take <= 0 and not any_progress:
+            take = 1      # sole-prefill guarantee
+        if take <= 0:
+            continue
+        takes[i] = take
+        budget -= take
+        any_progress = True
+    return takes
 
 
 class LLMEngine:
-    """Continuous-batching decode engine over a fixed slot batch."""
+    """Continuous-batching decode engine over a fixed slot batch.
+
+    `scheduler="continuous"` (default) is per-step join/evict with a
+    token-budget step plan: new requests enter the running batch at the
+    next decode step, finished sequences free their KV slot immediately,
+    and long prompts prefill in `prefill_chunk_size`-token chunks
+    (gpt2.prefill_chunk) under `max_num_batched_tokens` per step, with
+    decode lanes reserved first so prefill can't starve decode.
+    `scheduler="fixed"` is the admit-then-run loop kept for the serve
+    bench comparison: a batch is admitted only when every slot is free
+    and runs token-by-token to completion before the next admit.
+    """
 
     def __init__(self, preset: str = "gpt2-tiny", max_batch: int = 4,
                  max_seq_len: int = 128, seed: int = 0,
@@ -89,6 +135,9 @@ class LLMEngine:
                  enable_prefix_caching: bool = True,
                  kv_blocks: int = 64, kv_block_size: int = 16,
                  tensor_parallel_size: int = 1,
+                 scheduler: str = "continuous",
+                 prefill_chunk_size: int = 16,
+                 max_num_batched_tokens: Optional[int] = None,
                  params_override=None, cfg_override=None):
         import jax
         import jax.numpy as jnp
@@ -137,8 +186,20 @@ class LLMEngine:
                                    block_size=kv_block_size,
                                    dtype=cfg.dtype)
 
+        self.scheduler = scheduler
+        # chunk must fit the serving window (prefill_chunk requires C <= T)
+        self.prefill_chunk_size = max(1, min(prefill_chunk_size,
+                                             self.max_seq_len - 1))
+        self.max_num_batched_tokens = (
+            max_num_batched_tokens if max_num_batched_tokens
+            else max(2 * max_batch, max_batch + self.prefill_chunk_size))
+
         def _step(params, cache, tokens, pos, active):
             return gpt2.decode_step(params, cache, tokens, pos, active, cfg)
+
+        def _chunk(params, cache, tokens, pos0, length, active):
+            return gpt2.prefill_chunk(params, cache, tokens, pos0, length,
+                                      active, cfg)
 
         if tensor_parallel_size > 1:
             # TP-sharded engine (reference: vLLM TP workers in a
@@ -171,9 +232,17 @@ class LLMEngine:
                 in_shardings=(param_sh, {"k": cache_sh, "v": cache_sh},
                               rep, rep, rep),
                 out_shardings=(rep, {"k": cache_sh, "v": cache_sh}))
+            self._chunk_step = jax.jit(
+                _chunk, donate_argnums=(1,),
+                in_shardings=(param_sh, {"k": cache_sh, "v": cache_sh},
+                              rep, rep, rep, rep),
+                out_shardings=(rep, {"k": cache_sh, "v": cache_sh}))
         else:
             self.mesh = None
             self._step = jax.jit(_step, donate_argnums=(1,))
+            self._chunk_step = jax.jit(_chunk, donate_argnums=(1,))
+        if self.scheduler == "fixed":
+            self._chunk_step = None   # legacy admit-then-run, 1 token/step
         self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
@@ -182,10 +251,17 @@ class LLMEngine:
         self._slot_pos = [0] * max_batch
         self._slot_prefill: List[List[int]] = [[] for _ in range(max_batch)]
         self._stop = threading.Event()
+        self._stats_lock = threading.Lock()
+        self.total_generated = 0
+        self.engine_steps = 0          # jitted step calls (either kind)
+        self.chunk_steps = 0           # steps that ran the chunked program
+        self.tokens_prefilled = 0      # prompt tokens processed
+        self.ttft_sum = 0.0            # submit -> first generated token
+        self.ttft_count = 0
+        self.last_ttft_s = 0.0
         self._thread = threading.Thread(target=self._engine_loop, daemon=True,
                                         name="llm-engine")
         self._thread.start()
-        self.total_generated = 0
 
     # ------------------------------------------------------------- public
     def generate(self, prompt: str = "", prompt_ids: Optional[List[int]] = None,
@@ -316,6 +392,12 @@ class LLMEngine:
 
     # ------------------------------------------------------------- engine
     def _admit(self):
+        if self.scheduler == "fixed":
+            # admit-then-run: a new batch forms only once EVERY slot is
+            # free (the seed loop the continuous scheduler replaces; kept
+            # for the serve bench A/B)
+            if any(r is not None for r in self._slots):
+                return
         for i in range(self.max_batch):
             if self._slots[i] is None:
                 try:
@@ -348,7 +430,6 @@ class LLMEngine:
     def _engine_loop(self):
         import numpy as np
 
-        jnp = self.jnp
         rng = np.random.default_rng(0)
         last_sweep = time.time()
         while not self._stop.is_set():
@@ -360,68 +441,167 @@ class LLMEngine:
             if not live:
                 time.sleep(0.005)
                 continue
-            tokens = np.zeros((self.max_batch,), np.int32)
-            pos = np.asarray(self._slot_pos, np.int32)
-            active = np.zeros((self.max_batch,), bool)
-            for i in live:
-                active[i] = True
+            prefilling = any(self._slot_prefill[i] for i in live)
+            if prefilling and self._chunk_step is not None:
+                self._run_chunk_step(live, rng, np)
+            else:
+                self._run_decode_step(live, rng, np)
+
+    def _run_decode_step(self, live, rng, np):
+        """One single-token step for every live slot (the pure-decode fast
+        path; also the only step the fixed scheduler ever runs)."""
+        jnp = self.jnp
+        tokens = np.zeros((self.max_batch,), np.int32)
+        pos = np.asarray(self._slot_pos, np.int32)
+        active = np.zeros((self.max_batch,), bool)
+        for i in live:
+            active[i] = True
+            if self._slot_prefill[i]:
+                tokens[i] = self._slot_prefill[i][0]
+            else:
+                tokens[i] = (self._slots[i].generated[-1]
+                             if self._slots[i].generated
+                             else self._slots[i].prompt_ids[-1])
+        logits, self.cache = self._step(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(pos), jnp.asarray(active))
+        logits = np.asarray(logits)
+        self.engine_steps += 1
+        for i in live:
+            req = self._slots[i]
+            self._slot_pos[i] += 1
+            if self._slot_prefill[i]:
+                self._slot_prefill[i].pop(0)
+                self.tokens_prefilled += 1
                 if self._slot_prefill[i]:
-                    tokens[i] = self._slot_prefill[i][0]
-                else:
-                    tokens[i] = (self._slots[i].generated[-1]
-                                 if self._slots[i].generated
-                                 else self._slots[i].prompt_ids[-1])
-            logits, self.cache = self._step(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(pos), jnp.asarray(active))
-            logits = np.asarray(logits)
-            for i in live:
+                    continue  # still prefilling; ignore logits
+                if self.kv is not None:
+                    # prompt fully resident in this slot's cache:
+                    # publish its full blocks for future prefix hits
+                    # (dedup'd: shared prefixes stored once)
+                    self.kv.store_prefix(req.prompt_ids, self.cache, i)
+            self._finish_token(i, req, logits[i], rng, np)
+
+    def _run_chunk_step(self, live, rng, np):
+        """One token-budget step: decode slots advance one token each
+        (reserved first), prefilling slots consume up to a chunk of their
+        remaining prompt — all in ONE fused prefill_chunk call."""
+        jnp = self.jnp
+        B, C = self.max_batch, self.prefill_chunk_size
+        pending = [len(self._slot_prefill[i]) if self._slots[i] is not None
+                   else 0 for i in range(B)]
+        decoding = [self._slots[i] is not None and not self._slot_prefill[i]
+                    for i in range(B)]
+        takes = plan_chunk_budget(pending, decoding, C,
+                                  self.max_num_batched_tokens)
+        tokens = np.zeros((B, C), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        for i in live:
+            take = takes[i]
+            if take <= 0:
+                continue
+            # never step past the serving window (prefill_chunk requires
+            # pos0 + length <= T; _make_request already bounds prompts)
+            take = min(take, self.max_seq_len - self._slot_pos[i])
+            if take <= 0:
+                continue
+            lengths[i] = take
+            if self._slot_prefill[i]:
+                tokens[i, :take] = self._slot_prefill[i][:take]
+            else:
                 req = self._slots[i]
-                self._slot_pos[i] += 1
+                tokens[i, 0] = (req.generated[-1] if req.generated
+                                else req.prompt_ids[-1])
+        active = lengths > 0
+        if not active.any():
+            time.sleep(0.001)
+            return
+        logits, self.cache = self._chunk_step(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(np.asarray(self._slot_pos, np.int32)),
+            jnp.asarray(lengths), jnp.asarray(active))
+        logits = np.asarray(logits)
+        self.engine_steps += 1
+        self.chunk_steps += 1
+        for i in live:
+            take = int(lengths[i])
+            if take <= 0:
+                continue
+            req = self._slots[i]
+            self._slot_pos[i] += take
+            if self._slot_prefill[i]:
+                del self._slot_prefill[i][:take]
+                self.tokens_prefilled += take
                 if self._slot_prefill[i]:
-                    self._slot_prefill[i].pop(0)
-                    if self._slot_prefill[i]:
-                        continue  # still prefilling; ignore logits
-                    if self.kv is not None:
-                        # prompt fully resident in this slot's cache:
-                        # publish its full blocks for future prefix hits
-                        # (dedup'd: shared prefixes stored once)
-                        self.kv.store_prefix(req.prompt_ids, self.cache, i)
-                # sample the next token from this step's logits
-                if req.temperature > 0:
-                    lg = logits[i] / req.temperature
-                    if req.top_k and req.top_k < len(lg):
-                        kth = np.partition(lg, -req.top_k)[-req.top_k]
-                        lg = np.where(lg < kth, -np.inf, lg)
-                    p = np.exp(lg - lg.max())
-                    p /= p.sum()
-                    if req.top_p < 1.0:
-                        order = np.argsort(p)[::-1]
-                        # standard nucleus: smallest set whose mass reaches
-                        # top_p — keep a token if the mass BEFORE it is
-                        # still short of the threshold (inclusive of the
-                        # one that crosses it)
-                        csum = np.cumsum(p[order])
-                        keep = (csum - p[order]) < req.top_p
-                        mask = np.zeros_like(p, bool)
-                        mask[order[keep]] = True
-                        p = np.where(mask, p, 0.0)
-                        p /= p.sum()
-                    nxt = int(rng.choice(len(p), p=p))
-                else:
-                    nxt = int(np.argmax(logits[i]))
-                req.generated.append(nxt)
-                self.total_generated += 1
-                finished = (len(req.generated) >= req.max_tokens
-                            or nxt == self.tokenizer.eos_id
-                            or self._slot_pos[i] >= self.max_seq_len - 1)
-                if finished:
-                    req.finish_reason = ("stop" if nxt == self.tokenizer.eos_id
-                                         else "length")
-                    self._slots[i] = None
-                    req.done.set()
-                with req.progress:
-                    req.progress.notify_all()
+                    continue  # chunk didn't cover the prompt yet
+                if self.kv is not None:
+                    self.kv.store_prefix(req.prompt_ids, self.cache, i)
+            # the chunk ended at the prompt's final token (or a decode
+            # lane): its last-position logits seed/continue generation
+            self._finish_token(i, req, logits[i], rng, np)
+
+    def _finish_token(self, i, req, logit_row, rng, np):
+        """Sample one token from `logit_row`, append it, and evict the
+        slot the moment the request finishes (its KV slot frees for the
+        next admit — same tick)."""
+        if req.temperature > 0:
+            lg = logit_row / req.temperature
+            if req.top_k and req.top_k < len(lg):
+                kth = np.partition(lg, -req.top_k)[-req.top_k]
+                lg = np.where(lg < kth, -np.inf, lg)
+            p = np.exp(lg - lg.max())
+            p /= p.sum()
+            if req.top_p < 1.0:
+                order = np.argsort(p)[::-1]
+                # standard nucleus: smallest set whose mass reaches
+                # top_p — keep a token if the mass BEFORE it is
+                # still short of the threshold (inclusive of the
+                # one that crosses it)
+                csum = np.cumsum(p[order])
+                keep = (csum - p[order]) < req.top_p
+                mask = np.zeros_like(p, bool)
+                mask[order[keep]] = True
+                p = np.where(mask, p, 0.0)
+                p /= p.sum()
+            nxt = int(rng.choice(len(p), p=p))
+        else:
+            nxt = int(np.argmax(logit_row))
+        if req.t_first is None:
+            req.t_first = time.time()
+            with self._stats_lock:
+                self.last_ttft_s = req.t_first - req.t_enqueue
+                self.ttft_sum += self.last_ttft_s
+                self.ttft_count += 1
+        req.generated.append(nxt)
+        self.total_generated += 1
+        finished = (len(req.generated) >= req.max_tokens
+                    or nxt == self.tokenizer.eos_id
+                    or self._slot_pos[i] >= self.max_seq_len - 1)
+        if finished:
+            req.finish_reason = ("stop" if nxt == self.tokenizer.eos_id
+                                 else "length")
+            self._slots[i] = None
+            req.done.set()
+        with req.progress:
+            req.progress.notify_all()
+
+    def engine_stats(self) -> dict:
+        with self._stats_lock:
+            ttft_avg = (self.ttft_sum / self.ttft_count
+                        if self.ttft_count else 0.0)
+            last_ttft = self.last_ttft_s
+        return {"scheduler": self.scheduler,
+                "max_batch": self.max_batch,
+                "prefill_chunk_size": self.prefill_chunk_size,
+                "max_num_batched_tokens": self.max_num_batched_tokens,
+                "total_generated": self.total_generated,
+                "engine_steps": self.engine_steps,
+                "chunk_steps": self.chunk_steps,
+                "tokens_prefilled": self.tokens_prefilled,
+                "queued": self._queue.qsize(),
+                "slots_busy": sum(r is not None for r in self._slots),
+                "ttft_avg_s": round(ttft_avg, 6),
+                "last_ttft_s": round(last_ttft, 6)}
 
 
 class LLMServer:
@@ -458,8 +638,7 @@ class LLMServer:
         return self.engine.stream_next(stream_id, cursor=cursor)
 
     def stats(self) -> dict:
-        out = {"total_generated": self.engine.total_generated,
-               "max_batch": self.engine.max_batch}
+        out = self.engine.engine_stats()
         if self.engine.kv is not None:
             out["kv_cache"] = self.engine.kv.stats()
         return out
@@ -622,7 +801,9 @@ def build_openai_app(preset: str = "gpt2-tiny", max_batch: int = 4,
                      model_id: str = "ray-tpu-llm",
                      model_overrides: Optional[dict] = None,
                      num_tpu_chips: int = 0,
-                     checkpoint: Optional[str] = None):
+                     checkpoint: Optional[str] = None,
+                     slo_config: Optional[dict] = None,
+                     **engine_kwargs):
     """Deployment graph for an OpenAI-compatible server (reference
     `ray.serve.llm.build_openai_app`); run with
     `serve.run(app, route_prefix="/v1")`."""
@@ -634,10 +815,11 @@ def build_openai_app(preset: str = "gpt2-tiny", max_batch: int = 4,
     dep = deployment(OpenAIServer, name=f"openai-{model_id}",
                      num_replicas=num_replicas,
                      ray_actor_options=actor_options,
-                     max_ongoing_requests=max_batch * 2)
+                     max_ongoing_requests=max_batch * 2,
+                     slo_config=slo_config)
     return dep.bind(model_id=model_id, preset=preset, max_batch=max_batch,
                     max_seq_len=max_seq_len, model_overrides=model_overrides,
-                    checkpoint=checkpoint)
+                    checkpoint=checkpoint, **engine_kwargs)
 
 
 def build_llm_deployment(preset: str = "gpt2-tiny", max_batch: int = 4,
@@ -645,7 +827,9 @@ def build_llm_deployment(preset: str = "gpt2-tiny", max_batch: int = 4,
                          name: str = "llm",
                          model_overrides: Optional[dict] = None,
                          num_tpu_chips: int = 0,
-                         checkpoint: Optional[str] = None):
+                         checkpoint: Optional[str] = None,
+                         slo_config: Optional[dict] = None,
+                         **engine_kwargs):
     """Deployment for an LLM server (reference build_openai_app analog)."""
     from ray_tpu.serve.api import deployment
 
@@ -655,7 +839,8 @@ def build_llm_deployment(preset: str = "gpt2-tiny", max_batch: int = 4,
     dep = deployment(
         LLMServer, name=name, num_replicas=num_replicas,
         ray_actor_options=actor_options,
-        max_ongoing_requests=max_batch * 2)
+        max_ongoing_requests=max_batch * 2,
+        slo_config=slo_config)
     return dep.bind(preset=preset, max_batch=max_batch,
                     max_seq_len=max_seq_len, model_overrides=model_overrides,
-                    checkpoint=checkpoint)
+                    checkpoint=checkpoint, **engine_kwargs)

@@ -3,21 +3,31 @@
 Parity with the reference's per-node proxy actors
 (`python/ray/serve/_private/proxy.py`, starlette/uvicorn) re-based on
 aiohttp: the proxy polls the controller for the route table (long-poll-lite,
-`long_poll.py` role), matches the longest route prefix, pow-2-routes to a
+`long_poll.py` role), matches the longest route prefix, routes to a
 replica, and awaits the reply on the event loop — requests never block the
 loop thread.
+
+Serving-plane additions: the router's pow-2 choice compares LIVE load
+(gossiped queue depth / EWMA latency from `state.list_serve_stats()`,
+blended with local in-flight counts — see serve/live_signals.py) with
+prompt-prefix affinity kept as the tiebreak; the proxy runs SLO-aware
+admission control per route (429 + Retry-After when the projected wait
+exceeds the route's SLO or every replica's queue is at its bound), and
+failed submissions to a dying replica fail over to a healthy one instead
+of surfacing a 500.
 """
 
 from __future__ import annotations
 
 import asyncio
-import random
 import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve import live_signals
 
 ROUTE_REFRESH_S = 1.0
+SUBMIT_ATTEMPTS = 3     # original try + failovers on replica death
 
 # ------------------------------------------------------- serve telemetry
 _serve_metrics = None
@@ -35,8 +45,67 @@ def _get_serve_metrics():
                 "serve_request_seconds",
                 "Ingress request latency by matched route and status code",
                 tag_keys=("route", "code")),
+            "admitted": m.Counter(
+                "serve_admitted_total",
+                "Ingress requests admitted past the route's admission "
+                "policy", tag_keys=("route",)),
+            "shed": m.Counter(
+                "serve_shed_total",
+                "Ingress requests shed by SLO-aware admission control "
+                "(HTTP 429 / gRPC RESOURCE_EXHAUSTED)",
+                tag_keys=("route", "reason")),
+            "failover": m.Counter(
+                "serve_failover_total",
+                "Requests re-routed to another replica after an "
+                "infrastructure failure (replica death/drain)",
+                tag_keys=("route",)),
         }
     return _serve_metrics
+
+
+def note_admission(route: str, shed: Optional[dict]) -> Optional[int]:
+    """Count one admission decision (shared by the HTTP and gRPC
+    ingresses so the counters and the Retry-After formatting can't
+    drift); for a shed, returns the Retry-After hint in whole seconds
+    (ceiling, >= 1)."""
+    try:
+        m = _get_serve_metrics()
+        if shed is not None:
+            m["shed"].inc(tags={"route": route, "reason": shed["reason"]})
+        else:
+            m["admitted"].inc(tags={"route": route})
+    except Exception:
+        pass
+    if shed is None:
+        return None
+    return max(1, int(-(-float(shed["retry_after_s"]) // 1)))
+
+
+def _is_infra_error(e: BaseException) -> bool:
+    """Failures that justify re-routing to ANOTHER replica: the replica
+    died, drained, or its connection dropped. User exceptions raised
+    inside the deployment are NOT retried — they would re-run user code
+    for a deterministic failure.
+
+    NOTE: this gives ingress requests at-least-once semantics under
+    replica death — a handler that ran to completion just before its
+    process died may run again elsewhere. That matches the actor layer's
+    own lost-reply resend contract (client._fast_actor_send) and the
+    usual serving tradeoff: handlers observable from outside should be
+    idempotent per request."""
+    from ray_tpu.core import protocol
+    from ray_tpu.core.exceptions import (ActorDiedError,
+                                         ActorUnavailableError,
+                                         WorkerCrashedError)
+
+    if isinstance(e, (ActorDiedError, ActorUnavailableError,
+                      WorkerCrashedError, protocol.ConnectionLost,
+                      ConnectionRefusedError)):
+        return True
+    if isinstance(e, RuntimeError):
+        msg = str(e)
+        return "draining" in msg or "is gone" in msg
+    return False
 
 
 class Request:
@@ -89,14 +158,16 @@ def prompt_prefix_key(json_body) -> Optional[str]:
 
 
 class _AsyncRouter:
-    """Pow-2 replica choice with local in-flight counts, all-async;
-    optional prompt-prefix affinity (prefix-aware routing)."""
+    """Live-load replica choice (pow-2 on gossiped queue depth blended
+    with local in-flight counts), all-async; prompt-prefix affinity as
+    the tiebreak; per-route SLO admission; failover on replica death."""
 
     def __init__(self, controller, deployment: str):
         self._controller = controller
         self._deployment = deployment
         self._table: Dict[str, Any] = {}
         self._model_map: Dict[str, list] = {}
+        self._slo: Optional[dict] = None
         self._ts = 0.0
         self._inflight: Dict[str, int] = {}
         from collections import OrderedDict
@@ -112,54 +183,124 @@ class _AsyncRouter:
         if table:
             self._table = table["replicas"]
             self._model_map = table.get("models", {})
+            self._slo = table.get("slo")
             self._inflight = {t: self._inflight.get(t, 0)
                               for t in self._table}
+            # a dead replica's stale prefix mapping would eat a failed
+            # first route before the pow-2 fallback: evict entries whose
+            # replica left the route table
+            for key in [k for k, tag in self._prefix_map.items()
+                        if tag not in self._table]:
+                del self._prefix_map[key]
         self._ts = now
 
-    async def submit(self, method: str, args: tuple, kwargs: dict,
-                     model_id: Optional[str] = None,
-                     with_tag: bool = False,
-                     prefix_key: Optional[str] = None):
-        await self._refresh()
-        deadline = time.monotonic() + 30
-        while not self._table:
-            if time.monotonic() > deadline:
-                raise RuntimeError(f"no replicas for {self._deployment}")
-            await asyncio.sleep(0.1)
-            await self._refresh(force=True)
-        tags = list(self._table)
-        if model_id:
-            warm = [t for t in tags
-                    if model_id in self._model_map.get(t, [])]
-            if warm:
-                tags = warm
-            kwargs = {**kwargs, "_multiplexed_model_id": model_id}
-        tag = None
+    def _live_cache(self):
+        # lazy: unit tests build routers via __new__ with hand-set state
+        live = getattr(self, "_live", None)
+        if live is None:
+            live = self._live = live_signals.get_cache()
+        return live
+
+    def _drop_replica(self, tag: str) -> None:
+        """Stop routing to a replica this process just watched fail; the
+        next table refresh re-adds it only if the controller still
+        believes in it."""
+        self._table.pop(tag, None)
+        for key in [k for k, t in self._prefix_map.items() if t == tag]:
+            del self._prefix_map[key]
+
+    def _score(self, tag: str, now: float, max_age_s: float) -> float:
+        return live_signals.replica_score(
+            self._inflight.get(tag, 0),
+            self._live_cache().row(self._deployment, tag), now, max_age_s)
+
+    def _choose(self, tags, prefix_key: Optional[str]) -> str:
+        now = time.time()
+        max_age = live_signals._flag("serve_live_signal_max_age_s", 5.0)
         if prefix_key is not None and len(tags) > 1:
             # cache affinity: a replica that served this prefix holds its
             # KV blocks — prefer it unless clearly busier than the rest
             # (reference PrefixAwareRequestRouter's imbalance threshold)
             mapped = self._prefix_map.get(prefix_key)
             if mapped in self._table and mapped in tags:
-                floor = min(self._inflight.get(t, 0) for t in tags)
-                if (self._inflight.get(mapped, 0)
+                floor = min(self._score(t, now, max_age) for t in tags)
+                if (self._score(mapped, now, max_age)
                         <= floor + PREFIX_IMBALANCE_SLACK):
                     self._prefix_map.move_to_end(prefix_key)
-                    tag = mapped
-        if tag is None:
-            if len(tags) == 1:
-                tag = tags[0]
-            else:
-                a, b = random.sample(tags, 2)
-                tag = (a if self._inflight.get(a, 0)
-                       <= self._inflight.get(b, 0) else b)
-            if prefix_key is not None:
-                self._prefix_map[prefix_key] = tag
-                self._prefix_map.move_to_end(prefix_key)
-                while len(self._prefix_map) > PREFIX_MAP_CAP:
-                    self._prefix_map.popitem(last=False)
-        result = await self.submit_on(tag, method, args, kwargs)
-        return (result, tag) if with_tag else result
+                    return mapped
+        live = self._live_cache()
+        tag = live_signals.pick_pow2(
+            tags,
+            lambda t: self._score(t, now, max_age),
+            lambda t: live_signals.ewma_of(live.row(self._deployment, t)))
+        if prefix_key is not None:
+            self._prefix_map[prefix_key] = tag
+            self._prefix_map.move_to_end(prefix_key)
+            while len(self._prefix_map) > PREFIX_MAP_CAP:
+                self._prefix_map.popitem(last=False)
+        return tag
+
+    async def admission_check(self) -> Optional[dict]:
+        """None to admit; a shed dict ({"reason", "retry_after_s",
+        "projected_wait_s"}) to reject before touching a replica."""
+        await self._refresh()
+        slo = getattr(self, "_slo", None)
+        if not slo or not self._table:
+            return None
+        live = self._live_cache()
+        await live.refresh_async()
+        now = time.time()
+        replicas = [(self._inflight.get(t, 0),
+                     live.row(self._deployment, t))
+                    for t in self._table]
+        return live_signals.admission_decision(slo, replicas, now)
+
+    async def submit(self, method: str, args: tuple, kwargs: dict,
+                     model_id: Optional[str] = None,
+                     with_tag: bool = False,
+                     prefix_key: Optional[str] = None):
+        await self._refresh()
+        await self._live_cache().refresh_async()
+        deadline = time.monotonic() + 30
+        while not self._table:
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"no replicas for {self._deployment}")
+            await asyncio.sleep(0.1)
+            await self._refresh(force=True)
+        if model_id:
+            kwargs = {**kwargs, "_multiplexed_model_id": model_id}
+        excluded: set = set()
+        last_err: Optional[BaseException] = None
+        for attempt in range(SUBMIT_ATTEMPTS):
+            tags = [t for t in self._table if t not in excluded]
+            if model_id:
+                warm = [t for t in tags
+                        if model_id in self._model_map.get(t, [])]
+                if warm:
+                    tags = warm
+            if not tags:
+                break
+            tag = self._choose(tags, prefix_key)
+            try:
+                result = await self.submit_on(tag, method, args, kwargs)
+                return (result, tag) if with_tag else result
+            except Exception as e:  # noqa: BLE001 - classified below
+                if not _is_infra_error(e) or attempt == SUBMIT_ATTEMPTS - 1:
+                    raise
+                # replica died/drained mid-request: fail over to another
+                # replica instead of surfacing a 500 for an operation the
+                # replica never completed
+                last_err = e
+                excluded.add(tag)
+                self._drop_replica(tag)
+                try:
+                    _get_serve_metrics()["failover"].inc(
+                        tags={"route": self._deployment})
+                except Exception:
+                    pass
+                await self._refresh(force=True)
+        raise last_err or RuntimeError(
+            f"no live replicas for {self._deployment}")
 
     async def submit_on(self, tag: str, method: str, args: tuple,
                         kwargs: dict):
@@ -280,6 +421,21 @@ class ProxyActor:
         if router is None:
             router = self._routers[deployment] = _AsyncRouter(
                 self._get_controller(), deployment)
+        # SLO-aware admission control: shed BEFORE reading the body into
+        # a replica call — an overloaded route answers 429 + Retry-After
+        # from the proxy alone (reference: Serve's backpressure returns
+        # 503; 429 matches the retryable-client contract here)
+        try:
+            shed = await router.admission_check()
+        except Exception:
+            shed = None     # a broken signal plane must not block ingress
+        retry_after = note_admission(match, shed)
+        if shed is not None:
+            return web.json_response(
+                {"error": "deployment over capacity",
+                 "reason": shed["reason"],
+                 "projected_wait_s": shed.get("projected_wait_s")},
+                status=429, headers={"Retry-After": str(retry_after)})
         body = await request.read()
         try:
             json_body = await request.json() if body else None

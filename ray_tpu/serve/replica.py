@@ -58,6 +58,10 @@ class ReplicaActor:
         with self._ongoing_lock:
             self._ongoing += 1
             self._total += 1
+        # publish on ADMIT as well as completion: live-signal routing and
+        # admission control read the gossiped queue depth, which must
+        # rise while a burst is still executing, not after it drains
+        self._publish_load(self._ewma_latency_s)
         t0 = time.perf_counter()
         try:
             from ray_tpu.serve import multiplex

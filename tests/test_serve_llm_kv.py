@@ -128,6 +128,7 @@ def test_prefix_aware_router_affinity():
             self.tag = tag
 
     r = _AsyncRouter.__new__(_AsyncRouter)
+    r._deployment = "test"
     r._table = {"r1": FakeHandle("r1"), "r2": FakeHandle("r2"),
                 "r3": FakeHandle("r3")}
     r._inflight = {"r1": 0, "r2": 0, "r3": 0}

@@ -27,6 +27,8 @@ def test_soak_single_command(tmp_path):
     assert report["warm_burst"]["tasks_completed"] == 2 * 40
     assert report["large_object"]["mb_moved"] >= 4 * 12
     assert report["large_object"]["mb_per_s"] > 0
+    assert report["serve"]["failed"] == 0
+    assert report["serve"]["served"] > 0
     assert report["elastic_train"]["final_world_size"] == 1
     assert report["elastic_train"]["restarts"] >= 1
     assert report["elastic_train"]["recovery_s"] > 0
