@@ -10,6 +10,12 @@ plane from silently regressing.
 
 Suites:
   control (default) — benchmarks/control_plane_microbench.json
+                      (single-stream rates, head_restart_recoveries_per_s,
+                       elastic_train_recovery_s, serve(_traced)_rps,
+                       peer_spillback_tasks_per_s — task throughput with
+                       the head SIGSTOPped, via the peer-spillback mesh —
+                       and view_convergence_s — 2000 interest-scoped
+                       virtual nodes on the sharded view plane)
   data              — benchmarks/data_plane_microbench.json
                       (p2p_pull_mb_s, head_restart_large_object_recovery_s)
   serve             — benchmarks/serve_microbench.json

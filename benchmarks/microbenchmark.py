@@ -129,6 +129,151 @@ def head_restart_metric() -> float:
                 os.environ[k] = v
 
 
+def peer_spillback_metric(shapes: int = 4, per_shape: int = 40) -> float:
+    """Sustained task completions per second while the head is
+    SIGSTOPped: cold-path leases route local-pool-first, then through
+    epoch-fenced peer referrals, and parked client dispatch queues drain
+    through the granted leases — the headless throughput the PR-11
+    tentpole exists to keep alive. Asserts at least one peer grant
+    actually happened inside the measured window."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster, carve_pool
+
+    overrides = {"RAY_TPU_LEASE_IDLE_S": "1.0",
+                 "RAY_TPU_POOL_IDLE_S": "120",
+                 "RAY_TPU_POOL_ACQUIRE_TIMEOUT_S": "2",
+                 "RAY_TPU_METRICS_PUSH_INTERVAL_S": "0.5"}
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    cluster = Cluster(num_cpus=0)
+    cluster.add_node(num_cpus=2, labels={"zone": "a"})
+    cluster.add_node(num_cpus=2, labels={"zone": "b"})
+    paused = False
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+        client = ray_tpu.core.api._global_client()
+        deadline = time.time() + 30
+        while time.time() < deadline and sum(
+                1 for e in client.cluster_view.entries.values()
+                if e.get("sched_addr")) < 2:
+            time.sleep(0.2)
+        for e in list(client.cluster_view.entries.values()):
+            if e.get("sched_addr"):
+                carve_pool(client, tuple(e["sched_addr"]), 2,
+                           selector={"zone": e["labels"]["zone"]})
+
+        fns = []
+        for i in range(shapes):
+            exec(f"@ray_tpu.remote\ndef _ps_g{i}(x):\n"
+                 f"    return x\nfns.append(_ps_g{i})",
+                 {"ray_tpu": ray_tpu, "fns": fns})
+
+        # the pause must catch EVERY cached view knowing both warm pools
+        # (daemons are pushed before pubsub subscribers in one broadcast
+        # tick, so the driver seeing 2/2 implies the daemons did too)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            idles = [e.get("idle_workers", 0)
+                     for e in client.cluster_view.entries.values()
+                     if e.get("sched_addr")]
+            if sum(1 for i in idles if i >= 2) >= 2:
+                break
+            time.sleep(0.2)
+        cluster.stop_head()
+        paused = True
+        client._head_suspect_until = time.monotonic() + 300
+        t0 = time.perf_counter()
+        out = ray_tpu.get([f.remote(j) for j in range(per_shape)
+                           for f in fns], timeout=180)
+        elapsed = time.perf_counter() - t0
+        assert len(out) == shapes * per_shape
+        assert client.lease_stats["peer_grants"] >= 1, client.lease_stats
+        cluster.cont_head()
+        paused = False
+        return len(out) / elapsed
+    finally:
+        if paused:
+            cluster.cont_head()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def view_convergence_metric(n_nodes: int = 2000, n_shards: int = 32) -> float:
+    """Seconds for a `n_nodes`-virtual-node cluster to converge on the
+    sharded, interest-scoped view plane (lower is better): every vnode
+    registered, the driver's full view complete, sampled vnodes holding
+    their own shard plus a digest covering the whole cluster — and no
+    scoped subscriber ever served a full-fanout push (asserted, not
+    gated). The same protocol as the slow-marked 2000-vnode smoke."""
+    import resource
+
+    import ray_tpu
+    from ray_tpu.core.resource_view import shard_of
+    from ray_tpu.cluster_utils import VirtualNodes
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < 4 * n_nodes:
+        resource.setrlimit(resource.RLIMIT_NOFILE,
+                           (min(4 * n_nodes, hard), hard))
+    saved = {k: os.environ.get(k) for k in
+             ("RAY_TPU_VIEW_SHARDS", "RAY_TPU_VIEW_DIGEST_REFRESH_S")}
+    os.environ["RAY_TPU_VIEW_SHARDS"] = str(n_shards)
+    os.environ["RAY_TPU_VIEW_DIGEST_REFRESH_S"] = "5.0"
+    ray_tpu.init(num_cpus=2, num_tpu_chips=0, max_workers=4)
+    vnodes = None
+    try:
+        client = ray_tpu.core.api._global_client()
+        t0 = time.perf_counter()
+        vnodes = VirtualNodes(client.head_host, client.head_port, n_nodes)
+        vnodes.start(timeout=480)
+        deadline = time.time() + 480
+        sample = [0, n_nodes // 2, n_nodes - 1]
+        while time.time() < deadline:
+            if len(client.cluster_view.entries) < n_nodes + 1:
+                time.sleep(0.25)
+                continue
+            done = True
+            for i in sample:
+                view = vnodes.views[i]["view"]
+                me = vnodes.node_ids[i]
+                if (me not in view.entries
+                        or (view.digest or {}).get("total_nodes", 0)
+                        < n_nodes + 1):
+                    done = False
+                    break
+            if done:
+                break
+            time.sleep(0.25)
+        elapsed = time.perf_counter() - t0
+        assert len(client.cluster_view.entries) >= n_nodes + 1, \
+            f"driver view stuck at {len(client.cluster_view.entries)}"
+        max_push = max(s["max_push"] for s in vnodes.views)
+        assert max_push < n_nodes, \
+            f"a scoped subscriber received a full-fanout push ({max_push})"
+        for i in sample:
+            assert vnodes.node_ids[i] in vnodes.views[i]["view"].entries, \
+                f"vnode {i} never converged"
+        return elapsed
+    finally:
+        if vnodes is not None:
+            vnodes.stop()
+        ray_tpu.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _elastic_train_loop(config):
     """Tiny GPT-2 DDP loop for the elastic-recovery bench/soak: per-worker
     2-device mesh, cross-worker kv-collective grad sync, sharded
@@ -690,6 +835,17 @@ def control_plane(out_path: str | None = None) -> dict:
     # re-adopted and the carve-out ledger reconciled (PR 3 tentpole)
     phase("head_restart_recoveries_per_s")
     results["head_restart_recoveries_per_s"] = head_restart_metric()
+
+    # headless-resilience row: task throughput with the head SIGSTOPped,
+    # served by daemon-local grants + epoch-fenced peer referrals
+    phase("peer_spillback_tasks_per_s")
+    results["peer_spillback_tasks_per_s"] = peer_spillback_metric()
+
+    # view-plane scale row: 2000 interest-scoped virtual nodes converge
+    # on the sharded broadcast plane (seconds, lower is better; asserts
+    # no scoped subscriber ever received a full-fanout push)
+    phase("view_convergence_s")
+    results["view_convergence_s"] = view_convergence_metric()
 
     # elastic-training robustness row: daemon SIGKILL mid-GPT-2-DDP run →
     # death-event detection, fence, reshape to surviving capacity,

@@ -9,6 +9,12 @@ FIXED seed, so a failure replays identically:
   all complete (the two-level warm path absorbs injected gossip delay and
   duplicated frames without dropping work).
 
+  phase 1b — head-paused burst: SIGSTOP the head mid warm+cold burst on
+  a 2-node cluster; task completions must continue through the
+  peer-spillback mesh (daemon-local + epoch-fenced peer-referred grants,
+  cold tasks parked in client-local dispatch queues) and the pool
+  ledgers must reconcile on SIGCONT with zero double grants.
+
   phase 2 — large-object data plane: an isolation-mode 2-node cluster
   where the consumer node's processes run a seeded drop plan on their
   data edges; workers repeatedly consume large remote objects, so every
@@ -82,6 +88,114 @@ def warm_burst_soak(seed: int, rounds: int = 6, burst: int = 40) -> dict:
         except Exception:
             pass
         cluster.shutdown()
+
+
+def head_paused_burst(seed: int, shapes: int = 4, per_shape: int = 8) -> dict:
+    """SIGSTOP the head mid warm+cold burst: task completions must
+    CONTINUE through the peer-spillback mesh (daemon-local grants +
+    epoch-fenced peer-referred grants, cold tasks parked in the client's
+    local dispatch queues), and on SIGCONT the pool ledgers must
+    reconcile with zero double grants and zero stale-epoch rejects."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster, carve_pool
+
+    overrides = {"RAY_TPU_LEASE_IDLE_S": "0.5",
+                 "RAY_TPU_POOL_IDLE_S": "60",
+                 "RAY_TPU_POOL_ACQUIRE_TIMEOUT_S": "2",
+                 "RAY_TPU_METRICS_PUSH_INTERVAL_S": "0.5"}
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    cluster = Cluster(num_cpus=0)
+    cluster.add_node(num_cpus=2, labels={"zone": "a"})
+    cluster.add_node(num_cpus=2, labels={"zone": "b"})
+    paused = False
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+        client = ray_tpu.core.api._global_client()
+        deadline = time.time() + 30
+        while time.time() < deadline and sum(
+                1 for e in client.cluster_view.entries.values()
+                if e.get("sched_addr")) < 2:
+            time.sleep(0.2)
+        for e in list(client.cluster_view.entries.values()):
+            if e.get("sched_addr"):
+                carve_pool(client, tuple(e["sched_addr"]), 2,
+                           selector={"zone": e["labels"]["zone"]})
+
+        fns = []
+        for i in range(shapes):
+            exec(f"@ray_tpu.remote\ndef _soak_g{i}(x):\n"
+                 f"    return x * {i + 2}\nfns.append(_soak_g{i})",
+                 {"ray_tpu": ray_tpu, "fns": fns})
+
+        # warm half the shapes before the pause (their defs + leases have
+        # existed; the rest stay cold so the outage window exercises the
+        # parked/referral path), then let the warm leases idle back into
+        # the pools so the pause catches both daemons at full pools
+        warm = fns[: shapes // 2]
+        assert ray_tpu.get([f.remote(1) for f in warm], timeout=90)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            idles = [e.get("idle_workers", 0)
+                     for e in client.cluster_view.entries.values()
+                     if e.get("sched_addr")]
+            if (sum(1 for i in idles if i >= 2) >= 2
+                    and not client._leases):
+                break
+            time.sleep(0.2)
+        t_pause = time.perf_counter()
+        cluster.stop_head()
+        paused = True
+        client._head_suspect_until = time.monotonic() + 120
+        refs = [f.remote(j) for j in range(per_shape) for f in fns]
+        out = ray_tpu.get(refs, timeout=120)
+        paused_window_s = time.perf_counter() - t_pause
+        expect = [j * (i + 2) for j in range(per_shape)
+                  for i in range(shapes)]
+        assert out == expect, "burst results corrupted"
+        cluster.cont_head()
+        paused = False
+        client._head_suspect_until = 0.0
+
+        def rows():
+            return [r for r in client.head_request(
+                "list_state", kind="scheduler_stats")
+                if not r.get("is_head")]
+
+        deadline = time.time() + 60
+        peer_grants = 0
+        while time.time() < deadline:
+            rs = rows()
+            ok = rs and all(
+                r.get("pooled_workers") == (r.get("idle_workers", 0)
+                                            + r.get("leased_workers", 0))
+                for r in rs)
+            peer_grants = sum(r.get("peer_grants", 0) for r in rs)
+            if ok and peer_grants >= 1:
+                break
+            time.sleep(0.5)
+        assert peer_grants >= 1, f"no peer grants recorded: {rows()}"
+        head_row = next(r for r in client.head_request(
+            "list_state", kind="scheduler_stats") if r.get("is_head"))
+        assert head_row.get("stale_epoch_rejects", 0) == 0, head_row
+        return {"tasks_completed": len(out),
+                "paused_window_s": round(paused_window_s, 2),
+                "peer_grants": peer_grants,
+                "client_peer_grants": client.lease_stats["peer_grants"]}
+    finally:
+        if paused:
+            cluster.cont_head()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def large_object_soak(seed: int, rounds: int = 4, mb: int = 12) -> dict:
@@ -267,6 +381,9 @@ def main(seed: int = 7, out: str | None = None, rounds: int = 6,
     report = {"seed": seed}
     print(f"[soak] warm burst under chaos (seed={seed})", file=sys.stderr)
     report["warm_burst"] = warm_burst_soak(seed, rounds=rounds)
+    print(f"[soak] head-paused burst via peer spillback (seed={seed})",
+          file=sys.stderr)
+    report["head_paused"] = head_paused_burst(seed)
     print(f"[soak] large-object data plane under chaos (seed={seed})",
           file=sys.stderr)
     report["large_object"] = large_object_soak(seed)

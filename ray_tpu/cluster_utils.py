@@ -63,6 +63,23 @@ class Cluster:
                                 env=env)
 
     # -------------------------------------------------- head FT drills
+    def stop_head(self) -> None:
+        """SIGSTOP the head — the mid-burst pause drill: every TCP
+        connection stays open but nothing answers. Daemons and clients
+        must keep task throughput alive through the peer-spillback mesh
+        and reconcile cleanly on `cont_head`."""
+        import signal
+
+        self._head.send_signal(signal.SIGSTOP)
+
+    def cont_head(self) -> None:
+        """SIGCONT the paused head; queued gossip, releases and head-path
+        submissions drain, and the ledgers must reconcile with zero
+        double-grants."""
+        import signal
+
+        self._head.send_signal(signal.SIGCONT)
+
     def kill_head(self) -> None:
         """SIGKILL the head process (reference GCS-kill chaos drill).
         Node daemons keep serving warm leases and reconnect when
@@ -169,3 +186,166 @@ class Cluster:
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 pass
+
+
+def carve_pool(client, sched_addr, n, timeout: float = 90,
+               selector: Optional[Dict[str, str]] = None) -> None:
+    """Warm one daemon's pool to `n` idle workers by leasing directly
+    from its scheduler and returning the grants — the carve path the
+    client's lease machinery uses, minus the racing head queue. A label
+    selector matching only that node keeps the carve from turning into
+    a peer referral. Shared by the headless-resilience drills (tests)
+    and the soak's head-paused phase."""
+    import asyncio
+
+    from ray_tpu.core import protocol
+
+    async def carve():
+        conn = await protocol.connect(sched_addr[0], sched_addr[1],
+                                      name=f"warm-{sched_addr[1]}")
+        try:
+            deadline = time.time() + timeout
+            wids = []
+            while len(wids) < n and time.time() < deadline:
+                rep = await conn.request(
+                    "lease_grant", resources={"CPU": 1},
+                    label_selector=selector,
+                    epoch=client.cluster_epoch or None)
+                if rep and not rep.get("spill") and not rep.get("peers"):
+                    wids.append(rep["worker_id"])
+                else:
+                    await asyncio.sleep(0.5)
+            for w in wids:
+                await conn.request("lease_return", worker_id=w)
+            return len(wids)
+        finally:
+            await conn.close()
+
+    got = asyncio.run_coroutine_threadsafe(carve(), client.loop).result(
+        timeout=timeout + 10)
+    assert got == n, f"carved {got}/{n} at {sched_addr}"
+
+
+class VirtualNodes:
+    """N fake node registrations over real sockets on a private loop —
+    the reference cluster_utils strategy scaled past process counts: all
+    gossip/view/shard code paths run for real, only worker spawning is
+    absent (their resources never fit a task, so nothing schedules to
+    them). Shared by the gossip-convergence smokes (tests) and the
+    `view_convergence_s` bench row, so both measure the same protocol.
+
+    `interest="auto"` registers each vnode as an interest-scoped view
+    subscriber (the sharded plane); None keeps legacy full-fanout."""
+
+    def __init__(self, host: str, port: int, n: int, interest="auto"):
+        import asyncio
+        import threading
+
+        self.host, self.port, self.n = host, port, n
+        self.interest = interest
+        self.loop = asyncio.new_event_loop()
+        self.conns: List[object] = []
+        self.node_ids: List[str] = []
+        self.views: List[dict] = []  # per-vnode: last snap + push stats
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="vnodes")
+
+    def _run(self):
+        import asyncio
+
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def start(self, timeout: float = 120):
+        import asyncio
+
+        self._thread.start()
+        fut = asyncio.run_coroutine_threadsafe(self._bring_up(), self.loop)
+        fut.result(timeout=timeout)
+
+    async def _bring_up(self):
+        import asyncio
+
+        from ray_tpu.core import protocol
+        from ray_tpu.core.ids import NodeID
+
+        async def _noop(**kwargs):
+            return True
+
+        sem = asyncio.Semaphore(64)  # bounded concurrent connects
+
+        async def _one(i: int, slot: dict):
+            async with sem:
+                from ray_tpu.core.resource_view import ClusterView
+
+                slot["view"] = ClusterView()
+
+                async def _on_view(snap, _slot=slot):
+                    _slot["snap"] = snap
+                    _slot["pushes"] += 1
+                    n_entries = (len(snap.get("nodes") or ())
+                                 + sum(len(b.get("nodes") or ())
+                                       for b in snap.get("shards") or ()))
+                    _slot["entries_rx"] += n_entries
+                    _slot["max_push"] = max(_slot["max_push"], n_entries)
+                    # real consumer semantics: adopt like a daemon would
+                    if "shards" in snap:
+                        _slot["view"].adopt_shards(snap)
+                    else:
+                        _slot["view"].adopt(snap)
+                    return True
+
+                conn = await protocol.connect(
+                    self.host, self.port,
+                    handlers={"cluster_view": _on_view,
+                              "health_ping": _noop, "spawn_worker": _noop,
+                              "kill_worker": _noop, "shutdown_node": _noop,
+                              "free_object": _noop, "adopt_object": _noop,
+                              "drop_replica": _noop,
+                              "reconcile_request": _noop, "chaos": _noop,
+                              "pool_worker_died": _noop},
+                    name=f"vnode{i}")
+                nid = NodeID.generate()
+                await conn.request(
+                    "register_node", node_id=nid.binary(),
+                    # a resource no task asks for: these nodes exist for
+                    # the gossip/view plane only and never win placement
+                    resources={"vslot": 1.0}, labels={"vnode": str(i)},
+                    max_workers=0, data_port=0, sched_port=0,
+                    interest=self.interest)
+                slot["conn"] = conn
+                slot["node_id"] = nid.hex()
+
+        tasks = []
+        for i in range(self.n):
+            slot = {"snap": None, "pushes": 0, "entries_rx": 0,
+                    "max_push": 0}
+            self.views.append(slot)
+            tasks.append(_one(i, slot))
+        await __import__("asyncio").gather(*tasks)
+        self.conns = [s["conn"] for s in self.views]
+        self.node_ids = [s["node_id"] for s in self.views]
+
+    def kill(self, i: int):
+        import asyncio
+
+        asyncio.run_coroutine_threadsafe(
+            self.conns[i].close(), self.loop).result(timeout=10)
+
+    def stop(self):
+        import asyncio
+
+        async def _close_all():
+            for conn in self.conns:
+                try:
+                    await conn.close()
+                except Exception:
+                    pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _close_all(), self.loop).result(timeout=30)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=10)

@@ -172,7 +172,17 @@ class CoreClient:
                                 if data_port else None)
         self._sched_conns: Dict[Tuple[str, int], protocol.Connection] = {}
         self.lease_stats = {"daemon_grants": 0, "head_grants": 0,
-                            "spills": 0}
+                            "spills": 0, "peer_grants": 0}
+        # headless resilience: cold-path tasks park in per-shape local
+        # dispatch queues while the head is unreachable/suspect and drain
+        # through daemon/peer-granted leases — the head stops being a
+        # required hop on the cold task path. `_head_suspect_until` is
+        # armed when a head lease RPC times out with the connection still
+        # "open" (a paused head keeps TCP alive).
+        self._lease_parked: Dict[tuple, deque] = {}
+        self._lease_parked_ts: Dict[tuple, float] = {}
+        self._parked_exec_tasks: set = set()
+        self._head_suspect_until = 0.0
         # epoch fencing: the cluster epoch observed from the head
         # (registration reply + cluster_view pushes); lease traffic to
         # node-daemon schedulers is tagged with it, and a daemon that has
@@ -1203,6 +1213,32 @@ class CoreClient:
             self._daemon_pulled.popitem(last=False)
         return local
 
+    async def _pull_from_cache(self, oid: ObjectID) -> Optional[ObjectMeta]:
+        """One warm resolution attempt entirely from cache: gossiped
+        directory meta + cluster-view addresses (node pull manager first,
+        then direct pulls with replica failover). None when the cache
+        cannot resolve the object — never a head RPC."""
+        node_local = self._daemon_pulled.get(oid)
+        if node_local is not None and self._probe_readable(node_local):
+            return node_local
+        fresh = self.object_dir.lookup_meta(oid)
+        if fresh is None:
+            return None
+        self.local_metas[oid] = fresh
+        if self._probe_readable(fresh):
+            return fresh
+        sources = self._sources_from_view(fresh)
+        if sources or fresh.node_id is not None:
+            local = await self._pull_via_node(fresh, sources)
+            if local is not None:
+                return local
+        for addr in sources:
+            try:
+                return await self._pull_from(addr, fresh)
+            except (protocol.RpcError, OSError, FileNotFoundError):
+                continue
+        return None
+
     async def _locate_or_pull(self, meta: ObjectMeta) -> ObjectMeta:
         oid = meta.object_id
         with self._pulled_lock:
@@ -1236,20 +1272,68 @@ class CoreClient:
             except (protocol.RpcError, OSError, FileNotFoundError):
                 continue  # node lost / object moved: next source or head
         if (not sources and meta.node_id is not None
-                and meta.kind in ("shm", "arena", "spilled")):
+                and meta.kind in ("shm", "arena", "spilled")
+                and not self._head_suspect()):
             # meta names its node but the cached view doesn't know that
             # node's data server yet (cold driver): one head lookup
-            addr = await self.conn.request(
-                "node_data_addr", node_id=meta.node_id.binary())
+            try:
+                addr = await asyncio.wait_for(
+                    self.conn.request("node_data_addr",
+                                      node_id=meta.node_id.binary()),
+                    timeout=10.0)
+            except (protocol.RpcError, OSError, asyncio.TimeoutError):
+                addr = None
             if addr is not None:
                 try:
                     return await self._pull_from(tuple(addr), meta)
                 except (protocol.RpcError, OSError, FileNotFoundError):
                     pass
         # cold miss / all cached routes failed: the head directory is the
-        # fallback — refreshed meta + every advertised source
-        rep = await self.conn.request(
-            "locate_object", object_id=oid.binary(), timeout=30)
+        # fallback — refreshed meta + every advertised source. The head
+        # may be unreachable (outage) or unresponsive (paused), and this
+        # shared pull task can be JOINED by get()s issued after the
+        # gossiped directory learned the object — so between bounded head
+        # attempts, re-consult the cached directory and serve from it the
+        # moment it resolves: a cold miss must never block a now-warm hit
+        # behind a head retry loop.
+        # the deadline budgets FAILED attempts against a trusted head; a
+        # suspect head (paused/reconnecting) pushes it out instead — a
+        # transient control-plane outage must stall this get(), like the
+        # unbounded request it replaces, not surface a spurious
+        # ObjectLostError for an object that is merely unresolvable from
+        # cache. A hard cap (reconnect window + slack) still bounds the
+        # truly-dead-head case.
+        deadline = time.monotonic() + 30.0
+        hard_deadline = time.monotonic() + max(
+            float(_config.get("reconnect_timeout_s")), 0.0) + 60.0
+        last_exc: Optional[BaseException] = None
+        while True:
+            local = await self._pull_from_cache(oid)
+            if local is not None:
+                return local
+            rep = None
+            if not self._head_suspect():
+                try:
+                    # client-side bound outlasts the server-side get_meta
+                    # wait, so it only fires against a head that stopped
+                    # answering entirely (paused/hung)
+                    rep = await asyncio.wait_for(
+                        self.conn.request("locate_object",
+                                          object_id=oid.binary(),
+                                          timeout=30),
+                        timeout=40.0)
+                    break
+                except (protocol.RpcError, OSError,
+                        asyncio.TimeoutError) as e:
+                    last_exc = e
+            else:
+                deadline = max(deadline, time.monotonic() + 10.0)
+            if time.monotonic() >= min(deadline, hard_deadline):
+                raise ObjectLostError(
+                    f"object {oid} unresolvable: head unreachable and the "
+                    f"cached directory has no serving copy "
+                    f"({last_exc!r})") from last_exc
+            await asyncio.sleep(0.2)
         if rep is None:
             raise ObjectLostError(f"object {oid} is gone")
         fresh = rep["meta"]
@@ -1642,10 +1726,15 @@ class CoreClient:
                     lease.dead = True
                     del self._leases[shape]
 
-    async def _daemon_lease_grant(self, entry: dict,
-                                  options: dict) -> Optional[dict]:
+    async def _daemon_lease_grant(self, entry: dict, options: dict,
+                                  referred=None) -> Optional[dict]:
         """Ask the chosen node daemon for a lease; None = spill to head
-        (infeasible there, stale view, or the daemon is unreachable)."""
+        (infeasible there, stale view, or the daemon is unreachable).
+        A reply carrying "peers" is a peer referral — the daemon's pool
+        missed but its cached view names peer daemons with warm idle
+        workers; the caller completes the grant there. `referred` marks
+        a request that IS such a completion (the peer grants warm-pool
+        only, never cascading)."""
         addr = tuple(entry["sched_addr"])
         conn = None
         try:
@@ -1664,7 +1753,8 @@ class CoreClient:
                     resources=options.get("resources") or {"CPU": 1},
                     label_selector=options.get("label_selector"),
                     venv_key=(options.get("runtime_env") or {}).get("pip_key"),
-                    epoch=self.cluster_epoch or None),
+                    epoch=self.cluster_epoch or None,
+                    referred=referred),
                 timeout=10.0)
         except asyncio.TimeoutError:
             # the daemon may still complete this grant after we give up —
@@ -1678,17 +1768,59 @@ class CoreClient:
         except (protocol.RpcError, OSError):
             return None
         if not rep or rep.get("spill"):
+            if rep and rep.get("peers") and not referred:
+                return rep  # peer referral: caller follows it
             self.lease_stats["spills"] += 1
             return None
         return rep
+
+    def _head_suspect(self) -> bool:
+        """True while the head cannot be counted on to answer: the
+        connection is down/re-forming, or a recent head RPC timed out
+        with the socket still "open" (a SIGSTOPped head keeps TCP alive
+        — liveness is judged by answers, not by the connection)."""
+        return (not self._connected.is_set() or self.conn is None
+                or self.conn.closed
+                or time.monotonic() < self._head_suspect_until)
+
+    def _only_pool_capacity(self, options: dict) -> bool:
+        """True when the cached view says this shape can ONLY be served
+        by warm daemon pools: no feasible node has ledger-free capacity.
+        Pushing such a task onto the head queue would starve it until a
+        pool release returns capacity (the pools hold the whole ledger),
+        so the local dispatch queue + lease path is strictly better —
+        the head could not have parallelized it anyway."""
+        if not _config.get("node_local_sched") \
+                or not self.cluster_view.entries:
+            return False
+        from ray_tpu.core.resource_view import fits, matches_labels
+
+        res = options.get("resources") or {"CPU": 1}
+        sel = options.get("label_selector")
+        saw_pool = False
+        for e in self.cluster_view.entries.values():
+            if not matches_labels(e.get("labels") or {}, sel):
+                continue
+            if not fits(e.get("total") or {}, res):
+                continue
+            if fits(e.get("free") or {}, res):
+                return False  # the head can dispatch this somewhere
+            if e.get("idle_workers") and e.get("sched_addr"):
+                saw_pool = True
+        return saw_pool
 
     def _maybe_acquire_lease(self, shape: tuple, options: dict) -> None:
         """Fire-and-forget lease acquisition — never blocks a submit.
 
         Warm path: the cached cluster view names a feasible node daemon
-        and the grant is node-local (zero head involvement). Spillback to
-        the head's acquire_lease on label miss, infeasibility, or a stale
-        view (the daemon refused)."""
+        and the grant is node-local (zero head involvement). A daemon
+        whose pool misses may answer with a peer REFERRAL — peer daemons
+        whose gossiped pools show warm idle workers; the grant completes
+        there (mode "peer", epoch-fenced by the peer) with zero head
+        RPCs. Spillback to the head's acquire_lease only on label miss,
+        infeasibility, or when the mesh has no warm capacity — and not
+        at all while the head is suspect (parked cold tasks retry the
+        mesh instead)."""
         with self._lease_lock:
             if shape in self._leases or shape in self._lease_acquiring:
                 return
@@ -1698,21 +1830,75 @@ class CoreClient:
             traced = self._sched_tracing()
             t0 = time.time() if traced else 0.0
             mode = None
+            acquired = False
             try:
                 rep, via = None, None
                 entry = self._pick_lease_node(options)
                 if entry is not None:
                     rep = await self._daemon_lease_grant(entry, options)
-                    if rep is not None:
+                    if rep is not None and rep.get("peers"):
+                        # peer referral: the chosen daemon's pool missed,
+                        # but its cached view names warm peers — complete
+                        # the grant there (one hop, no cascading)
+                        referral, rep = rep, None
+                        for p in referral["peers"]:
+                            prep = await self._daemon_lease_grant(
+                                {"sched_addr": p["sched_addr"]}, options,
+                                referred=entry["node_id"])
+                            if prep is not None and not prep.get("peers"):
+                                rep = prep
+                                via = tuple(p["sched_addr"])
+                                self.lease_stats["daemon_grants"] += 1
+                                self.lease_stats["peer_grants"] += 1
+                                mode = "peer"
+                                break
+                    elif rep is not None:
                         via = tuple(entry["sched_addr"])
                         self.lease_stats["daemon_grants"] += 1
                         mode = "local"
                 if rep is None:
                     # spillback: a daemon refused (stale view/labels/full)
-                    # or no feasible view node existed — the head grants
+                    # or no feasible view node existed — the head grants,
+                    # unless it is suspect (closed, reconnecting, or
+                    # recently unresponsive): then fail the attempt and
+                    # let the parked-task retry loop re-try the mesh
                     mode = "spillback" if entry is not None else "head"
-                    rep = await self.conn.request("acquire_lease",
-                                                  options=options)
+                    if not self._head_suspect():
+                        try:
+                            hfut = self.conn.request_future(
+                                "acquire_lease", options=options)
+                        except Exception:
+                            hfut = None
+                        try:
+                            if hfut is not None:
+                                rep = await asyncio.wait_for(
+                                    asyncio.shield(hfut), timeout=15.0)
+                        except (protocol.RpcError, OSError):
+                            rep = None
+                        except asyncio.TimeoutError:
+                            # the socket is open but the head is not
+                            # answering (paused/hung): reroute cold tasks
+                            # through the peer mesh for a while. A LATE
+                            # grant is handed straight back (the head
+                            # debited a worker for a requester that gave
+                            # up — releasing it is the leak fence).
+                            rep = None
+                            self._head_suspect_until = \
+                                time.monotonic() + 10.0
+
+                            def _late(f):
+                                if f.cancelled() or f.exception():
+                                    return
+                                r = f.result()
+                                if r:
+                                    try:
+                                        self.conn.push(
+                                            "release_lease",
+                                            worker_id=r["worker_id"])
+                                    except Exception:
+                                        pass
+
+                            hfut.add_done_callback(_late)
                     if rep is not None:
                         self.lease_stats["head_grants"] += 1
                 if rep is not None:
@@ -1732,6 +1918,7 @@ class CoreClient:
                             worker=lease.worker_id.hex()[:12])
                     with self._lease_lock:
                         self._leases[shape] = lease
+                    acquired = True
                     self._start_lease_reaper()
                 elif traced:
                     self._sched_event("lease-acquire", mode=mode or "none",
@@ -1739,8 +1926,129 @@ class CoreClient:
             finally:
                 with self._lease_lock:
                     self._lease_acquiring.discard(shape)
+            self._settle_parked(shape, options, acquired)
 
         asyncio.run_coroutine_threadsafe(_acquire(), self.loop)
+
+    def _park_for_lease(self, shape: tuple, options: dict, spec: dict,
+                        return_id: ObjectID):
+        """Park a cold-path task in the local per-shape dispatch queue
+        while the head is suspect: it dispatches through the daemon/peer
+        lease once one lands instead of riding the head queue. Returns
+        True (parked), False (queue full — caller falls back to the head
+        path), or "retry" (a lease landed concurrently — caller submits
+        through it)."""
+        cap = int(_config.get("lease_park_max"))
+        cfut: _cf.Future = _cf.Future()
+        with self._lease_lock:
+            lease = self._leases.get(shape)
+            if lease is not None and not lease.dead:
+                return "retry"
+            q = self._lease_parked.setdefault(shape, deque())
+            if len(q) >= cap:
+                return False
+            q.append((spec, cfut))
+            self._lease_parked_ts.setdefault(shape, time.monotonic())
+        with self._pending_lock:
+            self._pending_calls[return_id] = cfut
+        pins = [ObjectRef(ObjectID(b)) for b in spec["deps"]]
+
+        def _on_done(f, _pins=pins):
+            _pins.clear()
+            try:
+                meta = f.result()["meta"]
+            except BaseException:
+                return
+            if meta is not None:
+                self.local_metas[meta.object_id] = meta
+
+        cfut.add_done_callback(_on_done)
+        self._maybe_acquire_lease(shape, options)
+        return True
+
+    def _settle_parked(self, shape: tuple, options: dict,
+                       acquired: bool) -> None:
+        """After a lease acquisition attempt: drain this shape's parked
+        tasks through the fresh lease, or — with no lease — re-try the
+        mesh shortly while the head stays suspect, falling back to the
+        head queue the moment it is trusted again. Runs on the loop."""
+        items = []
+        lease = None
+        with self._lease_lock:
+            q = self._lease_parked.get(shape)
+            if not q:
+                self._lease_parked.pop(shape, None)
+                self._lease_parked_ts.pop(shape, None)
+                return
+            if acquired:
+                lease = self._leases.get(shape)
+                if lease is not None and not lease.dead:
+                    items = list(q)
+                    q.clear()
+                    self._lease_parked.pop(shape, None)
+                    self._lease_parked_ts.pop(shape, None)
+                    lease.inflight += len(items)
+                    lease.last_used = time.monotonic()
+                else:
+                    lease = None
+        if lease is not None:
+            for spec, cfut in items:
+                task = asyncio.ensure_future(
+                    self._lease_exec_async(lease, spec))
+                # STRONG reference until done: asyncio tracks tasks
+                # weakly, and a drained exec task whose only ref was this
+                # loop variable was observed garbage-collected mid-flight
+                # (its coroutine turned up "already awaited")
+                self._parked_exec_tasks.add(task)
+                task.add_done_callback(self._parked_exec_tasks.discard)
+
+                def _chain(t, _cfut=cfut):
+                    if _cfut.cancelled():
+                        return
+                    if t.cancelled():
+                        _cfut.cancel()
+                    elif t.exception() is not None:
+                        _cfut.set_exception(t.exception())
+                    else:
+                        _cfut.set_result(t.result())
+
+                task.add_done_callback(_chain)
+            return
+        parked_age = time.monotonic() - self._lease_parked_ts.get(
+            shape, time.monotonic())
+        if self._head_suspect() or (self._only_pool_capacity(options)
+                                    and parked_age < 2.0):
+            # no lease and no usable head queue (unreachable, or the
+            # pools hold the whole ledger): keep the tasks parked and
+            # re-try the mesh — the daemon pools / referral candidates
+            # are re-read from the cached view each attempt, and a pool
+            # release flips the view back to head-drainable. Pool-held
+            # parking is age-bounded: a shape the pools can't actually
+            # serve (wrong size/venv) must reach the HEAD queue, where
+            # the pool_trim reclaim loop can free capacity for it —
+            # parked tasks are invisible to that loop.
+            self.loop.call_later(
+                0.5, lambda: self._maybe_acquire_lease(shape, options))
+            return
+        # head is trusted again: the parked tasks take the classic head
+        # path (push + at-least-once inflight tracking); their parked
+        # futures resolve to the None-meta marker so get() falls through
+        # to the head directory, exactly like a lease failover
+        with self._lease_lock:
+            q = self._lease_parked.pop(shape, None)
+            self._lease_parked_ts.pop(shape, None)
+            items = list(q) if q else []
+        for spec, cfut in items:
+            with self._inflight_lock:
+                self._inflight_specs[ObjectID(spec["return_ids"][0])] = spec
+                while len(self._inflight_specs) > 4096:
+                    self._inflight_specs.popitem(last=False)
+            try:
+                self.conn.push("submit_task", spec=spec)
+            except Exception:
+                pass
+            if not cfut.done():
+                cfut.set_result({"meta": None})
 
     def _release_lease_now(self, lease: "_Lease") -> None:
         """Hand a lease back to whoever granted it (loop thread only)."""
@@ -1922,6 +2230,13 @@ class CoreClient:
                 "deps": deps, "return_ids": [return_id.binary()],
                 "borrows": [(o.binary(), t) for o, t in tokens],
                 "options": options}
+        if self._head_suspect():
+            # headless dispatch: the granted worker may never have run
+            # this function, and its KV fetch would stall on the dead/
+            # paused head — ship the definition with the spec
+            blob = self.fn_manager.blob(fn_key)
+            if blob is not None:
+                spec["fn_blob"] = blob
         # caller-held pins keep deps alive until completion (the head is
         # not involved, so it cannot pin them — same as direct actor
         # calls); deps already includes the big-args payload object
@@ -1955,14 +2270,52 @@ class CoreClient:
             deps = deps + [payload["meta"].object_id.binary()]
         task_id = TaskID.generate()
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
-        if (self._lease_eligible(options, num_returns)
-                and self._try_lease_submit(fn_key, payload, deps, tokens,
-                                           options, task_id, return_ids[0])):
-            if traced:
-                self._sched_event("submit", task_id=task_id,
-                                  name=options.get("name"), mode="lease",
-                                  t0=t_submit, t1=time.time())
-            return [ObjectRef(return_ids[0])]
+        if self._lease_eligible(options, num_returns):
+            if self._try_lease_submit(fn_key, payload, deps, tokens,
+                                      options, task_id, return_ids[0]):
+                if traced:
+                    self._sched_event("submit", task_id=task_id,
+                                      name=options.get("name"), mode="lease",
+                                      t0=t_submit, t1=time.time())
+                return [ObjectRef(return_ids[0])]
+            attempts = 0
+            while (self._head_suspect()
+                   or self._only_pool_capacity(options)) and attempts < 4:
+                attempts += 1
+                # cold path without a usable head queue: either the head
+                # is unreachable (outage/pause), or every feasible node's
+                # capacity lives in daemon pools (head-queueing would
+                # starve until a pool release). Park the task in the
+                # local per-shape dispatch queue; it drains through the
+                # daemon/peer-granted lease once the acquisition lands
+                spec = {"task_id": task_id, "fn_key": fn_key,
+                        "args": payload, "deps": deps,
+                        "return_ids": [return_ids[0].binary()],
+                        "borrows": [(o.binary(), t) for o, t in tokens],
+                        "options": options}
+                blob = self.fn_manager.blob(fn_key)
+                if blob is not None:
+                    # definitions ride parked specs: the worker that
+                    # eventually executes must not stall on a head KV
+                    # fetch the outage makes impossible
+                    spec["fn_blob"] = blob
+                parked = self._park_for_lease(
+                    self._lease_shape(fn_key, options), options, spec,
+                    return_ids[0])
+                if parked is True:
+                    if traced:
+                        self._sched_event(
+                            "submit", task_id=task_id,
+                            name=options.get("name"), mode="parked",
+                            t0=t_submit, t1=time.time())
+                    return [ObjectRef(return_ids[0])]
+                if parked == "retry":
+                    if self._try_lease_submit(fn_key, payload, deps,
+                                              tokens, options, task_id,
+                                              return_ids[0]):
+                        return [ObjectRef(return_ids[0])]
+                    continue
+                break  # queue full: classic head path below
         spec = {"task_id": task_id, "fn_key": fn_key, "args": payload,
                 "deps": deps, "return_ids": [o.binary() for o in return_ids],
                 # head releases these if the task dies before any worker

@@ -129,6 +129,36 @@ FLAGS: List[Flag] = [
          "Clients route lease requests to node-daemon schedulers via the "
          "cached cluster view; off = every lease goes through the head.",
          negotiated=True),
+    Flag("peer_spill_attempts", "RAY_TPU_PEER_SPILL_ATTEMPTS", int, 2,
+         "On a local-pool miss a node daemon refers the client to up to "
+         "this many peer daemons whose gossiped pools show warm idle "
+         "workers (epoch-stamped peer grants; the head becomes the last "
+         "resort). 0 disables daemon-to-daemon spillback.",
+         negotiated=True),
+    Flag("pool_acquire_timeout_s", "RAY_TPU_POOL_ACQUIRE_TIMEOUT_S",
+         float, 8.0,
+         "Daemon-side bound on the head pool_acquire carve-out RPC; a "
+         "paused/hung head must fail over to peer referral or client "
+         "spill instead of stalling the grant forever."),
+    Flag("lease_park_max", "RAY_TPU_LEASE_PARK_MAX", int, 256,
+         "Per-shape bound on cold-path tasks parked in the client's "
+         "local dispatch queue while the head is unreachable (drained "
+         "through daemon/peer-granted leases; overflow falls back to "
+         "the head path)."),
+    Flag("view_shards", "RAY_TPU_VIEW_SHARDS", int, 0,
+         "Shard the cluster_view broadcast: interest-scoped subscribers "
+         "(node daemons register interest='auto') receive only the "
+         "node-set shards they route against plus a compact digest for "
+         "spillback candidate selection, instead of the full node list "
+         "(head-side flag; 0/1 = full-fanout broadcasts)."),
+    Flag("view_digest_k", "RAY_TPU_VIEW_DIGEST_K", int, 16,
+         "Spillback-candidate rows carried in the sharded-view digest "
+         "(top idle-pool nodes cluster-wide)."),
+    Flag("view_digest_refresh_s", "RAY_TPU_VIEW_DIGEST_REFRESH_S",
+         float, 2.0,
+         "Cadence for refreshing a scoped subscriber's digest when none "
+         "of its interest shards changed (keeps spillback candidate "
+         "idle counts honest without full-fanout broadcasts)."),
     Flag("reconnect_timeout_s", "RAY_TPU_RECONNECT_TIMEOUT_S", float, 30.0,
          "Window for clients to reconnect to a restarted head; 0 = die "
          "on disconnect."),

@@ -71,6 +71,10 @@ class NodeInfo:
         self.pool_leased = 0
         self.fr_last_seq = 0
         self.reconciled = conn is None  # head-local node: nothing to do
+        # interest-scoped view plane: None = legacy full-fanout; else
+        # {"interest": [shard ids], "sent": {sid: version last pushed},
+        #  "digest_ts": monotonic ts of the last digest refresh}
+        self.view_sub: Optional[dict] = None
         self.pending_pool: Dict[WorkerID, dict] = {}  # claimed at register
         self.unadopted: Set["WorkerInfo"] = set()     # parked reconnectors
         self.alive = True
@@ -395,6 +399,14 @@ class Head:
         self._view_seq = 0
         self._last_view_snap: Optional[dict] = None
         self._view_wake: Optional[asyncio.Event] = None
+        # sharded view plane (view_shards > 1): independent per-shard
+        # versions bumped whenever any node in the shard changes, and the
+        # scoped pubsub subscribers' send state (daemons keep theirs on
+        # NodeInfo). Interest-scoped subscribers receive only changed
+        # interest shards (as shard snapshots) plus a compact digest —
+        # never the full node list.
+        self._shard_vs: Dict[int, int] = {}
+        self._sub_views: Dict[protocol.Connection, dict] = {}
         # serve-replica live-load rows piggybacked on the cluster_view
         # broadcast (changed-only): routers/handles/autoscalers read the
         # gossiped queue depth / EWMA latency with ZERO head RPCs on the
@@ -534,7 +546,8 @@ class Head:
                     "driver_sys_path": self.kv.get(("cluster", b"driver_sys_path"))}
 
         async def register_node(node_id, resources, labels, max_workers,
-                                data_port=None, sched_port=None):
+                                data_port=None, sched_port=None,
+                                interest=None):
             nid = NodeID(node_id)
             existing = self.nodes.get(nid)
             if existing is not None and not existing.is_head:
@@ -548,6 +561,7 @@ class Head:
                 node.conn = conn_state["conn"]
                 node.alive = True
                 node.reconciled = False
+                node.view_sub = self._make_view_sub(interest, nid)
                 if data_port:
                     node.data_addr = (_peer_host() or "127.0.0.1", data_port)
                 if sched_port:
@@ -561,12 +575,14 @@ class Head:
                      "node_id": nid.hex()})
                 self._kick()
                 self._view_changed()
-                self._push_full_view(conn_state["conn"])
+                self._push_full_view(conn_state["conn"],
+                                     sub=node.view_sub)
                 return {"session": self.session,
                         "head_node_id": self.node_id.binary(),
                         "epoch": self.cluster_epoch}
             node = NodeInfo(nid, resources, labels, conn_state["conn"],
                             max_workers)
+            node.view_sub = self._make_view_sub(interest, nid)
             if data_port:
                 node.data_addr = (_peer_host() or "127.0.0.1", data_port)
             if sched_port:
@@ -576,7 +592,7 @@ class Head:
             self._publish("node_state", {"node_id": nid.binary(), "state": "ALIVE"})
             self._kick()
             self._view_changed()
-            self._push_full_view(conn_state["conn"])
+            self._push_full_view(conn_state["conn"], sub=node.view_sub)
             return {"session": self.session,
                     "head_node_id": self.node_id.binary(),
                     "epoch": self.cluster_epoch}
@@ -1182,13 +1198,21 @@ class Head:
                 self._kick()
             return True
 
-        async def subscribe(channel):
-            self.subscribers.setdefault(channel, []).append(conn_state["conn"])
+        async def subscribe(channel, interest=None):
+            conn = conn_state["conn"]
+            self.subscribers.setdefault(channel, []).append(conn)
             if channel == "cluster_view":
+                sub = self._make_view_sub(
+                    interest, conn_state["worker"].node_id
+                    if conn_state.get("worker") else None)
+                if sub is not None:
+                    # interest-scoped pubsub subscriber: tracked alongside
+                    # the daemons' send state; pruned with the connection
+                    self._sub_views[conn] = sub
                 # late subscribers must not wait for the next view CHANGE
                 # to learn the current one (object-directory payload
                 # included wholesale — deltas only carry recent history)
-                self._push_full_view(conn_state["conn"], pubsub=True)
+                self._push_full_view(conn, pubsub=True, sub=sub)
             return True
 
         async def cluster_info():
@@ -2628,20 +2652,185 @@ class Head:
                                     "127.0.0.1",
                                     exclude=self.node_id.hex())
 
-    def _push_full_view(self, conn, pubsub: bool = False) -> None:
+    # ------------------------------------------- sharded view plane
+    def _make_view_sub(self, interest, nid) -> Optional[dict]:
+        """Resolve a subscriber's declared interest into scoped-send
+        state. None (legacy full-fanout) when sharding is off or the
+        subscriber declared none; "auto" scopes a node to its own shard
+        — the shard carrying its entry and its neighborhood."""
+        nshards = int(_config.get("view_shards"))
+        if interest is None or nshards <= 1:
+            return None
+        from ray_tpu.core.resource_view import shard_of
+
+        if interest == "auto":
+            if nid is None:
+                return None
+            scope = [shard_of(nid.hex(), nshards)]
+        else:
+            scope = sorted({int(s) % nshards for s in interest})
+        return {"interest": scope, "sent": {}, "digest_ts": 0.0}
+
+    def _note_shard_changes(self, prev: Optional[dict], cur: dict,
+                            nshards: int) -> None:
+        """Bump the version of every shard whose node set changed between
+        two view snapshots — the delta-compaction cursor scoped
+        subscribers are diffed against."""
+        from ray_tpu.core.resource_view import shard_of
+
+        prev_by = {e["node_id"]: e for e in (prev or {}).get("nodes", ())}
+        cur_by = {e["node_id"]: e for e in cur["nodes"]}
+        dirty = set()
+        for h, e in cur_by.items():
+            if prev_by.get(h) != e:
+                dirty.add(shard_of(h, nshards))
+        for h in prev_by:
+            if h not in cur_by:
+                dirty.add(shard_of(h, nshards))
+        for sid in dirty:
+            self._shard_vs[sid] = self._shard_vs.get(sid, 0) + 1
+
+    def _build_view_digest(self, snap: dict, nshards: int) -> dict:
+        """Compact cluster-wide summary shipped with every scoped
+        payload: the spillback-candidate rows (top warm pools, what a
+        daemon needs to pick a peer outside its interest shards) and the
+        total node count — O(digest_k), independent of cluster size."""
+        k = max(int(_config.get("view_digest_k")), 1)
+        cands = [e for e in snap["nodes"] if e.get("sched_addr")]
+        cands.sort(key=lambda e: e.get("idle_workers", 0), reverse=True)
+        return {"nshards": nshards, "total_nodes": len(snap["nodes"]),
+                "candidates": [
+                    {"node_id": e["node_id"],
+                     "sched_addr": tuple(e["sched_addr"]),
+                     "idle_workers": e.get("idle_workers", 0),
+                     "labels": e.get("labels") or {}}
+                    for e in cands[:k]]}
+
+    def _dir_record_scope(self, rec: dict, nshards: int):
+        """Shard set a directory record is relevant to, or None for
+        global records (frees/node-death are small removal facts every
+        consumer needs; a record for a node outside a subscriber's
+        interest is skipped — that subscriber cold-misses into the
+        locate_object fallback, which is the documented semantics)."""
+        from ray_tpu.core.resource_view import shard_of
+
+        op = rec.get("op")
+        if op in ("seal", "spill"):
+            nid = rec["meta"].node_id
+            return {shard_of(nid.hex(), nshards)} if nid is not None \
+                else None
+        if op in ("replica", "replica_gone"):
+            sids = {shard_of(rec["node"], nshards)}
+            ent = self.object_dir.entries.get(ObjectID(rec["oid"]))
+            if ent is not None and ent.meta.node_id is not None:
+                sids.add(shard_of(ent.meta.node_id.hex(), nshards))
+            return sids
+        return None  # free / node_dead: global
+
+    def _scope_dir_payload(self, payload: Optional[dict], interest,
+                           nshards: int,
+                           scopes: Optional[list] = None) -> Optional[dict]:
+        """Filter one directory broadcast payload to a subscriber's
+        interest shards. `scopes` carries the per-record scope sets
+        precomputed once per tick for delta payloads."""
+        if payload is None or interest is None:
+            return payload
+        want = set(interest)
+        if payload.get("full") is not None:
+            from ray_tpu.core.resource_view import shard_of
+
+            kept = []
+            for ent in payload["full"]:
+                nid = ent["meta"].node_id
+                sids = set()
+                if nid is not None:
+                    sids.add(shard_of(nid.hex(), nshards))
+                sids.update(shard_of(h, nshards)
+                            for h in ent.get("replicas") or ())
+                if not sids or sids & want:
+                    kept.append(ent)
+            return {"v": payload["v"], "full": kept}
+        delta = payload.get("delta") or ()
+        if scopes is None:
+            scopes = [self._dir_record_scope(r, nshards) for r in delta]
+        kept = [r for r, sids in zip(delta, scopes)
+                if sids is None or sids & want]
+        if not kept:
+            return None
+        return {"v": payload["v"], "delta": kept}
+
+    def _scoped_view_payload(self, sub: dict, snap: dict, nshards: int,
+                             digest: dict, shard_entries: dict,
+                             dir_payload, dir_scopes, serve_payload,
+                             now: float, refresh_s: float) -> Optional[dict]:
+        """Build one scoped subscriber's payload for this tick: only its
+        interest shards whose version moved past what it was last sent
+        (each as a wholesale shard snapshot — replace semantics need no
+        tombstones), its scoped slice of the directory delta, and the
+        digest. None when it owes nothing this tick (digest refreshes
+        ride a slower cadence than the broadcast loop)."""
+        shards = []
+        for sid in sub["interest"]:
+            v = self._shard_vs.get(sid, 0)
+            if v > sub["sent"].get(sid, -1):
+                shards.append({"sid": sid, "v": v,
+                               "nodes": shard_entries.get(sid, [])})
+        objects = self._scope_dir_payload(dir_payload, sub["interest"],
+                                          nshards, scopes=dir_scopes)
+        if (not shards and objects is None and serve_payload is None
+                and now - sub["digest_ts"] < refresh_s):
+            return None
+        for b in shards:
+            sub["sent"][b["sid"]] = b["v"]
+        sub["digest_ts"] = now
+        payload = {"version": snap["version"], "epoch": self.cluster_epoch,
+                   "nshards": nshards, "shards": shards, "digest": digest}
+        if objects is not None:
+            payload["objects"] = objects
+        if serve_payload is not None:
+            payload["workloads"] = serve_payload
+        return payload
+
+    def _push_full_view(self, conn, pubsub: bool = False,
+                        sub: Optional[dict] = None) -> None:
         """Push the current view with a WHOLESALE object-directory payload
         to one connection (a late subscriber or a (re)registered daemon):
         delta broadcasts only carry changes since the last tick, and a
         joiner that missed history must not cold-miss on every object.
         Daemons take the raw `cluster_view` push; drivers/workers get the
-        pubsub-wrapped flavor their subscription expects."""
+        pubsub-wrapped flavor their subscription expects. A scoped
+        subscriber (`sub`) gets ALL its interest shards as snapshots at
+        their current versions plus the digest — never the full list."""
         snap = dict(self._last_view_snap or self._build_view_snapshot())
-        if _config.get("object_directory"):
-            snap["objects"] = self.object_dir.full_payload(self._dir_seq)
-        if self._last_serve_rows:
-            # late joiners get the current serve-load rows immediately
-            # instead of waiting for the next row change
-            snap["workloads"] = self._last_serve_rows
+        snap.setdefault("version", self._view_seq)
+        dir_on = _config.get("object_directory")
+        if sub is not None:
+            from ray_tpu.core.resource_view import shard_of
+
+            nshards = int(_config.get("view_shards"))
+            shard_entries: Dict[int, list] = {}
+            for e in snap["nodes"]:
+                shard_entries.setdefault(
+                    shard_of(e["node_id"], nshards), []).append(e)
+            # reset the send cursor so _scoped_view_payload emits EVERY
+            # interest shard as a fresh snapshot (one format owner for
+            # registration-time and broadcast-tick scoped payloads)
+            sub["sent"] = {}
+            sub["digest_ts"] = 0.0
+            snap = self._scoped_view_payload(
+                sub, snap, nshards,
+                self._build_view_digest(snap, nshards), shard_entries,
+                (self.object_dir.full_payload(self._dir_seq)
+                 if dir_on else None), None,
+                self._last_serve_rows or None, time.monotonic(),
+                refresh_s=0.0)
+        else:
+            if dir_on:
+                snap["objects"] = self.object_dir.full_payload(self._dir_seq)
+            if self._last_serve_rows:
+                # late joiners get the current serve-load rows immediately
+                # instead of waiting for the next row change
+                snap["workloads"] = self._last_serve_rows
         try:
             if pubsub:
                 conn.push("pubsub", channel="cluster_view", msg=snap)
@@ -2654,7 +2843,14 @@ class Head:
         """Debounced push of the compacted cluster view to every node
         daemon and every subscribed driver (the head half of the
         ray_syncer role). Broadcasts only when the view actually changed;
-        `_view_changed` wakes it early (node join/death, gossip delta)."""
+        `_view_changed` wakes it early (node join/death, gossip delta).
+
+        With `view_shards` > 1 the fan-out is interest-scoped: scoped
+        subscribers receive only their changed interest shards (as shard
+        snapshots versioned per shard) plus the compact digest, so a
+        single node's pool churn costs O(shard size × interested
+        subscribers), not O(nodes × subscribers) — the full-fanout
+        broadcast that capped the gossip smoke at ~200 virtual nodes."""
         interval = _config.get("view_broadcast_s")
         if interval <= 0:
             return
@@ -2665,36 +2861,148 @@ class Head:
             except asyncio.TimeoutError:
                 pass
             self._view_wake.clear()
+            nshards = int(_config.get("view_shards"))
+            sharding = nshards > 1
             snap = self._build_view_snapshot()
             nodes_changed = (self._last_view_snap is None
                              or snap["nodes"] != self._last_view_snap["nodes"])
             dir_payload = self._dir_payload()
             serve_payload = self._serve_loads_payload()
+            refresh_s = float(_config.get("view_digest_refresh_s"))
+            now_m = time.monotonic()
+            digest_due = sharding and (
+                any((now_m - n.view_sub["digest_ts"]) >= refresh_s
+                    for n in self.nodes.values()
+                    if n.view_sub is not None and n.alive)
+                or any((now_m - s["digest_ts"]) >= refresh_s
+                       for s in self._sub_views.values()))
             if (not nodes_changed and dir_payload is None
-                    and serve_payload is None):
+                    and serve_payload is None and not digest_due):
                 continue
             if nodes_changed:
                 self._view_seq += 1
                 snap["version"] = self._view_seq
+                if sharding:
+                    self._note_shard_changes(self._last_view_snap, snap,
+                                             nshards)
                 self._last_view_snap = snap
             else:
                 # object-directory-only tick: reuse the current view body
                 # (version unchanged — consumers' version bookkeeping is
                 # for the NODE entries; directory ordering rides dir v)
                 snap = dict(self._last_view_snap)
+            full_snap = snap
             if dir_payload is not None:
-                snap = dict(snap)
-                snap["objects"] = dir_payload
+                full_snap = dict(full_snap)
+                full_snap["objects"] = dir_payload
             if serve_payload is not None:
-                snap = dict(snap)
-                snap["workloads"] = serve_payload
+                full_snap = dict(full_snap)
+                full_snap["workloads"] = serve_payload
+            digest = shard_entries = dir_scopes = None
+            if sharding:
+                from ray_tpu.core.resource_view import shard_of
+
+                digest = self._build_view_digest(snap, nshards)
+                shard_entries = {}
+                for e in snap["nodes"]:
+                    shard_entries.setdefault(
+                        shard_of(e["node_id"], nshards), []).append(e)
+                if dir_payload is not None and dir_payload.get("delta"):
+                    dir_scopes = [self._dir_record_scope(r, nshards)
+                                  for r in dir_payload["delta"]]
+            now = time.monotonic()
             for node in self.nodes.values():
-                if node.conn is not None and node.alive and not node.conn.closed:
+                if node.conn is None or not node.alive or node.conn.closed:
+                    continue
+                if sharding and node.view_sub is not None:
+                    payload = self._scoped_view_payload(
+                        node.view_sub, snap, nshards, digest,
+                        shard_entries, dir_payload, dir_scopes,
+                        serve_payload, now, refresh_s)
+                    if payload is None:
+                        continue
                     try:
-                        node.conn.push("cluster_view", snap=snap)
+                        node.conn.push("cluster_view", snap=payload)
                     except Exception:
                         pass
-            self._publish("cluster_view", snap)
+                    continue
+                try:
+                    node.conn.push("cluster_view", snap=full_snap)
+                except Exception:
+                    pass
+            if sharding and self._sub_views:
+                # scoped pubsub subscribers (pruned with their conns)
+                for conn in [c for c in self._sub_views if c.closed]:
+                    del self._sub_views[conn]
+                for conn, sub in self._sub_views.items():
+                    payload = self._scoped_view_payload(
+                        sub, snap, nshards, digest, shard_entries,
+                        dir_payload, dir_scopes, serve_payload, now,
+                        refresh_s)
+                    if payload is not None:
+                        try:
+                            conn.push("pubsub", channel="cluster_view",
+                                      msg=payload)
+                        except Exception:
+                            pass
+            conns = self.subscribers.get("cluster_view")
+            if conns:
+                live = [c for c in conns if not c.closed]
+                if len(live) != len(conns):
+                    self.subscribers["cluster_view"] = live  # prune dead
+                scoped = ({id(c) for c in self._sub_views}
+                          if sharding else ())
+                for conn in live:
+                    if id(conn) in scoped:
+                        continue  # already served a scoped payload above
+                    conn.push("pubsub", channel="cluster_view",
+                              msg=full_snap)
+
+    async def _pool_reclaim_loop(self) -> None:
+        """Anti-starvation reclaim: daemon pools borrow ledger capacity,
+        and nothing used to force it back before pool_idle_s — so a
+        head-queued task whose only feasible nodes are fully pooled
+        starved for the whole idle window. When dep-free queued tasks
+        can't fit anywhere but a feasible node gossips idle POOL
+        workers, push a pool_trim: the daemon releases one matching
+        worker through the normal ack-tracked path and the queue drains
+        within a tick instead of a pool-idle period."""
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            if not self.queue or self._shutdown:
+                continue
+            now = time.monotonic()
+            needed = []
+            for rec in self.queue:
+                if rec.pending_deps:
+                    continue
+                needed.append(
+                    (rec.spec["options"].get("resources") or {"CPU": 1},
+                     rec.spec["options"].get("label_selector")))
+                if len(needed) >= 8:
+                    break
+            for res, sel in needed:
+                if any(n.alive and n.matches_labels(sel) and n.fits(res)
+                       for n in self.nodes.values()):
+                    continue  # schedulable: the normal kick will place it
+                for node in self.nodes.values():
+                    if (node.alive and node.conn is not None
+                            and not node.conn.closed
+                            and node.pool_idle > 0
+                            and node.matches_labels(sel)
+                            and node.could_ever_fit(res)
+                            and not node.fits(res)
+                            and now - getattr(node, "_last_trim_ts", 0.0)
+                            > 2.0):
+                        node._last_trim_ts = now
+                        self.lease_events.append(
+                            {"ts": time.time(), "kind": "pool_trim",
+                             "node_id": node.node_id.hex()})
+                        try:
+                            node.conn.push("pool_trim", resources=res)
+                        except Exception:
+                            pass
+                        break
 
     def _publish(self, channel: str, msg: dict) -> None:
         conns = self.subscribers.get(channel)
@@ -3273,6 +3581,7 @@ class Head:
         asyncio.ensure_future(self._health_loop())
         asyncio.ensure_future(self._view_broadcast_loop())
         asyncio.ensure_future(self._workload_watchdog_loop())
+        asyncio.ensure_future(self._pool_reclaim_loop())
         from ray_tpu.core.job_manager import JobManager
 
         self.job_manager = JobManager(self.session, self.port)

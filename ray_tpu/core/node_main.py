@@ -72,7 +72,8 @@ class NodeDaemon:
         self.fr_events = EventRing(_config.get("flight_recorder_events"))
         self.sched_stats = {"local_grants": 0, "spillbacks": 0,
                             "pool_acquires": 0, "lease_returns": 0,
-                            "pool_releases": 0, "pool_worker_deaths": 0}
+                            "pool_releases": 0, "pool_worker_deaths": 0,
+                            "peer_spillbacks": 0, "peer_grants": 0}
         self._fr_metrics_ts = 0.0   # last registry snapshot ride-along
         self._last_gossip_ts = 0.0  # heartbeat bookkeeping (monotonic)
         # partition tolerance: the cluster epoch observed from the head
@@ -127,7 +128,14 @@ class NodeDaemon:
             "register_node", node_id=self.node_id.binary(),
             resources=self.resources, labels=self.labels,
             max_workers=self.max_workers, data_port=self.data_port,
-            sched_port=self.sched_port)
+            sched_port=self.sched_port,
+            # interest-scoped view plane: when the head shards the
+            # cluster_view broadcast, this daemon only needs the shard it
+            # lives in (its own entry + neighbors) plus the digest — full
+            # fan-out of the whole node list does not scale past ~200
+            # nodes ("auto" = the head computes the scope; ignored when
+            # sharding is off)
+            interest="auto")
         self.session = reply["session"]
         self.head_epoch = reply.get("epoch", 0)
         # reconciliation handshake runs on EVERY (re)connect — trivially
@@ -191,9 +199,30 @@ class NodeDaemon:
             "health_ping": self._health_ping,
             "cluster_view": self._on_cluster_view,
             "pool_worker_died": self._on_pool_worker_died,
+            "pool_trim": self._on_pool_trim,
             "reconcile_request": self._on_reconcile_request,
             "chaos": self._on_chaos,
         }
+
+    async def _on_pool_trim(self, resources=None):
+        """Head-pushed reclaim: queued head-path tasks are starving for
+        capacity this pool holds idle (pools can otherwise hoard a
+        node's entire ledger until pool_idle_s). Release one idle worker
+        — preferring the starving shape — through the normal ack-tracked
+        release path."""
+        shape = tuple(sorted(resources.items())) if resources else None
+        ent = self._pool_take(shape, None) if shape is not None else None
+        if ent is None and self.pool_idle:
+            ent = self.pool_idle.pop()
+        if ent is None:
+            return False
+        self._fr("pool_release", worker=ent["wid"].hex()[:12], trim=True)
+        self._pending_releases.append(
+            {"wid": ent["wid"], "seq": ent.get("seq"),
+             "epoch": self.head_epoch, "attempts": 0,
+             "next_try": time.monotonic()})
+        self._gossip_soon()
+        return True
 
     async def _on_reconcile_request(self):
         """Head-pushed when it saw a stale-epoch op from us: re-run the
@@ -243,7 +272,8 @@ class NodeDaemon:
                         resources=self.resources, labels=self.labels,
                         max_workers=self.max_workers,
                         data_port=self.data_port,
-                        sched_port=self.sched_port)
+                        sched_port=self.sched_port,
+                        interest="auto")
                 except Exception:
                     try:
                         await conn.close()
@@ -331,30 +361,73 @@ class NodeDaemon:
             return {"spill": reason}
 
         async def lease_grant(resources, label_selector=None, venv_key=None,
-                              epoch=None):
+                              epoch=None, referred=None):
             if epoch is not None and self.head_epoch \
                     and epoch != self.head_epoch:
                 # the client's cached view predates a head restart (or
                 # lags ours): refuse and let it spill to the head, which
                 # grants under the current epoch — stale-epoch traffic is
-                # fenced, never silently applied
+                # fenced, never silently applied. The same fence covers
+                # peer-referred grants: a daemon partitioned across an
+                # epoch bump cannot double-grant against a rebuilt ledger.
+                if referred:
+                    self._fr("peer_refuse", reason="epoch", referrer=referred)
                 return _spill("epoch")
             if not matches_labels(self.labels, label_selector):
+                if referred:
+                    self._fr("peer_refuse", reason="labels",
+                             referrer=referred)
                 return _spill("labels")
             shape = tuple(sorted(resources.items()))
             t0 = time.monotonic()
             ent = self._pool_take(shape, venv_key)
             warm = ent is not None
+            if ent is None and referred:
+                # a peer daemon referred this client here expecting a warm
+                # worker; the referral was stale — refuse WITHOUT
+                # cascading (no head carve, no further referral: referral
+                # chains must terminate after one hop)
+                self._fr("peer_refuse", reason="cold", referrer=referred)
+                return _spill("cold")
             if ent is None:
-                # cold pool: carve a worker out of the head's ledger ONCE;
-                # every later grant/return cycle on it is daemon-local
+                # cold pool. Daemon-to-daemon spillback first: a peer
+                # whose gossiped pool shows warm idle workers can grant
+                # NOW with zero head involvement (warm steal beats a cold
+                # head carve, and it is the only path that keeps task
+                # throughput alive while the head is paused/partitioned).
+                # The head carve remains the growth path when no peer
+                # advertises warm capacity — the last resort, not the
+                # default.
+                peers = self._spill_candidates(resources, label_selector)
+                if peers:
+                    self._fr("peer_spill", shape=list(shape),
+                             peers=[p["node_id"][:12] for p in peers])
+                    return {"spill": "peer", "peers": peers}
                 if self.conn is None or self.conn.closed:
                     return _spill("head")
                 try:
-                    rep = await self.conn.request(
+                    fut = self.conn.request_future(
                         "pool_acquire", resources=resources,
                         venv_key=venv_key, epoch=self.head_epoch)
+                except Exception:
+                    return _spill("head")
+                try:
+                    # bounded: a SIGSTOPped head keeps the TCP connection
+                    # alive, so an unbounded carve RPC would stall every
+                    # cold grant on this node for the whole outage. The
+                    # request itself is shielded — a LATE grant (slow
+                    # worker spawn, head resuming) is adopted into the
+                    # pool instead of leaking the head-side carve-out.
+                    rep = await asyncio.wait_for(
+                        asyncio.shield(fut),
+                        timeout=float(
+                            _config.get("pool_acquire_timeout_s")))
                 except protocol.RpcError:
+                    return _spill("head")
+                except asyncio.TimeoutError:
+                    fut.add_done_callback(
+                        lambda f: self._adopt_late_carve(
+                            f, venv_key, shape, dict(resources)))
                     return _spill("head")
                 if rep is None:
                     return _spill("resources")
@@ -374,10 +447,19 @@ class NodeDaemon:
                     return None
             self.pool_leases[ent["wid"]] = ent
             held.add(ent["wid"])
+            if referred:
+                # warm grant for a peer referral: count it separately so
+                # the mesh is observable (lease_peer_spillbacks_total /
+                # peer_grants on /metrics and in the lease-event stream)
+                self._fr("peer_grant", shape=list(shape), referrer=referred,
+                         worker=ent["wid"].hex()[:12])
             self._fr("local_grant", shape=list(shape), warm=warm,
                      worker=ent["wid"].hex()[:12])
             self._gossip_soon()
-            return {"worker_id": ent["wid"], "addr": ent["addr"]}
+            rep = {"worker_id": ent["wid"], "addr": ent["addr"]}
+            if referred:
+                rep["peer"] = self.node_id.hex()
+            return rep
 
         async def lease_return(worker_id):
             held.discard(worker_id)
@@ -405,7 +487,38 @@ class NodeDaemon:
                     "pool_acquire": "pool_acquires",
                     "lease_return": "lease_returns",
                     "pool_release": "pool_releases",
-                    "pool_worker_died": "pool_worker_deaths"}
+                    "pool_worker_died": "pool_worker_deaths",
+                    "peer_spill": "peer_spillbacks",
+                    "peer_grant": "peer_grants"}
+
+    def _adopt_late_carve(self, fut, venv_key, shape, resources) -> None:
+        """A pool_acquire we timed out on completed anyway: the head has
+        already debited its ledger and marked the worker pooled, so
+        dropping the reply would leak the carve-out forever (the head
+        never dispatches to pooled workers). Adopt it into the idle pool
+        instead — the next matching grant serves it warm."""
+        if fut.cancelled() or fut.exception() is not None:
+            return
+        rep = fut.result()
+        if not rep:
+            return
+        self._fr("pool_acquire", shape=list(shape), late=True)
+        self.pool_idle.append(
+            {"wid": rep["worker_id"], "addr": tuple(rep["addr"]),
+             "venv_key": venv_key, "shape": shape, "res": resources,
+             "seq": rep.get("grant_seq"), "since": time.monotonic()})
+        self._gossip_soon()
+
+    def _spill_candidates(self, resources, label_selector) -> List[dict]:
+        """Peer daemons this node can refer a cold lease request to,
+        resolved entirely from the cached cluster view + digest (zero
+        head RPCs — that is the point)."""
+        limit = int(_config.get("peer_spill_attempts"))
+        if limit <= 0:
+            return []
+        return self.cluster_view.spill_candidates(
+            resources, label_selector, exclude=self.node_id.hex(),
+            limit=limit)
 
     def _fr(self, kind: str, **detail) -> None:
         """Record a flight-recorder event + bump its lifetime counter; the
@@ -611,12 +724,19 @@ class NodeDaemon:
 
     async def _on_cluster_view(self, snap):
         prev_age = self.cluster_view.staleness_s()
-        self.cluster_view.adopt(snap)
+        if "shards" in snap:
+            # interest-scoped broadcast: only the shards this daemon
+            # subscribed to (plus the digest) — adopt per-shard
+            self.cluster_view.adopt_shards(snap)
+            nodes = sum(len(b.get("nodes") or ())
+                        for b in snap.get("shards") or ())
+        else:
+            self.cluster_view.adopt(snap)
+            nodes = len(snap.get("nodes", []))
         self.head_epoch = snap.get("epoch", self.head_epoch)
         self._adopt_directory(snap.get("objects"))
         self._fr("view_adopt", version=snap.get("version"),
-                 nodes=len(snap.get("nodes", [])),
-                 age_s=round(prev_age, 3))
+                 nodes=nodes, age_s=round(prev_age, 3))
         return True
 
     # ------------------------------------------------ object data plane

@@ -23,6 +23,15 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 
+def shard_of(node_id_hex: str, nshards: int) -> int:
+    """Stable shard assignment for a node id. Node ids are random, so the
+    first 32 bits are already uniform — no extra hashing needed, and every
+    party (head, daemons, drivers, tests) computes the same shard."""
+    if nshards <= 1:
+        return 0
+    return int(node_id_hex[:8] or "0", 16) % nshards
+
+
 def matches_labels(labels: Dict[str, str],
                    selector: Optional[dict]) -> bool:
     """Shared label-selector semantics (NodeInfo and view entries must
@@ -83,6 +92,14 @@ class ClusterView:
         # (serve/live_signals.py) distinguish "no serve plane yet" from
         # "idle serve plane" and fall back to the state API for the former
         self.serve_loads: Optional[list] = None
+        # interest-scoped view plane: when the head shards its broadcast,
+        # a scoped subscriber holds full entries only for its interest
+        # shards (versioned independently, so a stale shard payload can
+        # never rewind another shard's entries) plus a compact digest of
+        # the whole cluster for spillback candidate selection
+        self.nshards = 0
+        self.shard_vs: Dict[int, int] = {}
+        self.digest: Optional[dict] = None
 
     def staleness_s(self) -> float:
         """Seconds since the last adopted snapshot; -1 = never adopted."""
@@ -121,6 +138,53 @@ class ClusterView:
         self.entries = {e["node_id"]: e for e in snap.get("nodes", [])}
         self.version = snap.get("version", self.version)
         self.epoch = snap.get("epoch", self.epoch)
+        # a wholesale snapshot supersedes any sharded history (e.g. the
+        # head restarted with sharding off)
+        self.nshards = 0
+        self.shard_vs.clear()
+        wl = snap.get("workloads")
+        if wl is not None:
+            self.serve_loads = wl
+        self.adopted_ts = time.monotonic()
+
+    def adopt_shards(self, snap: dict) -> None:
+        """Apply a sharded, interest-scoped broadcast payload.
+
+        Each shard blob is a SNAPSHOT of that shard's current entries at
+        an independent per-shard version: a blob at or below the version
+        already applied for ITS shard is dropped (a delayed or replayed
+        push must never rewind one shard while another is current), and
+        applying a blob replaces that shard's entries wholesale so node
+        removals need no tombstones. An epoch change (head restart) or a
+        reshard invalidates EVERY cached shard atomically — entries from
+        the old epoch's shards must not survive into the new one."""
+        import time
+
+        epoch = snap.get("epoch", 0)
+        nshards = snap.get("nshards", 0)
+        if ((epoch and self.epoch and epoch != self.epoch)
+                or (self.nshards and nshards != self.nshards)):
+            self.entries.clear()
+            self.shard_vs.clear()
+            self.version += 1
+        if nshards:
+            self.nshards = nshards
+        if epoch:
+            self.epoch = epoch
+        for blob in snap.get("shards") or ():
+            sid, v = blob["sid"], blob["v"]
+            if v <= self.shard_vs.get(sid, -1):
+                continue  # stale shard payload: keep the newer entries
+            for h in [h for h in self.entries
+                      if shard_of(h, self.nshards) == sid]:
+                del self.entries[h]
+            for e in blob.get("nodes") or ():
+                self.entries[e["node_id"]] = e
+            self.shard_vs[sid] = v
+            self.version += 1
+        d = snap.get("digest")
+        if d is not None:
+            self.digest = d
         wl = snap.get("workloads")
         if wl is not None:
             self.serve_loads = wl
@@ -160,3 +224,41 @@ class ClusterView:
             if best_key is None or key > best_key:
                 best, best_key = e, key
         return best
+
+    def spill_candidates(self, resources: Dict[str, float],
+                         label_selector: Optional[dict] = None,
+                         exclude: Optional[str] = None,
+                         limit: int = 2) -> List[dict]:
+        """Peer daemons a local-pool miss can spill to: nodes whose
+        gossiped pools show warm idle workers, warmest first. Full view
+        entries are checked against totals; digest candidate rows (nodes
+        outside this consumer's interest shards) carry no totals, so only
+        labels gate them — the peer's own pool-take decides the rest."""
+        if limit <= 0:
+            return []
+        # full entries are authoritative where we hold them: a digest row
+        # must never resurrect a node the entry disqualified
+        seen = set(self.entries)
+        rows = []
+        for e in self.entries.values():
+            if (not e.get("sched_addr") or not e.get("idle_workers")
+                    or e["node_id"] == exclude):
+                continue
+            if not matches_labels(e.get("labels") or {}, label_selector):
+                continue
+            if not fits(e.get("total") or {}, resources):
+                continue
+            rows.append({"node_id": e["node_id"],
+                         "sched_addr": tuple(e["sched_addr"]),
+                         "idle_workers": e.get("idle_workers", 0)})
+        for d in (self.digest or {}).get("candidates") or ():
+            if (d["node_id"] in seen or d["node_id"] == exclude
+                    or not d.get("sched_addr") or not d.get("idle_workers")):
+                continue
+            if not matches_labels(d.get("labels") or {}, label_selector):
+                continue
+            rows.append({"node_id": d["node_id"],
+                         "sched_addr": tuple(d["sched_addr"]),
+                         "idle_workers": d.get("idle_workers", 0)})
+        rows.sort(key=lambda r: r["idle_workers"], reverse=True)
+        return rows[:limit]

@@ -193,7 +193,8 @@ class WorkerRuntime:
         try:
             from ray_tpu.util import tracing
 
-            fn = self.client.fn_manager.load(spec["fn_key"])
+            fn = self.client.fn_manager.load(spec["fn_key"],
+                                 blob=spec.get("fn_blob"))
             # dependency fetches land in the dispatch phase (outside the
             # run span) but still carry the task's trace context, so
             # object-pull spans parent to the submitting trace
@@ -256,7 +257,8 @@ class WorkerRuntime:
                 applied = AppliedEnv(self.client, opts["runtime_env"])
             from ray_tpu.util import tracing
 
-            fn = self.client.fn_manager.load(spec["fn_key"])
+            fn = self.client.fn_manager.load(spec["fn_key"],
+                                 blob=spec.get("fn_blob"))
             with tracing.adopt_context(opts.get("trace_ctx")):
                 args, kwargs = self._resolve_args(spec["args"])
             with tracing.execute_span(opts.get("name", "task"),

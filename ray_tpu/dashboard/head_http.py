@@ -172,7 +172,7 @@ def _scheduler_metrics_snapshot(head) -> list:
 
     now = _time.time()
     local_grants, spillbacks, staleness, lag, pool_idle = [], [], [], [], []
-    pool_leased = []
+    pool_leased, peer_spillbacks, peer_grants = [], [], []
     dir_staleness, node_pulls, node_pull_bytes, node_replicas = [], [], [], []
     for n in head.nodes.values():
         if n.is_head or not n.alive:
@@ -181,6 +181,8 @@ def _scheduler_metrics_snapshot(head) -> list:
         stats = n.sched_stats or {}
         local_grants.append((tags, stats.get("local_grants", 0)))
         spillbacks.append((tags, stats.get("spillbacks", 0)))
+        peer_spillbacks.append((tags, stats.get("peer_spillbacks", 0)))
+        peer_grants.append((tags, stats.get("peer_grants", 0)))
         staleness.append((tags, max(now - n.last_delta_ts, 0.0)))
         view_age = (n.gossip_health or {}).get("view_age_s", -1)
         if view_age is not None and view_age >= 0:
@@ -215,6 +217,14 @@ def _scheduler_metrics_snapshot(head) -> list:
         series("lease_spillbacks_total", "counter",
                "Lease requests a node daemon refused back to the head",
                spillbacks or [(head_tags, 0)]),
+        series("lease_peer_spillbacks_total", "counter",
+               "Cold lease requests a node daemon referred to a peer "
+               "daemon's warm pool instead of the head (daemon-to-daemon "
+               "spillback)", peer_spillbacks or [(head_tags, 0)]),
+        series("lease_peer_grants_total", "counter",
+               "Peer-referred leases each daemon granted from its warm "
+               "pool (epoch-fenced, zero head RPCs)",
+               peer_grants or [(head_tags, 0)]),
         series("lease_head_grants_total", "counter",
                "Leases granted by the head (cold path or spillback)",
                [(head_tags, head.sched_totals.get("head_grants", 0))]),

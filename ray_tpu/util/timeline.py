@@ -7,10 +7,12 @@ trace events, one row per worker.
 
 With tracing enabled, the driver's flight-recorder scheduling phases are
 merged in: each traced task gets its own row showing submit →
-lease-acquire[local|spillback|head] → dispatch → run as distinct
-sub-spans, with Chrome flow arrows (`s`/`f` events keyed by task id)
-connecting submit to the run slice — the two-level scheduler's warm path
-made visible per task.
+lease-acquire[local|peer|spillback|head] → dispatch → run as distinct
+sub-spans ("peer" = a daemon-referred grant completed at a peer
+daemon's warm pool; "parked" submits mark cold tasks that waited in the
+client-local dispatch queue), with Chrome flow arrows (`s`/`f` events
+keyed by task id) connecting submit to the run slice — the two-level
+scheduler's warm path made visible per task.
 
 A `head-reconcile` row renders the head's reconciliation phases from the
 merged lease-event stream: node_dead→reregister/pool_reconcile windows,

@@ -25,6 +25,8 @@ def test_soak_single_command(tmp_path):
     out = str(tmp_path / "soak.json")
     report = soak.main(seed=7, out=out, rounds=2, steps=18)
     assert report["warm_burst"]["tasks_completed"] == 2 * 40
+    assert report["head_paused"]["tasks_completed"] == 4 * 8
+    assert report["head_paused"]["peer_grants"] >= 1
     assert report["large_object"]["mb_moved"] >= 4 * 12
     assert report["large_object"]["mb_per_s"] > 0
     assert report["serve"]["failed"] == 0
