@@ -21,6 +21,12 @@ Suites:
   serve             — benchmarks/serve_microbench.json
                       (serve_sustained_rps, serve_fixed_batch_rps,
                        serve_p99_s, disagg_ttft_s)
+  collective        — benchmarks/collective_microbench.json
+                      (allreduce_mb_s — flat path; hier_allreduce_mb_s /
+                       quant_allreduce_mb_s — two-level + int8 inter hop
+                       on the emulated 2-host x 2-device topology;
+                       grad_sync_steps_per_s — device-path DDP sync;
+                       reshard_mb_s — cross-mesh window redistribution)
 
 Usage:
   python benchmarks/check_regression.py                # runs the bench
@@ -48,6 +54,8 @@ SUITES = {
              "runner": "data_plane"},
     "serve": {"baseline": "serve_microbench.json",
               "runner": "serve_plane"},
+    "collective": {"baseline": "collective_microbench.json",
+                   "runner": "collective_plane"},
 }
 DEFAULT_BASELINE = os.path.join(HERE, SUITES["control"]["baseline"])
 

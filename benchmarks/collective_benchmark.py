@@ -22,6 +22,12 @@ reducescatter/allgather (w-1)/w · S/t, broadcast S/t.
 Run: `python benchmarks/collective_benchmark.py [--mode mesh|processes]
 [--world 4] [--sizes-mb 1,8,64] [--op allreduce,...]`
 Emits one JSON line per (op, size) plus a summary line.
+
+`--mode suite` runs the hierarchical/quantized gate rows instead
+(`collective_suite`, also reachable as
+`microbenchmark.collective_plane`) and writes the
+`collective_microbench.json` artifact consumed by
+`check_regression.py --suite collective`.
 """
 
 from __future__ import annotations
@@ -33,9 +39,10 @@ import sys
 import time
 
 import numpy as np
-from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map  # noqa: E402
 
 MEMBER_ENV = {"JAX_PLATFORMS": "cpu",
               "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
@@ -166,9 +173,158 @@ def _row(op: str, world: int, nbytes: int, dt: float, mode: str) -> dict:
             "bus_bw_gb_s": round(alg_bw * _bus_factor(op, world), 3)}
 
 
+# ------------------------------------------------------- hierarchical suite
+HIER_MEMBER_ENV = {"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+
+
+def collective_suite(out_path: str | None = None, payload_mb: int = 8,
+                     iters: int = 5) -> dict:
+    """Gate rows for `check_regression.py --suite collective`, measured on
+    the emulated 2-host x 2-device topology (2 member processes, each
+    with 2 virtual CPU devices; the cross-process gloo edge is the slow
+    "DCN" fabric, the in-process devices the fast one):
+
+      allreduce_mb_s       — the flat pre-hierarchy path at the
+                             collective API layer (host-staged numpy in,
+                             one world-flat device allreduce, numpy out);
+      hier_allreduce_mb_s  — the staged two-level device path
+                             (`allreduce_device`): payload split over the
+                             local devices, each column allreducing its
+                             S/2 shard across the slow edge concurrently;
+      quant_allreduce_mb_s — same with the int8 inter hop (per-chunk
+                             scales; error feedback off — the wire-rate
+                             row; grad sync below exercises EF);
+      grad_sync_steps_per_s — cross_worker_grad_sync steps/s on the
+                             device hierarchical path with the
+                             error-feedback int8 inter hop (fused ~8 MB
+                             gradient pytree per step, residual carried
+                             across iterations);
+      reshard_mb_s         — reshard() of a 32 MB array from a 4-device
+                             sharding onto a different 2-device mesh
+                             (the restore-under-new-mesh window path).
+    """
+    import ray_tpu
+
+    nbytes = payload_mb * (1 << 20)
+    results: dict = {}
+
+    ray_tpu.init(num_cpus=4, num_tpu_chips=0, max_workers=6)
+
+    @ray_tpu.remote
+    class HierMember:
+        def __init__(self, world, rank, name):
+            import ray_tpu.util.collective as col
+
+            self.world, self.rank, self.name = world, rank, name
+            col.init_collective_group(world, rank, backend="xla-multihost",
+                                      group_name=name)
+
+        def run(self, mode, nbytes, iters):
+            import time as _t
+
+            import numpy as _np
+
+            import ray_tpu.util.collective as col
+            from ray_tpu.train.spmd import cross_worker_grad_sync
+
+            n = nbytes // 4
+            g = col.get_group(self.name)
+            rng = _np.random.default_rng(17 + self.rank)
+            x = rng.standard_normal(n).astype(_np.float32)
+            quant = col.QuantizedAllreduce(dtype="int8", chunk=4096,
+                                           error_feedback=False)
+            quant_ef = col.QuantizedAllreduce(dtype="int8", chunk=4096,
+                                              error_feedback=True)
+            tree = {"w": x.reshape(-1, 1024), "b": x[:4096].copy()}
+            fns = {
+                "flat": lambda: col.allreduce(x.copy(),
+                                              group_name=self.name),
+                "hier": lambda: g.allreduce_device(x),
+                "quant": lambda: g.allreduce_device(x, quantize=quant),
+                "grad_sync": lambda: cross_worker_grad_sync(
+                    tree, self.name, self.world, quantize=quant_ef),
+            }
+            fn = fns[mode]
+            col.barrier(group_name=self.name)
+            fn()  # warm: compile + transport setup
+            col.barrier(group_name=self.name)
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                out = fn()
+            if mode != "flat":  # device results: force completion
+                import jax
+
+                jax.block_until_ready(
+                    out["b"] if mode == "grad_sync" else out)
+            return (_t.perf_counter() - t0) / iters
+
+    name = f"hier{os.getpid() % 10000}"
+    members = [HierMember.options(
+        runtime_env={"env_vars": HIER_MEMBER_ENV}).remote(2, r, name)
+        for r in range(2)]
+    for mode, row in (("flat", "allreduce_mb_s"),
+                      ("hier", "hier_allreduce_mb_s"),
+                      ("quant", "quant_allreduce_mb_s"),
+                      ("grad_sync", "grad_sync_steps_per_s")):
+        dts = ray_tpu.get([m.run.remote(mode, nbytes, iters)
+                           for m in members], timeout=600)
+        dt = max(dts)  # a group op finishes when the slowest member does
+        if row.endswith("_mb_s"):
+            results[row] = nbytes / dt / 1e6
+        else:
+            results[row] = 1.0 / dt
+        print(json.dumps({"row": row, "value": round(results[row], 2),
+                          "dt_s": round(dt, 4)}))
+    ray_tpu.shutdown()
+
+    # reshard row: in-process, 4-device source -> different 2-device mesh
+    from ray_tpu.utils.platform import ensure_virtual_cpu
+
+    ensure_virtual_cpu(6)
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.util.collective import reshard
+
+    rbytes = 32 * (1 << 20)
+    arr = np.arange(rbytes // 4, dtype=np.float32).reshape(-1, 1024)
+    src = reshard(arr, NamedSharding(
+        Mesh(np.array(jax.devices()[:4]), ("p",)), P("p")))
+    dst_sh = NamedSharding(Mesh(np.array(jax.devices()[4:6]), ("p",)),
+                           P("p"))
+    jax.block_until_ready(reshard(src, dst_sh))  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = reshard(src, dst_sh)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    results["reshard_mb_s"] = rbytes / dt / 1e6
+    print(json.dumps({"row": "reshard_mb_s",
+                      "value": round(results["reshard_mb_s"], 2)}))
+
+    report = {
+        "metrics": {k: round(v, 2) for k, v in results.items()},
+        "unit": "*_mb_s: MB/s, *_per_s: steps/s (all higher is better)",
+        "host": {"cpus": os.cpu_count(), "payload_mb": payload_mb},
+        "reference": {
+            "topology": "emulated 2 hosts x 2 local devices: member "
+                        "processes are hosts (slow gloo edge = DCN), "
+                        "their virtual CPU devices the fast local fabric",
+            "acceptance": "hier_allreduce_mb_s > allreduce_mb_s and "
+                          "quant_allreduce_mb_s >= 1.5x allreduce_mb_s "
+                          "at matched payload",
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--mode", choices=["processes", "mesh"],
+    p.add_argument("--mode", choices=["processes", "mesh", "suite"],
                    default="processes")
     p.add_argument("--world", type=int, default=4)
     p.add_argument("--sizes-mb", type=str, default="1,8,64")
@@ -178,6 +334,9 @@ def main() -> None:
     p.add_argument("--out", type=str, default=None)
     args = p.parse_args()
 
+    if args.mode == "suite":
+        collective_suite(args.out)
+        return
     sizes = [int(float(s) * (1 << 20)) for s in args.sizes_mb.split(",")]
     ops = args.op.split(",")
     if args.mode == "mesh":

@@ -606,6 +606,16 @@ def _drive_handle(handle, bodies, concurrency: int = 8,
     return time.perf_counter() - t0, latencies, errors
 
 
+def collective_plane(out_path: str | None = None) -> dict:
+    """Collective-layer gate rows (hierarchical two-level allreduce,
+    quantized inter hop, reshard, device grad sync) — implemented in
+    collective_benchmark.collective_suite; this wrapper is the
+    check_regression `--suite collective` runner."""
+    import collective_benchmark
+
+    return collective_benchmark.collective_suite(out_path)
+
+
 def serve_plane(out_path: str | None = None) -> dict:
     """Serving-plane gate rows (the ISSUE-10 acceptance artifact):
 

@@ -30,6 +30,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.parallel.mesh import current_mesh, logical_to_spec
+from ray_tpu.util.collective.hierarchy import (account_collective,
+                                               ring_perm)
 from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 NEG_INF = -1e30
@@ -99,7 +101,7 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     sp_static = int(sp) if not isinstance(sp, jax.core.Tracer) else None
     if sp_static is None:
         raise ValueError("ring_attention_local requires a concrete mesh axis")
-    perm = [(j, (j + 1) % sp_static) for j in range(sp_static)]
+    perm = ring_perm(sp_static)  # canonical collective-layer ring hop
 
     for step in range(sp_static):
         src = (idx - step) % sp_static          # owner of the chunk we hold
@@ -134,6 +136,23 @@ def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True,
 
 def _wrap_shard_map(local_fn, q, k, v, mesh, axis, causal, scale):
     spec = logical_to_spec("batch", "heads", "seq", None)
+    sp = mesh.shape.get(axis, 1)
+    if not isinstance(k, jax.core.Tracer):
+        # eager entry: account the cluster wire bytes; in-jit callers are
+        # covered by collective spans
+        kb = getattr(k, "nbytes", 0)
+        vb = getattr(v, "nbytes", 0)
+        qb = getattr(q, "nbytes", 0)
+        if local_fn is ring_attention_local:
+            # K and V each rotate sp-1 hops around the ring
+            op, nbytes = "ring_attention.ppermute", (sp - 1) * (kb + vb)
+        else:
+            # four tiled all_to_alls (q/k/v in, output back — output is
+            # q-shaped), each moving (sp-1)/sp of its operand off-device
+            op = "ulysses.all_to_all"
+            nbytes = (sp - 1) * (2 * qb + kb + vb) // max(sp, 1)
+        account_collective(op, nbytes, str(getattr(k, "dtype", "unknown")),
+                           hop="intra")
     fn = functools.partial(local_fn, axis_name=axis, causal=causal, scale=scale)
     return _compat_shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

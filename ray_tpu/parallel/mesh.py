@@ -246,3 +246,17 @@ def constrain(x, *logical_axes: Optional[str]):
 
 def axis_size(mesh: Mesh, *axes: str) -> int:
     return math.prod(mesh.shape[a] for a in axes)
+
+
+def topology_from_devices(devices: Optional[Sequence[Any]] = None):
+    """Physical hosts x local-devices `collective.Topology` of a device
+    list (default: all devices) — the descriptor the hierarchical
+    collectives consume. Processes are the inter (DCN) axis, each
+    process's local chips the intra (ICI) axis; asymmetric hosts
+    truncate to the common minimum so the 2D mesh stays rectangular."""
+    from ray_tpu.util.collective.hierarchy import (Topology,
+                                                   device_rows_by_process)
+
+    rows = device_rows_by_process(
+        list(devices) if devices is not None else jax.devices())
+    return Topology(inter=len(rows), intra=min(len(r) for r in rows))

@@ -32,6 +32,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.parallel.mesh import current_mesh
+from ray_tpu.util.collective.hierarchy import (account_collective,
+                                               ring_perm)
 from ray_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 
@@ -76,6 +78,14 @@ def pipeline_apply(
     boundary_dtype = (jnp.float32 if jax.default_backend() == "cpu"
                       else compute_dtype)
     xs = x.reshape(M, B // M, *x.shape[1:]).astype(boundary_dtype)
+    if not isinstance(x, jax.core.Tracer):
+        # eager entry: account the pipeline's stage hand-off wire bytes
+        # ((M+F-1) ticks, each stage forwards one microbatch activation).
+        # The ring moves compute_dtype state (spmd_fn casts back before
+        # the ppermute) — size it off x, not the f32 boundary buffer.
+        mb_bytes = x.nbytes // M
+        account_collective("pipeline.ppermute", (M + F - 1) * F * mb_bytes,
+                           str(compute_dtype), hop="intra")
 
     def spmd_fn(stage_p, xs):
         xs = xs.astype(compute_dtype)
@@ -97,9 +107,9 @@ def pipeline_apply(
             cur = lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
             new = jnp.where((stage == F - 1) & (out_t >= 0), state, cur)
             outs = lax.dynamic_update_index_in_dim(outs, new, idx, 0)
-            # rotate activations one stage forward (ICI ring)
-            state = lax.ppermute(state, axis,
-                                 [(i, (i + 1) % F) for i in range(F)])
+            # rotate activations one stage forward (ICI ring; the
+            # canonical collective-layer ring hop)
+            state = lax.ppermute(state, axis, ring_perm(F))
             return (state, outs), None
 
         (state, outs), _ = lax.scan(tick, (state, outs),

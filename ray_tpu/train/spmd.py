@@ -209,8 +209,11 @@ def restore_state_sharded(path: str, compiled: CompiledTrain) -> TrainState:
 
     The target mesh may have a different shape / device count than the
     save-time mesh: arrays are gathered to global form on the host, then
-    resharded by `compiled.state_sharding`.
+    redistributed by `collective.reshard` under `compiled.state_sharding`
+    — each destination device receives ONLY its own index window (one
+    shard of device memory peak), not a full copy that XLA then slices.
     """
+    from ray_tpu.util.collective import reshard as _reshard
     from ray_tpu.train import checkpoint as ckpt_lib
 
     flat, _ = ckpt_lib.load_sharded(path)
@@ -230,8 +233,8 @@ def restore_state_sharded(path: str, compiled: CompiledTrain) -> TrainState:
         if tuple(arr.shape) != tuple(leaf.shape):
             raise ValueError(f"leaf {key}: checkpoint shape {arr.shape} "
                              f"!= program shape {leaf.shape}")
-        restored.append(jax.device_put(arr.astype(leaf.dtype),
-                                       shard_leaves[key]))
+        restored.append(_reshard(arr.astype(leaf.dtype),
+                                 shard_leaves[key]))
     treedef = jax.tree_util.tree_structure(_state_as_tree(state_shape))
     tree = jax.tree_util.tree_unflatten(treedef, restored)
     return TrainState(step=tree["step"], params=tree["params"],
@@ -239,15 +242,27 @@ def restore_state_sharded(path: str, compiled: CompiledTrain) -> TrainState:
 
 
 def cross_worker_grad_sync(grads: Any, group_name: str, world_size: int,
-                           timeout: float = 60.0) -> Any:
+                           timeout: float = 60.0,
+                           quantize: Optional[Any] = None) -> Any:
     """Average a gradient pytree across the worker gang (elastic DDP).
 
-    XLA meshes allreduce in-program over ICI; ACROSS worker processes the
-    gang uses the kv collective backend. One fused allreduce per step:
-    leaves are flattened into a single buffer so the rendezvous cost is
-    O(1) per step, not O(n_leaves). No-op at world size 1. `group_name`
-    should carry the group generation (e.g. "ddp:g3") so a rebuilt gang
-    never collides with a fenced predecessor's rendezvous keys.
+    XLA meshes allreduce in-program over ICI; ACROSS worker processes
+    there are two planes. When the gang is an `xla-multihost` group the
+    sync runs the DEVICE hierarchical path (`allreduce_tree`): one fused
+    buffer, reduced over the gang's hosts x local-devices topology with
+    the slow inter-host hop carrying only 1/intra of the bytes — and,
+    with `quantize=QuantizedAllreduce(...)`, carrying it at int8/fp8
+    width with error-feedback residuals. Gradient bytes ride the gang's
+    own transport (ICI/DCN/gloo); the head KV carries nothing.
+
+    The kv collective stays the CPU-only/CI fallback: one fused host
+    allreduce per step so the rendezvous cost is O(1) per step, not
+    O(n_leaves). No-op at world size 1. `group_name` should carry the
+    group generation (e.g. "ddp:g3") so a rebuilt gang never collides
+    with a fenced predecessor's rendezvous keys. `timeout` bounds only
+    the kv fallback's rendezvous; the device path blocks until the gang
+    completes (a dead member is detected and fenced by the elastic
+    controller's death watch, not by a deadline here).
     """
     if world_size <= 1:
         return grads
@@ -255,10 +270,13 @@ def cross_worker_grad_sync(grads: Any, group_name: str, world_size: int,
 
     from ray_tpu.util import collective
 
+    group = collective.get_group(group_name)
+    if getattr(group, "backend_name", "") == "xla-multihost":
+        return group.allreduce_tree(grads, average=True, quantize=quantize,
+                                    timeout=timeout)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     arrs = [np.asarray(leaf) for leaf in leaves]
     fused = np.concatenate([a.ravel().astype(np.float32) for a in arrs])
-    group = collective.get_group(group_name)
     group.allreduce(fused, timeout=timeout)
     fused /= world_size
     out, offset = [], 0

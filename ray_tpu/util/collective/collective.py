@@ -19,7 +19,10 @@ from typing import List, Optional
 
 import numpy as np
 
+from ray_tpu.util.collective.hierarchy import Topology
 from ray_tpu.util.collective.kv_group import KVCollectiveGroup
+from ray_tpu.util.collective.quantize import QuantizedAllreduce
+from ray_tpu.util.collective.reshard import reshard, reshard_tree
 from ray_tpu.util.collective.types import Backend, ReduceOp
 from ray_tpu.util.collective.xla_group import XlaCollectiveGroup
 
@@ -160,41 +163,62 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 
 # --------------------------------------------------------------- collectives
-def _op_span(op_name: str, group_name: str):
+def _tensor_info(tensor) -> tuple:
+    """(nbytes, dtype) without materializing the tensor (shape/dtype
+    attributes only; np coercion would force a device fetch)."""
+    try:
+        nbytes = int(getattr(tensor, "nbytes", 0) or 0)
+        dtype = str(getattr(tensor, "dtype", "") or "unknown")
+        return nbytes, dtype
+    except Exception:
+        return 0, "unknown"
+
+
+def _op_span(op_name: str, group_name: str, tensor=None):
     """Child span for one collective op when the calling context traces
     (the span joins the consuming task's/train step's trace); a cheap
-    nullcontext otherwise — the warm path pays one is_enabled() check."""
+    nullcontext otherwise — the warm path pays one is_enabled() check.
+    Bytes/dtype ride the span attributes so the chrome timeline shows
+    comm phases with their wire cost; the same numbers feed the
+    `collective_bytes_total{op,dtype,hop}` counter."""
     import contextlib
 
     from ray_tpu.util import tracing
 
+    nbytes, dtype = _tensor_info(tensor)
+    if nbytes:
+        from ray_tpu.util.collective.hierarchy import account_collective
+
+        account_collective(op_name, nbytes, dtype, hop="world")
     if not tracing.is_recording():
         return contextlib.nullcontext()
     return tracing.start_span(
         f"collective.{op_name}",
-        attributes={"ray_tpu.op": "collective", "group": group_name})
+        attributes={"ray_tpu.op": "collective", "group": group_name,
+                    "collective.op": op_name, "collective.bytes": nbytes,
+                    "collective.dtype": dtype})
 
 
 def allreduce(tensor, op: ReduceOp = ReduceOp.SUM,
               group_name: str = "default"):
-    with _op_span("allreduce", group_name):
+    with _op_span("allreduce", group_name, tensor):
         return _get_group(group_name).allreduce(tensor, op)
 
 
 def reduce(tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM,
            group_name: str = "default"):
-    with _op_span("reduce", group_name):
+    with _op_span("reduce", group_name, tensor):
         return _get_group(group_name).reduce(tensor, dst_rank, op)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    with _op_span("broadcast", group_name):
+    with _op_span("broadcast", group_name, tensor):
         return _get_group(group_name).broadcast(tensor, src_rank)
 
 
 def allgather(tensor_list: Optional[list], tensor, group_name: str = "default"):
     """Reference signature: fills tensor_list with world_size tensors."""
-    with _op_span("allgather", group_name):
+    with _op_span("allgather", group_name, tensor):
         parts = _get_group(group_name).allgather(tensor)
     if tensor_list is not None:
         tensor_list[:] = parts
@@ -203,7 +227,7 @@ def allgather(tensor_list: Optional[list], tensor, group_name: str = "default"):
 
 def reducescatter(tensor, op: ReduceOp = ReduceOp.SUM,
                   group_name: str = "default"):
-    with _op_span("reducescatter", group_name):
+    with _op_span("reducescatter", group_name, tensor):
         return _get_group(group_name).reducescatter(tensor, op)
 
 
@@ -235,4 +259,5 @@ __all__ = [
     "get_collective_group_size", "allreduce", "reduce", "broadcast",
     "allgather", "reducescatter", "barrier", "send", "recv", "synchronize",
     "ReduceOp", "Backend", "XlaCollectiveGroup",
+    "Topology", "QuantizedAllreduce", "reshard", "reshard_tree",
 ]

@@ -1,0 +1,191 @@
+"""Quantized allreduce building blocks (EQuARX direction, arxiv 2506.17615).
+
+Gradient allreduce traffic tolerates aggressive compression when the
+compression error is fed back into the next step (error-feedback SGD), so
+the inter-host (DCN) hop of a hierarchical allreduce can run at int8/fp8
+wire width while the intra-host (ICI) hops stay full precision. The
+blocks here are pure `jnp`/`lax` code usable INSIDE shard_map programs:
+
+- per-chunk absmax scales: the tensor is viewed as [n_chunks, chunk] and
+  each chunk gets its own scale, so one outlier only degrades its own
+  chunk (EQuARX's block-scaling observation);
+- gather-based exchange: each member quantizes its OWN contribution, the
+  wire moves the quantized blocks (all-gather or a ppermute ring), and
+  every member dequantizes and accumulates in f32 in SOURCE-RANK order —
+  sums are exact in f32 and bit-identical on every member, which a
+  quantized psum (int8 accumulation, order-dependent) could not give;
+- error feedback: the residual `x + r - dequant(quant(x + r))` is
+  returned alongside the result and carried by the caller into the next
+  call, so quantization error accumulates into later steps instead of
+  being lost (determinism: same inputs + same residual state => same
+  bytes, chaos-drill-verified).
+
+Wire cost per member on the inter axis: (world-1) · S_q — the exchange
+is all-gather-shaped, shipping the full packed contribution on every hop
+(S_q = S/4 for int8 + ~S/chunk f32 scales) so the f32 accumulation stays
+exact and rank-order-deterministic (a quantized reduce-scatter would sum
+in int8: overflow + order-dependent). Against an fp32 allreduce's
+2(world-1)/world · S that is a 4x saving at world=2, break-even at
+world=8: the gather exchange targets SMALL inter degrees (the
+hierarchical path's host axis after intra reduction). A quantized
+RS+AG schedule for large host counts is a listed follow-on.
+`_account_hier` (xla_multihost.py) uses the same (world-1)·wire_bytes
+formula.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+# wire dtype -> (jnp dtype name, max representable magnitude used as the
+# scale denominator). int8 stays symmetric at 127 so -128 never appears
+# (its negation overflows); fp8 e4m3 saturates at 448.
+_WIRE = {
+    "int8": ("int8", 127.0),
+    "float8_e4m3fn": ("float8_e4m3fn", 448.0),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedAllreduce:
+    """Opt-in config for quantizing the inter hop of an allreduce.
+
+    dtype: wire dtype ("int8" or "float8_e4m3fn");
+    chunk: elements per scale block;
+    error_feedback: carry the per-member compression residual into the
+    next call (the caller owns the residual buffer between calls).
+    """
+
+    dtype: str = "int8"
+    chunk: int = 4096
+    error_feedback: bool = True
+
+    def __post_init__(self):
+        if self.dtype not in _WIRE:
+            raise ValueError(
+                f"unsupported wire dtype {self.dtype!r}; pick one of "
+                f"{sorted(_WIRE)}")
+        if self.chunk <= 0:
+            raise ValueError("chunk must be positive")
+
+    # ------------------------------------------------------------ properties
+    @property
+    def wire_dtype(self):
+        return jnp.dtype(_WIRE[self.dtype][0])
+
+    @property
+    def qmax(self) -> float:
+        return _WIRE[self.dtype][1]
+
+    def key(self) -> tuple:
+        return (self.dtype, self.chunk, self.error_feedback)
+
+    def padded_size(self, n: int) -> int:
+        """Smallest multiple of `chunk` holding n elements."""
+        return ((n + self.chunk - 1) // self.chunk) * self.chunk
+
+    def wire_bytes(self, n: int) -> int:
+        """Wire bytes for one member's padded contribution (payload +
+        scales)."""
+        np_ = self.padded_size(n)
+        return np_ * self.wire_dtype.itemsize + (np_ // self.chunk) * 4
+
+    # ------------------------------------------------------- in-program math
+    def quantize(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Flat f32 [n] (n % chunk == 0) -> (q [nc, chunk], scales [nc, 1])."""
+        xc = x.reshape(-1, self.chunk)
+        amax = jnp.max(jnp.abs(xc), axis=1, keepdims=True)
+        scale = jnp.where(amax > 0, amax / self.qmax, 1.0)
+        if self.dtype == "int8":
+            q = jnp.clip(jnp.round(xc / scale), -self.qmax,
+                         self.qmax).astype(jnp.int8)
+        else:
+            # fp8 cast rounds; clip first so overflow saturates predictably
+            q = jnp.clip(xc / scale, -self.qmax,
+                         self.qmax).astype(self.wire_dtype)
+        return q, scale
+
+    def dequantize(self, q, scale) -> jnp.ndarray:
+        return (q.astype(jnp.float32) * scale).reshape(-1)
+
+    # -------------------------------------------------- inter-hop allreduce
+    def inter_allreduce(self, x, axis_name: str):
+        """Quantized allreduce over `axis_name` via all-gather: the wire
+        carries the quantized blocks (the HLO's all-gather operand dtype
+        IS the wire dtype); dequant + f32 accumulation happen locally in
+        source-rank order. Fused/TPU lowering — one shard_map program."""
+        q, scale = self.quantize(x)
+        qg = lax.all_gather(q, axis_name)        # [world, nc, chunk] wire dtype
+        sg = lax.all_gather(scale, axis_name)    # [world, nc, 1] f32 (tiny)
+        return (qg.astype(jnp.float32) * sg).sum(axis=0).reshape(x.shape)
+
+    def inter_allreduce_ef(self, x, residual, axis_name: str):
+        """Error-feedback variant: returns (reduced, new_residual)."""
+        xc = x + residual
+        q, scale = self.quantize(xc)
+        new_residual = xc - self.dequantize(q, scale).reshape(x.shape)
+        qg = lax.all_gather(q, axis_name)
+        sg = lax.all_gather(scale, axis_name)
+        out = (qg.astype(jnp.float32) * sg).sum(axis=0).reshape(x.shape)
+        return out, new_residual
+
+    def ring_allreduce(self, x, axis_name: str, world: int,
+                       residual: Optional[jnp.ndarray] = None):
+        """Quantized allreduce over `axis_name` via a ppermute ring.
+
+        Same wire bytes as the gather form, but lowered as world-1
+        CollectivePermute rounds — the faster lowering where the
+        transport's all-gather is weak (the CPU/gloo incarnation; gloo
+        all-gather measured ~5x slower than ppermute at equal bytes).
+
+        The quantized payload and its f32 scales ship as ONE packed int8
+        buffer per hop (scales bitcast into the tail): two independent
+        collectives in one program may execute CONCURRENTLY on the same
+        transport pair, and gloo cross-pairs their frames (observed as
+        `op.preamble.length <= op.nbytes` aborts) — a single buffer per
+        round leaves nothing to mispair.
+
+        Contributions are collected into a [world, ...] buffer indexed by
+        SOURCE rank and summed in that fixed order, so every member
+        computes the bit-identical f32 result. Returns `reduced` or
+        (reduced, new_residual) when `residual` is given.
+        """
+        from jax import lax as _lax
+
+        from ray_tpu.util.collective.hierarchy import ring_perm
+
+        xc = x if residual is None else x + residual
+        q, scale = self.quantize(xc)
+        if residual is not None:
+            new_residual = xc - self.dequantize(q, scale).reshape(x.shape)
+        nc, C = q.shape
+        qb = (q if q.dtype == jnp.int8
+              else _lax.bitcast_convert_type(q, jnp.int8))
+        sb = _lax.bitcast_convert_type(scale, jnp.int8).reshape(nc, 4)
+        pack = jnp.concatenate([qb, sb], axis=1)      # [nc, C+4] int8
+        idx = lax.axis_index(axis_name)
+        buf = jnp.zeros((world,) + pack.shape, jnp.int8)
+        buf = lax.dynamic_update_index_in_dim(buf, pack, idx, 0)
+        perm = ring_perm(world)
+        cur, src = pack, idx
+        for _ in range(world - 1):
+            cur = lax.ppermute(cur, axis_name, perm)
+            src = (src - 1) % world
+            buf = lax.dynamic_update_index_in_dim(buf, cur, src, 0)
+        qg = buf[:, :, :C]
+        if self.dtype != "int8":
+            qg = _lax.bitcast_convert_type(qg, self.wire_dtype)
+        sg = _lax.bitcast_convert_type(
+            buf[:, :, C:].reshape(world, nc, 1, 4), jnp.float32)
+        out = (qg.astype(jnp.float32) * sg.reshape(world, nc, 1)).sum(
+            axis=0).reshape(x.shape)
+        if residual is None:
+            return out
+        return out, new_residual
+
+
+__all__ = ["QuantizedAllreduce"]

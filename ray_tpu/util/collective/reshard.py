@@ -1,0 +1,113 @@
+"""Portable array resharding (the array-redistribution direction in
+PAPERS.md, specialized to the two places meshes actually change shape:
+checkpoint restore under a different world size, and
+`adaptive_mesh_config` reshapes after elastic shrink/regrow).
+
+Two schedules, picked by where the source data lives:
+
+- **in-mesh** (`arr` is a jax.Array whose mesh == the destination's):
+  one jitted identity with `out_shardings=dst` — XLA emits the
+  memory-efficient all-to-all / collective-permute redistribution plan
+  itself, never materializing the global array on any device;
+- **cross-mesh / host** (numpy source, or a jax.Array on a different
+  mesh — the restore-under-new-mesh case): per-destination-shard window
+  assembly. Each addressable device receives ONLY its own index window
+  (`device_put` of a host slice), so peak device memory is one shard,
+  not one full copy per device — the memory-efficient schedule the
+  array-redistribution paper describes, degenerated to the host-mediated
+  case. Replicated windows are sliced once and fanned out.
+
+`reshard` replaces the old gather-then-`device_put`-the-full-array hop in
+`restore_state_sharded`; round-trips are bitwise (no dtype or value
+changes, only placement).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _dst_mesh(sharding):
+    return getattr(sharding, "mesh", None)
+
+
+def _identity(x):
+    return x
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_identity_for(dst_sharding):
+    """One jitted identity per destination sharding: a fresh
+    `jax.jit(lambda ...)` per call would miss the executable cache and
+    recompile the redistribution program for every leaf of a pytree."""
+    import jax
+
+    return jax.jit(_identity, out_shardings=dst_sharding)
+
+
+def reshard(arr: Any, dst_sharding, *, src_sharding=None):
+    """Redistribute `arr` (numpy or jax.Array) to `dst_sharding`.
+
+    `src_sharding` is accepted for API symmetry/documentation; the actual
+    source layout is read off the array itself (a jax.Array knows its
+    sharding, a numpy array is host-global).
+    """
+    import jax
+
+    if isinstance(arr, jax.Array) and not isinstance(arr, jax.core.Tracer):
+        src_mesh = _dst_mesh(getattr(arr, "sharding", None))
+        if src_mesh is not None and src_mesh == _dst_mesh(dst_sharding):
+            # same mesh (addressable or multi-host global): let XLA plan
+            # the redistribution (all-to-all / collective-permute inside
+            # one program, no host bounce)
+            return _jit_identity_for(dst_sharding)(arr)
+        if not arr.is_fully_addressable:
+            raise ValueError(
+                "reshard across DIFFERENT meshes needs a host-stageable "
+                "source, but this jax.Array spans non-addressable "
+                "devices; gather it per process first (the checkpoint "
+                "path does: save_sharded writes addressable chunks, "
+                "load_sharded reassembles the host array)")
+        arr = np.asarray(arr)  # cross-mesh: stage through host windows
+    else:
+        arr = np.asarray(arr)
+
+    shape = arr.shape
+    if not shape:
+        return jax.device_put(arr, dst_sharding)
+    imap = dst_sharding.addressable_devices_indices_map(shape)
+    windows: dict = {}  # index-window key -> host slice (sliced once)
+    shards = []
+    for dev, idx in imap.items():
+        idx = idx if idx is not None else tuple(slice(None) for _ in shape)
+        key = tuple((s.start, s.stop, s.step) for s in idx)
+        win = windows.get(key)
+        if win is None:
+            win = windows[key] = np.ascontiguousarray(arr[idx])
+        shards.append(jax.device_put(win, dev))
+    return jax.make_array_from_single_device_arrays(
+        shape, dst_sharding, shards)
+
+
+def reshard_tree(tree: Any, dst_shardings: Any, *,
+                 src_shardings: Optional[Any] = None):
+    """`reshard` over a pytree; `dst_shardings` must match `tree`'s
+    structure (extra: a single sharding broadcasts over all leaves)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    try:
+        dst_leaves = jax.tree_util.tree_flatten(
+            dst_shardings, is_leaf=lambda x: hasattr(x, "device_set"))[0]
+        if len(dst_leaves) == 1 and len(leaves) > 1:
+            dst_leaves = dst_leaves * len(leaves)
+    except Exception:
+        dst_leaves = [dst_shardings] * len(leaves)
+    out = [reshard(l, s) for l, s in zip(leaves, dst_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+__all__ = ["reshard", "reshard_tree"]

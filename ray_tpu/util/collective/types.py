@@ -15,6 +15,14 @@ from typing import Union
 
 
 class ReduceOp(enum.Enum):
+    """SUM/MAX/MIN lower to native XLA primitives (psum/pmax/pmin).
+
+    PRODUCT has no XLA primitive and lowers as all-gather-then-multiply
+    on the device backends: memory bound is min(32 MiB gather cap,
+    world x leaf bytes) of intermediate per chunk — the gather runs
+    chunked (`hierarchy.gathered_reduce`) so a large leaf never
+    materializes a full [world, ...] buffer at once."""
+
     SUM = "sum"
     PRODUCT = "product"
     MIN = "min"

@@ -28,13 +28,6 @@ from ray_tpu.util.collective.types import ReduceOp
 
 _AXIS = "ranks"
 
-_REDUCERS = {
-    ReduceOp.SUM: jnp.sum,
-    ReduceOp.PRODUCT: jnp.prod,
-    ReduceOp.MIN: jnp.min,
-    ReduceOp.MAX: jnp.max,
-}
-
 
 class XlaCollectiveGroup:
     backend_name = "xla"
@@ -71,12 +64,21 @@ class XlaCollectiveGroup:
     def _allreduce_fn(self, op: ReduceOp):
         if op is ReduceOp.SUM:
             body = lambda x: jax.lax.psum(x, _AXIS)
+        elif op is ReduceOp.MAX:
+            body = lambda x: jax.lax.pmax(x, _AXIS)
+        elif op is ReduceOp.MIN:
+            body = lambda x: jax.lax.pmin(x, _AXIS)
         else:
-            reducer = _REDUCERS[op]
+            # PRODUCT: XLA has no pprod primitive — all-gather the factors
+            # and multiply. The gather materializes a [world, ...]
+            # intermediate, so it runs CHUNKED (32 MiB gather cap via
+            # hierarchy.gathered_reduce) instead of asking for
+            # world x leaf bytes on large leaves.
+            from ray_tpu.util.collective.hierarchy import gathered_reduce
 
-            def body(x):  # all_gather then local reduce for non-sum ops
-                full = jax.lax.all_gather(x[0], _AXIS)
-                return jnp.expand_dims(reducer(full, axis=0), 0)
+            def body(x):
+                return jnp.expand_dims(gathered_reduce(
+                    x[0], _AXIS, lambda g: g.prod(axis=0)), 0)
 
         return jax.jit(shard_map(body, mesh=self.mesh, in_specs=P(_AXIS),
                                  out_specs=P(_AXIS)))

@@ -44,9 +44,14 @@ def _reduce_op(op: ReduceOp):
     from jax import lax
 
     def pprod(a, ax):
-        # XLA has no pprod primitive: all-gather the factors and multiply
-        g = lax.all_gather(a, ax)          # [world, ...]
-        return g.prod(axis=0)
+        # XLA has no pprod primitive: all-gather the factors and multiply.
+        # The gather materializes a [world, ...] intermediate, so it runs
+        # CHUNKED (hierarchy.gathered_reduce): peak extra memory is the
+        # 32 MiB cap + one chunk's product, not world x leaf bytes —
+        # a naive gather of a 1 GiB leaf at world=64 would ask for 64 GiB.
+        from ray_tpu.util.collective.hierarchy import gathered_reduce
+
+        return gathered_reduce(a, ax, lambda g: g.prod(axis=0))
 
     return {ReduceOp.SUM: lambda a, ax: lax.psum(a, ax),
             ReduceOp.MAX: lambda a, ax: lax.pmax(a, ax),
@@ -152,18 +157,31 @@ class XlaMultihostGroup:
         import jax
         from jax.sharding import Mesh
 
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, d)
-        if len(per_proc) != self.world_size:
+        from ray_tpu.util.collective.hierarchy import (Topology,
+                                                       device_rows_by_process)
+
+        rows = device_rows_by_process(jax.devices())
+        if len(rows) != self.world_size:
             raise RuntimeError(
-                f"jax cluster has {len(per_proc)} processes, expected "
+                f"jax cluster has {len(rows)} processes, expected "
                 f"{self.world_size}")
-        devs = [per_proc[i] for i in range(self.world_size)]
+        devs = [row[0] for row in rows]
         self.mesh = Mesh(np.array(devs), ("p",))
         self._rank_dev = devs
-        self._local_dev = per_proc[jax.process_index()]
+        self._local_dev = rows[jax.process_index()][0]
         self._pair_meshes: Dict[Tuple[int, int], Any] = {}
+        # hosts x local-devices hierarchy: every member process is one
+        # "host" row; its local virtual/physical devices are the intra
+        # (fast-fabric) axis. Asymmetric device counts truncate to the
+        # common minimum so the 2D mesh stays rectangular.
+        n_local = min(len(r) for r in rows)
+        self.topology = Topology(inter=self.world_size, intra=n_local)
+        self._local_devs = rows[jax.process_index()][:n_local]
+        self._hier_mesh = Mesh(
+            np.array([r[:n_local] for r in rows]),
+            (self.topology.inter_axis, self.topology.intra_axis))
+        self._hier_progs: Dict[Tuple, Any] = {}
+        self._ef_state: Dict[Tuple, Any] = {}
 
     @staticmethod
     def _probe(addr: str) -> bool:
@@ -236,13 +254,20 @@ class XlaMultihostGroup:
     def _publish_membership(self) -> None:
         """worker-id -> (group, rank) in the head KV: lets the device
         object store route a get() between gang members over the ICI
-        data plane instead of host staging."""
+        data plane instead of host staging. Also carries this member's
+        topology coordinates (host identity + local device count) so
+        `hierarchy.infer_topology` can group the gang into hosts x local
+        devices without extra RPCs."""
         try:
             wid = self._client.worker_id.hex()
+            host = os.environ.get("RAY_TPU_NODE_IP") or (
+                __import__("socket").gethostname())
             self._client.kv_put(
                 _MEMBER_NS, wid.encode(),
                 pickle.dumps({"group": self.group_name, "rank": self.rank,
-                              "world": self.world_size}), overwrite=True)
+                              "world": self.world_size, "host": host,
+                              "local_devices": self.topology.intra}),
+                overwrite=True)
         except Exception:
             pass  # membership routing is an optimization, never fatal
 
@@ -362,6 +387,214 @@ class XlaMultihostGroup:
         with self._op_lock:
             out = self._shard_map(_rs_program(op), self._global(arr))
             return self._local_of(out)
+
+    # --------------------------------------- hierarchical device-plane path
+    def _hier_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        t = self.topology
+        return NamedSharding(self._hier_mesh,
+                             P((t.inter_axis, t.intra_axis)))
+
+    def _hier_program(self, colpad: int, op: ReduceOp, quantize,
+                      average: bool):
+        """Compiled inter-hop program for one (column size, op, quant)
+        shape; cached per group. The intra phases of the staged schedule
+        (scatter to columns / regather) happen OUTSIDE the program on the
+        host-local fabric — under a distributed CPU runtime every
+        in-program collective pays the cross-process transport, so only
+        the genuinely inter-host hop runs as a collective. On the fused
+        TPU path (`hierarchy.hier_allreduce_program`) all three phases
+        stay in one program."""
+        ef = (quantize is not None and quantize.error_feedback
+              and op is ReduceOp.SUM)
+        key = (colpad, op, quantize.key() if quantize else None, average)
+        prog = self._hier_progs.get(key)
+        if prog is not None:
+            return prog
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        t = self.topology
+        H, inter = t.inter, t.inter_axis
+        spec = P((t.inter_axis, t.intra_axis))
+        # divide (not multiply-by-reciprocal): the kv fallback divides its
+        # host buffer, and grad-sync parity across backends must be exact
+        world = self.world_size if average else 0
+        red = _reduce_op(op)
+
+        if quantize is not None and op is ReduceOp.SUM:
+            if ef:
+                def body(a, r):
+                    out, nr = quantize.ring_allreduce(
+                        a[0], inter, H, residual=r[0])
+                    if world:
+                        out = out / world
+                    return out[None], nr[None]
+
+                fn = _compat_shard_map(
+                    body, mesh=self._hier_mesh, in_specs=(spec, spec),
+                    out_specs=(spec, spec), check_vma=False)
+            else:
+                def body(a):
+                    out = quantize.ring_allreduce(a[0], inter, H)
+                    if world:
+                        out = out / world
+                    return out[None]
+
+                fn = _compat_shard_map(body, mesh=self._hier_mesh,
+                                       in_specs=spec, out_specs=spec,
+                                       check_vma=False)
+        else:
+            def body(a):
+                out = red(a[0], inter)
+                if world:
+                    out = out / world
+                return out[None]
+
+            fn = _compat_shard_map(body, mesh=self._hier_mesh,
+                                   in_specs=spec, out_specs=spec,
+                                   check_vma=False)
+        prog = jax.jit(fn)
+        self._hier_progs[key] = prog
+        return prog
+
+    def allreduce_device(self, tensor, op: ReduceOp = ReduceOp.SUM, *,
+                         quantize=None, average: bool = False,
+                         ef_key: str = "", timeout=None):
+        """Hierarchical device-plane allreduce of a FLOATING tensor;
+        returns a jax.Array on this process's first local device (input
+        is NOT mutated — device consumers chain off the returned array).
+        Integer payloads must use the flat `allreduce` (this path stages
+        through f32 and would corrupt values above 2^24).
+
+        Staged two-level schedule over `self.topology` (hosts x local
+        devices): the payload is split into `intra` columns, one per
+        local device; each column allreduces its S/intra shard across the
+        `inter` (host) axis CONCURRENTLY — the slow fabric carries S/intra
+        per link instead of S — and the columns regather on the local
+        fabric. With `quantize`, the inter hop runs the int8/fp8 ppermute
+        ring with per-chunk scales. Error-feedback residuals persist on
+        device between calls, keyed by (`ef_key`, payload size, quant
+        config): callers syncing SEVERAL same-sized logical buffers must
+        pass a distinct `ef_key` per buffer, or their residuals
+        cross-contaminate (each call would fold the OTHER buffer's
+        leftover quantization error into its sum). One residual buffer is
+        retained per distinct key for the life of the group.
+
+        `timeout` is accepted for kv-API parity but NOT enforced: like
+        every device-plane collective here, the gloo/ICI program blocks
+        until all members enter it, so a dead peer hangs the call — gang
+        death is the controller's job (the PR 6 death watch fences and
+        rebuilds the group; the kv fallback is the path with a real
+        deadline)."""
+        import jax
+        import jax.numpy as jnp
+
+        t = self.topology
+        H, L = t.inter, t.intra
+        x = np.asarray(tensor)
+        shape, orig_dtype, n = x.shape, x.dtype, x.size
+        if orig_dtype.kind != "f":
+            raise TypeError(
+                f"allreduce_device needs a floating dtype, got "
+                f"{orig_dtype}; integer tensors take the flat allreduce()")
+        if orig_dtype.itemsize > 4:
+            raise TypeError(
+                f"allreduce_device stages through f32 and would silently "
+                f"truncate {orig_dtype} precision; use the flat "
+                f"allreduce() (dtype-preserving) or downcast explicitly")
+        if quantize is not None and op is not ReduceOp.SUM:
+            raise ValueError(
+                f"quantized allreduce supports SUM only (got {op.name}): "
+                f"the int8/fp8 exchange accumulates contributions in f32 "
+                f"source-rank order, which has no analog for other "
+                f"reductions — drop quantize= for {op.name}")
+        colpad = -(-max(n, 1) // L)
+        if quantize is not None:
+            colpad = quantize.padded_size(colpad)
+        flat = np.zeros(L * colpad, dtype=np.float32)
+        flat[:n] = np.ravel(x)
+        cols = flat.reshape(L, colpad)
+        ef = (quantize is not None and quantize.error_feedback
+              and op is ReduceOp.SUM)
+        gshard = self._hier_sharding()
+        with self._op_lock:
+            puts = [jax.device_put(cols[i][None], d)
+                    for i, d in enumerate(self._local_devs)]
+            ga = jax.make_array_from_single_device_arrays(
+                (H * L, colpad), gshard, puts)
+            prog = self._hier_program(colpad, op, quantize, average)
+            if ef:
+                rkey = (ef_key, colpad, quantize.key())
+                r = self._ef_state.get(rkey)
+                if r is None:
+                    zeros = [jax.device_put(
+                        np.zeros((1, colpad), np.float32), d)
+                        for d in self._local_devs]
+                    r = jax.make_array_from_single_device_arrays(
+                        (H * L, colpad), gshard, zeros)
+                out, self._ef_state[rkey] = prog(ga, r)
+            else:
+                out = prog(ga)
+            parts = sorted(out.addressable_shards,
+                           key=lambda s: s.index[0].start)
+            col_arrs = [jax.device_put(s.data[0], self._local_devs[0])
+                        for s in parts]
+            fused = (jnp.concatenate(col_arrs) if len(col_arrs) > 1
+                     else col_arrs[0])
+        self._account_hier(op, colpad, quantize)
+        res = fused[:n].reshape(shape)
+        if orig_dtype.kind == "f" and res.dtype != orig_dtype:
+            res = res.astype(orig_dtype)
+        return res
+
+    def _account_hier(self, op: ReduceOp, colpad: int, quantize) -> None:
+        from ray_tpu.util.collective import hierarchy as _hier
+
+        t = self.topology
+        fp32_wire = 2 * (t.inter - 1) * colpad * 4 * t.intra // max(t.inter, 1)
+        if quantize is not None and op is ReduceOp.SUM:
+            wire = (t.inter - 1) * quantize.wire_bytes(colpad) * t.intra
+            _hier.account_collective("allreduce", wire,
+                                     quantize.dtype, hop="inter")
+            _hier.account_quant_saving(max(0, fp32_wire - wire))
+        else:
+            _hier.account_collective("allreduce", fp32_wire, "float32",
+                                     hop="inter")
+        if t.intra > 1:
+            # scatter + regather columns on the host-local fabric
+            _hier.account_collective("allreduce", 2 * t.intra * colpad * 4,
+                                     "float32", hop="intra")
+
+    def allreduce_tree(self, tree, *, average: bool = True, quantize=None,
+                       timeout=None):
+        """Fused device-plane gradient sync: flatten the pytree's leaves
+        into one f32 buffer, run ONE hierarchical allreduce, unflatten.
+        Cross-member bytes ride the gang's device transport (ICI/DCN on
+        TPU, gloo here) — the head KV carries nothing (the kv collective
+        is the CPU-only fallback, see train.spmd.cross_worker_grad_sync).
+        `timeout` is not enforced on the device plane (see
+        `allreduce_device`); leaves are staged through f32 (f64 leaves
+        lose precision — keep f64 state on the kv path)."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            return tree
+        arrs = [np.asarray(leaf) for leaf in leaves]
+        fused = np.concatenate(
+            [a.ravel().astype(np.float32, copy=False) for a in arrs])
+        out = np.asarray(self.allreduce_device(
+            fused, ReduceOp.SUM, quantize=quantize, average=average))
+        res, off = [], 0
+        for a, leaf in zip(arrs, leaves):
+            dt = getattr(leaf, "dtype", a.dtype)
+            res.append(jnp.asarray(
+                out[off:off + a.size].reshape(a.shape), dtype=dt))
+            off += a.size
+        return jax.tree_util.tree_unflatten(treedef, res)
 
     def barrier(self, timeout=None):
         from jax.experimental import multihost_utils

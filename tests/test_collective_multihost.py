@@ -206,6 +206,267 @@ def test_stale_membership_falls_back_to_snapshot(cluster):
         ray_tpu.kill(a)
 
 
+# ---------------------------------------------------------------------------
+# Hierarchical (hosts x local devices) device-plane path + quantized inter hop
+# ---------------------------------------------------------------------------
+
+# emulated 2-host x 2-device topology: each member process carries TWO
+# virtual devices — its local (fast, in-process) fabric; the cross-process
+# gloo edge is the slow "DCN" fabric the hierarchy economizes
+HIER_ENV = {"JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+
+
+def _ddp_loop(group_name, world, rank, steps, lr=0.1, quant_dtype=None):
+    """Tiny least-squares DDP loop shared by the device-path and kv-path
+    gangs: per-rank fixed data, grads synced every step; returns the loss
+    history (train-loss-parity acceptance compares them)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.train.spmd import cross_worker_grad_sync
+    import ray_tpu.util.collective as col
+
+    rng = np.random.default_rng(100 + rank)
+    X = jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((16, 4)).astype(np.float32))
+    params = {"w": jnp.zeros((8, 4), jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32)}
+    quant = (col.QuantizedAllreduce(dtype=quant_dtype, chunk=16)
+             if quant_dtype else None)
+    losses = []
+    for _ in range(steps):
+        pred = X @ params["w"] + params["b"]
+        err = pred - y
+        losses.append(float(jnp.mean(err * err)))
+        grads = {"w": 2.0 * X.T @ err / err.shape[0],
+                 "b": 2.0 * jnp.mean(err, axis=0)}
+        grads = cross_worker_grad_sync(grads, group_name, world,
+                                       quantize=quant)
+        params = {k: params[k] - lr * grads[k] for k in params}
+    return losses
+
+
+@ray_tpu.remote
+class HierMember:
+    """Gang member exercising the hierarchical/quantized device paths."""
+
+    def __init__(self, world, rank, name):
+        import ray_tpu.util.collective as col
+
+        self.world, self.rank, self.name = world, rank, name
+        col.init_collective_group(world, rank, backend="xla-multihost",
+                                  group_name=name)
+
+    def topology(self):
+        import ray_tpu.util.collective as col
+
+        g = col.get_group(self.name)
+        return (g.topology.inter, g.topology.intra)
+
+    def hier_allreduce(self, seed, quant_dtype=None, average=False):
+        import ray_tpu.util.collective as col
+
+        g = col.get_group(self.name)
+        rng = np.random.default_rng(seed + self.rank)
+        x = rng.standard_normal(5000).astype(np.float32)
+        quant = (col.QuantizedAllreduce(dtype=quant_dtype, chunk=1024)
+                 if quant_dtype else None)
+        out = g.allreduce_device(x, quantize=quant, average=average)
+        return np.asarray(out)
+
+    def quant_series(self, seed, steps):
+        """`steps` error-feedback int8 allreduces of the same tensors:
+        returns the raw output bytes per step (chaos-determinism drill
+        compares them across two independent gang incarnations)."""
+        import ray_tpu.util.collective as col
+
+        g = col.get_group(self.name)
+        rng = np.random.default_rng(seed + self.rank)
+        x = rng.standard_normal(4096).astype(np.float32)
+        quant = col.QuantizedAllreduce(dtype="int8", chunk=512,
+                                       error_feedback=True)
+        outs = []
+        for _ in range(steps):
+            outs.append(np.asarray(
+                g.allreduce_device(x, quantize=quant)).tobytes())
+        return outs
+
+    def grad_sync_audited(self, quant_dtype=None):
+        """cross_worker_grad_sync through THIS gang's multihost group with
+        a head-RPC interposer armed: returns (synced leaves as np, head
+        request methods observed during the sync). The device path must
+        observe ZERO — gradient bytes ride the gang transport, not kv."""
+        import jax.numpy as jnp
+
+        from ray_tpu.core import protocol
+        from ray_tpu.train.spmd import cross_worker_grad_sync
+        import ray_tpu.util.collective as col
+
+        grads = {"w": jnp.arange(600., dtype=jnp.float32).reshape(30, 20)
+                 * (self.rank + 1),
+                 "b": jnp.full((40,), float(self.rank + 1) * 0.25)}
+        quant = (col.QuantizedAllreduce(dtype=quant_dtype, chunk=256)
+                 if quant_dtype else None)
+        events = []
+
+        def hook(conn_name, kind, method):
+            if conn_name == "head":
+                events.append((kind, method))
+
+        protocol.add_rpc_interposer(hook)
+        try:
+            out = cross_worker_grad_sync(grads, self.name, self.world,
+                                         quantize=quant)
+        finally:
+            protocol.remove_rpc_interposer(hook)
+        reqs = [m for k, m in events if k == "req"]
+        return ({k: np.asarray(v) for k, v in out.items()}, reqs)
+
+    def ddp_loop(self, steps, lr=0.1, quant_dtype=None):
+        return _ddp_loop(self.name, self.world, self.rank, steps, lr,
+                         quant_dtype)
+
+
+def test_hierarchical_gang_allreduce_device(cluster):
+    """2 members x 2 local devices: the group infers a 2x2 topology and
+    `allreduce_device` returns the exact cross-member sum (the staged
+    two-level schedule: columns across local devices, shard-sized
+    allreduce on the inter hop, local regather)."""
+    members = [HierMember.options(runtime_env={"env_vars": HIER_ENV}).remote(
+        2, r, "xmh_hier") for r in range(2)]
+    topos = ray_tpu.get([m.topology.remote() for m in members], timeout=180)
+    assert topos == [(2, 2), (2, 2)], topos
+    outs = ray_tpu.get([m.hier_allreduce.remote(7) for m in members],
+                       timeout=180)
+    want = sum(np.random.default_rng(7 + r).standard_normal(5000)
+               .astype(np.float32) for r in range(2))
+    for o in outs:
+        np.testing.assert_allclose(o, want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(outs[0], outs[1])  # bit-identical members
+    # quantized inter hop: close, and STILL bit-identical across members
+    qouts = ray_tpu.get([m.hier_allreduce.remote(7, quant_dtype="int8")
+                         for m in members], timeout=180)
+    np.testing.assert_array_equal(qouts[0], qouts[1])
+    err = np.abs(qouts[0] - want)
+    assert err.max() < np.abs(want).max() * 0.05, err.max()
+    for m in members:
+        ray_tpu.kill(m)
+
+
+def test_device_grad_sync_no_host_gather_and_kv_parity(cluster):
+    """Acceptance: with a multihost group, cross_worker_grad_sync runs the
+    device hierarchical path — interposer-verified ZERO head round trips
+    during the sync (the kv path would relay every gradient byte through
+    the head) — and its result matches the kv fallback bitwise."""
+    members = [HierMember.options(runtime_env={"env_vars": HIER_ENV}).remote(
+        2, r, "xmh_gs") for r in range(2)]
+    outs = ray_tpu.get([m.grad_sync_audited.remote() for m in members],
+                       timeout=180)
+    for synced, reqs in outs:
+        assert reqs == [], f"device grad sync made head round trips: {reqs}"
+    np.testing.assert_array_equal(outs[0][0]["w"], outs[1][0]["w"])
+    # expected average: (g1 + 2*g1)/2 where g1 is the rank-0 tree
+    base_w = np.arange(600., dtype=np.float32).reshape(30, 20)
+    np.testing.assert_allclose(outs[0][0]["w"], base_w * 1.5, rtol=1e-6)
+    np.testing.assert_allclose(outs[0][0]["b"],
+                               np.full((40,), 0.375), rtol=1e-6)
+    for m in members:
+        ray_tpu.kill(m)
+
+    # kv-backend gang syncing the same trees must produce the same bytes
+    @ray_tpu.remote
+    class KvMember:
+        def __init__(self, world, rank, name):
+            import ray_tpu.util.collective as col
+
+            self.world, self.rank, self.name = world, rank, name
+            col.init_collective_group(world, rank, backend="kv",
+                                      group_name=name)
+
+        def sync(self):
+            import jax.numpy as jnp
+
+            from ray_tpu.train.spmd import cross_worker_grad_sync
+
+            grads = {"w": jnp.arange(600., dtype=jnp.float32).reshape(30, 20)
+                     * (self.rank + 1),
+                     "b": jnp.full((40,), float(self.rank + 1) * 0.25)}
+            out = cross_worker_grad_sync(grads, self.name, self.world)
+            return {k: np.asarray(v) for k, v in out.items()}
+
+    kvs = [KvMember.options(runtime_env={"env_vars": MEMBER_ENV}).remote(
+        2, r, "kv_gs") for r in range(2)]
+    kv_outs = ray_tpu.get([m.sync.remote() for m in kvs], timeout=180)
+    np.testing.assert_array_equal(kv_outs[0]["w"], outs[0][0]["w"])
+    np.testing.assert_array_equal(kv_outs[0]["b"], outs[0][0]["b"])
+    for m in kvs:
+        ray_tpu.kill(m)
+
+
+def test_train_loss_parity_device_vs_kv(cluster):
+    """Acceptance: a DDP loop synced through the device hierarchical path
+    tracks the kv path EXACTLY with quantization off, and within
+    tolerance with error-feedback int8 on (loss still descending)."""
+    dev = [HierMember.options(runtime_env={"env_vars": HIER_ENV}).remote(
+        2, r, "xmh_train") for r in range(2)]
+    dev_hist = ray_tpu.get([m.ddp_loop.remote(8) for m in dev], timeout=240)
+    dev_q_hist = ray_tpu.get([m.ddp_loop.remote(8, quant_dtype="int8")
+                              for m in dev], timeout=240)
+    for m in dev:
+        ray_tpu.kill(m)
+
+    @ray_tpu.remote
+    class KvLoop:
+        def __init__(self, world, rank, name):
+            import ray_tpu.util.collective as col
+
+            self.world, self.rank, self.name = world, rank, name
+            col.init_collective_group(world, rank, backend="kv",
+                                      group_name=name)
+
+        def ddp_loop(self, steps, lr=0.1):
+            return _ddp_loop(self.name, self.world, self.rank, steps, lr)
+
+    kv_members = [KvLoop.options(runtime_env={"env_vars": MEMBER_ENV}).remote(
+        2, r, "kv_train") for r in range(2)]
+    kv_hist = ray_tpu.get([m.ddp_loop.remote(8) for m in kv_members],
+                          timeout=240)
+    for m in kv_members:
+        ray_tpu.kill(m)
+
+    assert dev_hist[0] == kv_hist[0], (dev_hist[0], kv_hist[0])
+    assert dev_hist[1] == kv_hist[1]
+    # quantized: same descent within tolerance, loss strictly improving
+    for fp, q in zip(dev_hist[0], dev_q_hist[0]):
+        assert abs(fp - q) <= max(0.05 * abs(fp), 5e-3), (fp, q)
+    assert dev_q_hist[0][-1] < dev_q_hist[0][0]
+
+
+@pytest.mark.chaos
+def test_hier_quant_chaos_determinism(cluster):
+    """Satellite drill: seeded delay/dup chaos on the coordination (kv)
+    edge must not change a single BIT of the hierarchical+quantized
+    allreduce across gang incarnations — rendezvous timing can wobble,
+    but error-feedback state and the quantized data plane are
+    deterministic functions of the inputs."""
+    env = dict(HIER_ENV)
+    env["RAY_TPU_CHAOS"] = ("seed=11,delay:kv_get@head:t=0.02:p=0.4,"
+                            "dup:kv_put@head:every=3")
+    histories = []
+    for attempt in range(2):
+        members = [HierMember.options(runtime_env={"env_vars": env}).remote(
+            2, r, f"xmh_chaos{attempt}") for r in range(2)]
+        outs = ray_tpu.get([m.quant_series.remote(31, 4) for m in members],
+                           timeout=240)
+        assert outs[0] == outs[1], "members disagree on quantized bytes"
+        histories.append(outs[0])
+        for m in members:
+            ray_tpu.kill(m)
+    assert histories[0] == histories[1], \
+        "chaos on the coordination edge changed quantized allreduce bytes"
+
+
 def test_crashed_peer_surfaces_error_not_hang(cluster):
     """Owner replies to the ICI fetch but never enters the transfer
     (crash between reply and send, simulated by the chaos hook): the
