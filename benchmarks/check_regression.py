@@ -20,7 +20,12 @@ Suites:
                       (p2p_pull_mb_s, head_restart_large_object_recovery_s)
   serve             — benchmarks/serve_microbench.json
                       (serve_sustained_rps, serve_fixed_batch_rps,
-                       serve_p99_s, disagg_ttft_s)
+                       serve_p99_s, disagg_ttft_s,
+                       disagg_shared_prefix_ttft_s — shared-system-prompt
+                       TTFT with the cluster prefix store warm, must beat
+                       the point-to-point disagg_ttft_s — and
+                       cluster_prefix_hit_ratio, the share of
+                       shared-prefix requests absorbed by the cache tier)
   collective        — benchmarks/collective_microbench.json
                       (allreduce_mb_s — flat path; hier_allreduce_mb_s /
                        quant_allreduce_mb_s — two-level + int8 inter hop

@@ -1051,6 +1051,38 @@ class Head:
                 self._dir_announce(objdir.spill_record(canonical))
             return True
 
+        async def announce_prefix(model_key, oid, block_size, rows):
+            """A serve replica exported a KV prefix blob into the store:
+            bind its content hashes — one row per covered block boundary,
+            `rows=[(hash, n_tokens), ...]`, all naming the same blob — and
+            ride them out on the next cluster_view broadcast, so any
+            decode replica can warm-start from the blob at ANY shared
+            depth with zero head RPCs. Pushed fire-and-forget on the
+            replica's existing head connection (FIFO after the blob's
+            put_meta, so consumers never see a binding before its blob's
+            location)."""
+            o = ObjectID(oid)
+            for phash, n_tokens in rows:
+                self._dir_announce(objdir.prefix_record(
+                    model_key, phash, o, n_tokens, block_size))
+            return True
+
+        async def withdraw_prefix(model_key, phashes, oid=None):
+            """Publisher-side eviction (its pin LRU rotated a blob out):
+            retire its bindings promptly instead of waiting for the
+            refcount plane to free the object. `oid` scopes the retire to
+            the publisher's OWN blob: two replicas racing to publish the
+            same prefix rebind last-write-wins, and the loser's later
+            eviction must not delete the winner's live binding."""
+            rows = self.object_dir.prefixes.get(model_key) or {}
+            for phash in phashes:
+                ent = rows.get(phash)
+                if ent is None or (oid is not None and ent["oid"] != oid):
+                    continue          # rebound to another blob: keep it
+                self._dir_announce(
+                    objdir.prefix_gone_record(model_key, phash))
+            return True
+
         async def worker_address(worker_id):
             """Direct-server address of a live worker (device-object
             fetches go straight to the owning process)."""
@@ -2749,7 +2781,10 @@ class Head:
                             for h in ent.get("replicas") or ())
                 if not sids or sids & want:
                     kept.append(ent)
-            return {"v": payload["v"], "full": kept}
+            # prefix bindings are global facts (any decode node may need
+            # any prefix) — they ride every scoped resync uncut
+            return {"v": payload["v"], "full": kept,
+                    "prefixes": payload.get("prefixes") or []}
         delta = payload.get("delta") or ()
         if scopes is None:
             scopes = [self._dir_record_scope(r, nshards) for r in delta]

@@ -31,6 +31,17 @@ Record shapes (plain dicts, pickled inside the existing frames):
   {"op": "replica", "oid": bytes, "node": hex}     # extra pull source
   {"op": "replica_gone", "oid": bytes, "node": hex}
   {"op": "node_dead", "node": hex}                 # purge its locations
+  {"op": "prefix", "mk": str, "ph": bytes, "oid": bytes,
+   "n": int, "bs": int}                            # content-addressed KV
+  {"op": "prefix_gone", "mk": str, "ph": bytes}    # binding withdrawn
+
+Prefix rows are the serve plane's cluster-wide KV cache index: a rolling
+content hash of a token prefix (serve/prefix_store.py) bound to the
+object id of an exported paged-KV blob. They ride the same broadcast as
+location records, so ANY replica resolves "who already computed this
+prefix" from cache — zero head RPCs on the warm path. A binding dies
+with its blob: free/node-death records purge the rows of objects whose
+bytes are gone, so a lookup never returns an unreachable prefix.
 
 Broadcast payloads:
   {"v": seq, "delta": [records...]}                # normal tick
@@ -73,6 +84,16 @@ def replica_gone_record(oid: ObjectID, node_hex: str) -> dict:
 
 def node_dead_record(node_hex: str) -> dict:
     return {"op": "node_dead", "node": node_hex}
+
+
+def prefix_record(model_key: str, phash: bytes, oid: ObjectID,
+                  n_tokens: int, block_size: int) -> dict:
+    return {"op": "prefix", "mk": model_key, "ph": phash,
+            "oid": oid.binary(), "n": int(n_tokens), "bs": int(block_size)}
+
+
+def prefix_gone_record(model_key: str, phash: bytes) -> dict:
+    return {"op": "prefix_gone", "mk": model_key, "ph": phash}
 
 
 def resolve_addrs(directory: "ObjectDirectory", meta, addr_of,
@@ -125,6 +146,11 @@ class ObjectDirectory:
 
     def __init__(self):
         self.entries: Dict[ObjectID, _Entry] = {}
+        # content-addressed KV prefix index: model_key -> prefix chain
+        # hash -> {"oid", "n", "bs"}; _prefix_by_oid is the reverse index
+        # that lets free/node-death records purge bindings in O(1)
+        self.prefixes: Dict[str, Dict[bytes, dict]] = {}
+        self._prefix_by_oid: Dict[ObjectID, Set[tuple]] = {}
         self.last_v = 0           # highest broadcast version applied
         self.adopted_ts = 0.0     # monotonic ts of the last applied payload
         self.applied_records = 0  # lifetime counter (tests/diagnostics)
@@ -164,6 +190,52 @@ class ObjectDirectory:
             return -1.0
         return time.monotonic() - self.adopted_ts
 
+    def longest_prefix(self, model_key: str, chain) -> Optional[dict]:
+        """Longest announced prefix binding covering a prompt, entirely
+        from cache. `chain` is the prompt's rolling chain hashes in
+        prefix order (block 1..k, serve/prefix_store.chain_hashes);
+        walked longest-first, the first binding whose blob is still
+        RESIDENT somewhere (its oid resolves in the location entries)
+        wins — a binding that outlived its bytes is skipped, never
+        returned as a warm hit. Returns {"ph", "oid", "n", "bs"}."""
+        rows = self.prefixes.get(model_key)
+        if not rows:
+            return None
+        for phash in reversed([h for h, _n in chain]):
+            ent = rows.get(phash)
+            if ent is None:
+                continue
+            if ObjectID(ent["oid"]) in self.entries:
+                return {"ph": phash, **ent}
+        return None
+
+    def prefix_count(self) -> int:
+        return sum(len(rows) for rows in self.prefixes.values())
+
+    def _drop_prefix(self, model_key: str, phash: bytes) -> None:
+        rows = self.prefixes.get(model_key)
+        ent = rows.pop(phash, None) if rows else None
+        if ent is None:
+            return
+        if not rows:
+            self.prefixes.pop(model_key, None)
+        oid = ObjectID(ent["oid"])
+        keys = self._prefix_by_oid.get(oid)
+        if keys is not None:
+            keys.discard((model_key, phash))
+            if not keys:
+                self._prefix_by_oid.pop(oid, None)
+
+    def _purge_prefixes_for(self, oid: ObjectID) -> None:
+        """The blob's bytes are gone everywhere: its bindings must not
+        linger as phantom warm hits."""
+        for model_key, phash in list(self._prefix_by_oid.pop(oid, ())):
+            rows = self.prefixes.get(model_key)
+            if rows is not None:
+                rows.pop(phash, None)
+                if not rows:
+                    self.prefixes.pop(model_key, None)
+
     # ------------------------------------------------------------- writes
     def apply_record(self, rec: dict) -> None:
         op = rec.get("op")
@@ -178,7 +250,9 @@ class ObjectDirectory:
                 # spill retarget / re-seal keeps replica knowledge
                 ent.meta = meta
         elif op == "free":
-            self.entries.pop(ObjectID(rec["oid"]), None)
+            oid = ObjectID(rec["oid"])
+            self.entries.pop(oid, None)
+            self._purge_prefixes_for(oid)
         elif op == "replica":
             ent = self.entries.get(ObjectID(rec["oid"]))
             if ent is not None:
@@ -192,6 +266,7 @@ class ObjectDirectory:
                     # that was the last copy anywhere: a primary-dead
                     # entry must not linger unreachable forever
                     del self.entries[oid]
+                    self._purge_prefixes_for(oid)
         elif op == "node_dead":
             dead = rec["node"]
             for oid in list(self.entries):
@@ -207,6 +282,16 @@ class ObjectDirectory:
                     # its local copy by object id) — losing the primary
                     # is exactly when replica knowledge matters most
                     del self.entries[oid]
+                    self._purge_prefixes_for(oid)
+        elif op == "prefix":
+            mk, phash = rec["mk"], rec["ph"]
+            self._drop_prefix(mk, phash)   # rebind: retire the old oid
+            self.prefixes.setdefault(mk, {})[phash] = {
+                "oid": rec["oid"], "n": rec["n"], "bs": rec["bs"]}
+            self._prefix_by_oid.setdefault(
+                ObjectID(rec["oid"]), set()).add((mk, phash))
+        elif op == "prefix_gone":
+            self._drop_prefix(rec["mk"], rec["ph"])
         self.applied_records += 1
 
     def apply(self, payload: Optional[dict]) -> bool:
@@ -222,6 +307,10 @@ class ObjectDirectory:
                 e["meta"].object_id: _Entry(e["meta"],
                                             set(e.get("replicas") or ()))
                 for e in full if e["meta"].kind in PULLABLE_KINDS}
+            self.prefixes = {}
+            self._prefix_by_oid = {}
+            for rec in payload.get("prefixes") or ():
+                self.apply_record(rec)
             self.last_v = v
             self.adopted_ts = time.monotonic()
             self.applied_records += 1
@@ -239,4 +328,9 @@ class ObjectDirectory:
         return {"v": v,
                 "full": [{"meta": ent.meta,
                           "replicas": sorted(ent.replicas)}
-                         for ent in self.entries.values()]}
+                         for ent in self.entries.values()],
+                "prefixes": [
+                    {"op": "prefix", "mk": mk, "ph": ph, "oid": e["oid"],
+                     "n": e["n"], "bs": e["bs"]}
+                    for mk, rows in self.prefixes.items()
+                    for ph, e in rows.items()]}

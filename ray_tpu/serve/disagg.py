@@ -70,13 +70,21 @@ class _RpcAudit:
 
 class PrefillServer:
     """Prefill-pool replica: runs prompt passes and exports KV blobs into
-    the object store for decode replicas to pull."""
+    the object store for decode replicas to pull. Every export is also
+    PUBLISHED into the cluster prefix store (content hash -> blob binding
+    on the gossiped directory), so later requests for the same prefix —
+    on ANY decode replica — warm-start without even calling this pool."""
 
-    def __init__(self, **engine_kwargs):
+    def __init__(self, cluster_prefix_cache: bool = True, **engine_kwargs):
         from ray_tpu.serve.llm import LLMEngine
 
         engine_kwargs.setdefault("enable_prefix_caching", True)
         self.engine = LLMEngine(**engine_kwargs)
+        self.prefix_store = None
+        if cluster_prefix_cache and self.engine.kv is not None:
+            from ray_tpu.serve import prefix_store as _ps
+
+            self.prefix_store = _ps.store_for_engine(self.engine)
         self._lock = threading.Lock()
         self.prefills = 0
         self.blobs_exported = 0
@@ -105,7 +113,25 @@ class PrefillServer:
 
         if blob["k"].nbytes + blob["v"].nbytes <= INLINE_THRESHOLD:
             return {**out, "blob": blob}
-        return {**out, "ref": ray_tpu.put(blob)}
+        ref = ray_tpu.put(blob)
+        if self.prefix_store is not None:
+            # publish-on-prefill: bind the content hash to THIS blob so
+            # the whole cluster shares the export (the store pins its own
+            # ref; pin-level dedup keeps re-prefills from re-announcing)
+            try:
+                self.prefix_store.publish(blob, ref=ref)
+            except Exception:
+                pass
+        return {**out, "ref": ref}
+
+    def live_signal_extra(self) -> dict:
+        """Resident-prefix routing hint merged into this replica's
+        gossiped load row: decode handles route PREFILL calls to the
+        pool replica advertising the longest matching resident prefix."""
+        if self.engine.kv is None:
+            return {}
+        return {"prefix_roots":
+                [h.hex() for h in self.engine.kv.recent_chain_hashes()]}
 
     def stats(self) -> dict:
         out = self.engine.engine_stats()
@@ -115,6 +141,8 @@ class PrefillServer:
                         "tokens_exported": self.tokens_exported})
         if self.engine.kv is not None:
             out["kv_cache"] = self.engine.kv.stats()
+        if self.prefix_store is not None:
+            out["prefix_store"] = self.prefix_store.stats()
         return out
 
     def rpc_audit_start(self) -> bool:
@@ -130,13 +158,18 @@ class PrefillServer:
 
 class DisaggLLMServer(LLMServer):
     """Decode-pool replica: completions API surface; prompts whose KV
-    isn't resident are prefilled by the prefill pool and imported over
-    the data plane before decoding."""
+    isn't resident fall through the residency tiers — local engine pool,
+    cluster prefix store (any replica's export, via the gossiped
+    directory + P2P pull, zero head RPCs warm), prefill pool RPC — and
+    the blob import overlaps decode of other lanes instead of blocking
+    the request thread."""
 
     def __init__(self, prefill_handle=None, directory_wait_s: float = 2.0,
-                 prefill_timeout_s: float = 120.0, **engine_kwargs):
+                 prefill_timeout_s: float = 120.0,
+                 cluster_prefix_cache: bool = True, **engine_kwargs):
         engine_kwargs.setdefault("enable_prefix_caching", True)
-        super().__init__(**engine_kwargs)
+        super().__init__(cluster_prefix_cache=cluster_prefix_cache,
+                         **engine_kwargs)
         # arrives as a live DeploymentHandle via deployment composition
         self.prefill_handle = prefill_handle
         self.directory_wait_s = directory_wait_s
@@ -144,8 +177,7 @@ class DisaggLLMServer(LLMServer):
         self._lock = threading.Lock()
         self.prefill_fetches = 0
         self.plane_fetches = 0      # blobs pulled via the object data plane
-        self.blocks_imported = 0
-        self.tokens_imported = 0
+        self.store_fetches = 0      # blobs resolved from the cluster store
         self.local_prefix_hits = 0
         self.fetch_errors = 0
         self._audit = _RpcAudit()
@@ -174,62 +206,113 @@ class DisaggLLMServer(LLMServer):
             time.sleep(0.01)
         return False
 
-    def _ensure_prefix(self, ids: List[int]) -> int:
-        """Fetch+import the prompt's KV from the prefill pool unless the
-        local pool already covers it (a full block of gain is the bar —
-        below that the fetch costs more than the prefill it saves).
-        Returns imported block count; 0 means decode prefills locally."""
-        if (self.prefill_handle is None or self.engine.kv is None
-                or len(ids) < 2):
-            return 0
+    def _prefix_future(self, ids: List[int]):
+        """Async prefill fetch: kick the residency-tier fall-through onto
+        the prefetch executor and hand the engine a blob future — the
+        request thread enqueues immediately and OTHER lanes keep decoding
+        while this prompt's KV crosses the network (the engine imports on
+        its own thread at admission). None when the local pool already
+        covers the prompt (a full block of gain is the bar — below that
+        the fetch costs more than the prefill it saves)."""
+        if self.engine.kv is None or len(ids) < 2:
+            return None
+        if self.prefill_handle is None and self.prefix_store is None:
+            return None
         kv = self.engine.kv
         covered = kv.peek_prefix_len(ids[:-1])
         if (len(ids) - 1) - covered < kv.block_size:
             with self._lock:
                 self.local_prefix_hits += 1
-            return 0
+            return None
+        return self._prefix_submit(self._fetch_prefix_blob, list(ids),
+                                   covered)
+
+    def _fetch_prefix_blob(self, ids: List[int],
+                          covered: int) -> Optional[dict]:
+        """Executor thread: cluster store first (directory lookup from
+        cache + P2P pull — zero head RPCs on the warm path, no prefill
+        RPC at all), then the prefill pool with a prefix-affinity routing
+        hint. None on total failure: decode-local prefill is always
+        correct."""
+        kv = self.engine.kv
+        need = ids[:-1]
+        store = self.prefix_store
+        if store is not None:
+            hit = store.lookup(need)
+            if hit is not None and hit["n"] > covered:
+                # a store hit only replaces the prefill RPC when it
+                # covers most of the uncovered prompt: a shallow hit on a
+                # long prompt would leave the decode replica prefilling
+                # the long tail locally — exactly what disaggregation
+                # exists to avoid — so those fall through to the pool
+                # (affinity-routed to the replica holding the prefix)
+                remaining_after = len(need) - hit["n"]
+                deep_enough = remaining_after <= max(
+                    kv.block_size, (len(need) - covered) // 2)
+                if deep_enough or self.prefill_handle is None:
+                    blob = store.fetch(hit)
+                    if blob is not None:
+                        with self._lock:
+                            self.store_fetches += 1
+                        return blob
+                    # owner died / blob gone mid-fetch: fall through to
+                    # the prefill pool (which re-exports and re-announces)
+        if self.prefill_handle is None:
+            return None
         try:
-            res = self.prefill_handle.options(
-                method_name="prefill").remote(list(ids)).result(
-                    timeout=self.prefill_timeout_s)
+            from ray_tpu.serve.kv_cache import chain_hashes
+
+            h = self.prefill_handle.options(
+                method_name="prefill",
+                prefix_hint=[ph.hex() for ph, _n in
+                             chain_hashes(need, kv.block_size)])
+            res = h.remote(list(ids)).result(timeout=self.prefill_timeout_s)
             blob = res.get("blob")
             via_plane = blob is None
             if via_plane:
                 ref = res.get("ref")
                 if ref is None:
-                    return 0
+                    return None
                 self._wait_directory(ref)
                 blob = ray_tpu.get(ref, timeout=self.prefill_timeout_s)
-            installed = self.engine.import_prefix(blob)
             with self._lock:
                 self.prefill_fetches += 1
                 self.plane_fetches += 1 if via_plane else 0
-                self.blocks_imported += installed
-                self.tokens_imported += installed * kv.block_size
             # the blob ref is dropped here, not free()d: free is a head
             # round trip, while a dropped borrow GCs through the refcount
             # plane's batched pushes — the warm path stays head-RPC-free
-            return installed
+            return blob
         except Exception:
             # degraded mode: decode-side prefill (correct, just slower)
             with self._lock:
                 self.fetch_errors += 1
-            return 0
+            return None
+
+    def prefix_store_probe(self, prompt_ids: List[int]) -> Optional[int]:
+        """Debug/drill surface: covered-token count the cluster store
+        would warm-start this prompt with right now (cached directory
+        only — no fetch, and uncounted so polls don't skew the hit/miss
+        counters)."""
+        if self.prefix_store is None:
+            return None
+        hit = self.prefix_store.lookup(list(prompt_ids), count=False)
+        return None if hit is None else hit["n"]
 
     # ---------------------------------------------------------- requests
     def __call__(self, request: Any) -> dict:
         body = request if isinstance(request, dict) else getattr(
             request, "json", None) or {}
-        ids = body.get("prompt_ids")
-        if ids is None:
-            ids = self.engine.tokenizer.encode(body.get("prompt", ""))
-        ids = (ids or [self.engine.tokenizer.eos_id])
-        ids = ids[-(self.engine.max_seq_len - 2):]
-        self._ensure_prefix(ids)
+        ids = self._request_ids(self.engine, body)
         out = self.engine.generate(
             prompt_ids=ids,
             max_tokens=int(body.get("max_tokens", 16)),
-            temperature=float(body.get("temperature", 0.0)))
+            temperature=float(body.get("temperature", 0.0)),
+            prefix_future=self._prefix_future(ids),
+            prefix_wait_s=self.prefill_timeout_s,
+            # the fetch wait parks INSIDE generate now (the old sync
+            # _ensure_prefix ran before it): the deadline must cover the
+            # full fetch window PLUS the decode budget
+            timeout=self.prefill_timeout_s + 120.0)
         return {
             "object": "text_completion",
             "choices": [{"text": out["text"], "index": 0,
@@ -241,12 +324,17 @@ class DisaggLLMServer(LLMServer):
 
     def stats(self) -> dict:
         out = super().stats()
+        kv = self.engine.kv
         with self._lock:
             out.update({"role": "decode",
                         "prefill_fetches": self.prefill_fetches,
                         "plane_fetches": self.plane_fetches,
-                        "blocks_imported": self.blocks_imported,
-                        "tokens_imported": self.tokens_imported,
+                        "store_fetches": self.store_fetches,
+                        "blocks_imported":
+                            self.engine.prefix_blocks_imported,
+                        "tokens_imported":
+                            self.engine.prefix_blocks_imported
+                            * (kv.block_size if kv is not None else 0),
                         "local_prefix_hits": self.local_prefix_hits,
                         "fetch_errors": self.fetch_errors})
         return out
@@ -269,6 +357,7 @@ def build_disagg_llm_deployment(
         checkpoint: Optional[str] = None, seed: int = 0,
         kv_blocks: int = 64, kv_block_size: int = 16,
         num_tpu_chips: int = 0,
+        cluster_prefix_cache: bool = True,
         autoscaling_config=None, slo_config=None,
         **engine_kwargs):
     """Two-pool deployment graph: `{name}-prefill` and `{name}` (decode,
@@ -282,6 +371,7 @@ def build_disagg_llm_deployment(
     shared = dict(preset=preset, max_seq_len=max_seq_len, seed=seed,
                   model_overrides=model_overrides, checkpoint=checkpoint,
                   kv_blocks=kv_blocks, kv_block_size=kv_block_size,
+                  cluster_prefix_cache=cluster_prefix_cache,
                   **engine_kwargs)
     pre_opts: Dict[str, Any] = {"num_cpus": 1}
     dec_opts: Dict[str, Any] = {"num_cpus": 1}

@@ -43,11 +43,16 @@ class DeploymentResponse:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
                  method_name: str = "__call__",
-                 multiplexed_model_id: Optional[str] = None):
+                 multiplexed_model_id: Optional[str] = None,
+                 prefix_hint: Optional[list] = None):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method_name = method_name
         self._model_id = multiplexed_model_id
+        # prefix-affinity routing: the prompt's chain hashes (hex, prefix
+        # order) — the pick prefers the replica whose gossiped row
+        # advertises the deepest resident match (disagg decode->prefill)
+        self._prefix_hint = list(prefix_hint) if prefix_hint else None
         self._table: Dict[str, Any] = {}
         self._models: Dict[str, list] = {}
         self._table_version = -1
@@ -64,10 +69,12 @@ class DeploymentHandle:
 
     # --------------------------------------------------------------- remote
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                prefix_hint: Optional[list] = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self._controller,
                              method_name or self._method_name,
-                             multiplexed_model_id or self._model_id)
+                             multiplexed_model_id or self._model_id,
+                             prefix_hint or self._prefix_hint)
         h._table, h._table_version = self._table, self._table_version
         h._table_ts, h._inflight = self._table_ts, self._inflight
         h._models = self._models
@@ -154,13 +161,27 @@ class DeploymentHandle:
                         if self._model_id in self._models.get(t, [])]
                 if warm:
                     tags = warm
+
+            def score_of(t):
+                return live_signals.replica_score(
+                    self._inflight.get(t, 0),
+                    live.row(self.deployment_name, t), now, max_age)
+
+            if self._prefix_hint:
+                # prefix-affinity first: the replica advertising the
+                # deepest resident match skips recomputing the prefix.
+                # Only CURRENT route-table tags are candidates, so the
+                # stale row of a departed replica can't draw traffic.
+                tag = live_signals.pick_prefix_affinity(
+                    tags, self._prefix_hint,
+                    lambda t: live.row(self.deployment_name, t),
+                    score_of, now, max_age)
+                if tag is not None:
+                    return tag, self._table[tag]
             # power of two choices on LIVE queue depth (gossiped rows
             # blended with local in-flight; EWMA latency breaks ties)
             tag = live_signals.pick_pow2(
-                tags,
-                lambda t: live_signals.replica_score(
-                    self._inflight.get(t, 0),
-                    live.row(self.deployment_name, t), now, max_age),
+                tags, score_of,
                 lambda t: live_signals.ewma_of(
                     live.row(self.deployment_name, t)))
             return tag, self._table[tag]

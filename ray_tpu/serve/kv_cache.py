@@ -31,6 +31,20 @@ def _chain_hash(prev: bytes, token_block: Tuple[int, ...]) -> bytes:
     return h.digest()
 
 
+def chain_hashes(ids: List[int], block_size: int) -> List[Tuple[bytes, int]]:
+    """Rolling content hashes of every FULL block boundary of a prompt:
+    [(hash_of_blocks_1..k, k*block_size), ...]. This is THE content
+    address of a prefix — the same function keys the local block table,
+    the cluster prefix store, and the routing residency hints, so a hash
+    computed anywhere matches a prefix computed anywhere else."""
+    out: List[Tuple[bytes, int]] = []
+    h = b"root"
+    for i in range(0, len(ids) - len(ids) % block_size, block_size):
+        h = _chain_hash(h, tuple(ids[i:i + block_size]))
+        out.append((h, i + block_size))
+    return out
+
+
 class PagedKVCache:
     """Host-side block table + device-side block pool.
 
@@ -83,13 +97,12 @@ class PagedKVCache:
 
     # ------------------------------------------------------------ hashing
     def _chains(self, ids: List[int]):
-        """Yield (chain_hash, token_block) for every FULL block of ids."""
-        h = b"root"
+        """Yield (chain_hash, token_block) for every FULL block of ids —
+        delegates to `chain_hashes` so the local block table and the
+        cluster prefix store can never disagree on a content address."""
         B = self.block_size
-        for i in range(0, len(ids) - len(ids) % B, B):
-            blk = tuple(ids[i:i + B])
-            h = _chain_hash(h, blk)
-            yield h, blk
+        for h, n in chain_hashes(ids, B):
+            yield h, tuple(ids[n - B:n])
 
     # ------------------------------------------------------------- lookup
     def peek_prefix_len(self, ids: List[int]) -> int:
@@ -103,6 +116,11 @@ class PagedKVCache:
                 break
             n += self.block_size
         return n
+
+    def recent_chain_hashes(self, n: int = 48) -> List[bytes]:
+        """The most-recently-touched pooled chain hashes (LRU tail) —
+        what this engine advertises as its resident-prefix routing hint."""
+        return list(self._table)[-n:]
 
     def match_prefix(self, ids: List[int]) -> Tuple[int, List[int]]:
         blocks: List[int] = []

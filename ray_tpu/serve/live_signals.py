@@ -96,8 +96,17 @@ class LiveLoadCache:
         rows = self._gossiped_rows()
         if rows is None:
             # no broadcast-fed view in this process (remote driver, serve
-            # plane not yet announced): fall back to one state-API pull
+            # plane not yet announced): fall back to one state-API pull —
+            # but only inside an already-initialized runtime. The state
+            # client AUTO-INITS a default single-node runtime otherwise,
+            # and a router consulted pre-init (unit tests, standalone
+            # tooling) must not leave that runtime behind to starve the
+            # cluster a later ray_tpu.init() actually wants.
             try:
+                from ray_tpu.core import api as core_api
+
+                if not core_api.is_initialized():
+                    return
                 from ray_tpu.util import state
 
                 rows = state.list_serve_stats(
@@ -184,6 +193,58 @@ def ewma_of(row: Optional[dict]) -> float:
     not decay with row age (an idle replica's last measured service time
     is still the best estimate)."""
     return float((row or {}).get("ewma_latency_s") or 0.0)
+
+
+def prefix_match_len(row: Optional[dict], chain_hexes, now: float,
+                     max_age_s: float) -> int:
+    """Longest-matching-prefix depth a replica's gossiped load row
+    advertises for a prompt: `chain_hexes` is the prompt's rolling chain
+    hashes in prefix order (hex), the row's `prefix_roots` the replica's
+    resident set. Stale rows (including those of departed replicas whose
+    last row still lingers in the cache) advertise NOTHING — a dead
+    replica's residency must never attract traffic."""
+    if not chain_hexes or not row:
+        return 0
+    if now - (row.get("ts") or 0.0) > max_age_s:
+        return 0
+    roots = row.get("prefix_roots")
+    if not roots:
+        return 0
+    roots = set(roots)
+    best = 0
+    for i, h in enumerate(chain_hexes):
+        if h in roots:
+            best = i + 1
+    return best
+
+
+def pick_prefix_affinity(tags, chain_hexes, row_of, score_of, now: float,
+                         max_age_s: float,
+                         max_imbalance: float = 8.0) -> Optional[object]:
+    """Prefix-affinity replica pick: the tag whose fresh row advertises
+    the deepest resident match for the prompt (queue score breaks ties —
+    among equally-warm replicas the shorter queue wins). A warm replica
+    whose queue runs `max_imbalance` past the least-loaded candidate is
+    excluded — the sole replica holding a popular prefix must not absorb
+    the whole workload while peers idle; past that point recomputing the
+    prefix on an idle replica is cheaper than waiting. None when no
+    (eligible) replica advertises any match, so the caller falls back to
+    pow-2 on load alone."""
+    scores = {t: score_of(t) for t in tags}
+    if not scores:
+        return None
+    min_score = min(scores.values())
+    best_tag, best_key = None, None
+    for t in tags:
+        if scores[t] - min_score > max_imbalance:
+            continue   # overloaded vs an idle peer: not a candidate
+        depth = prefix_match_len(row_of(t), chain_hexes, now, max_age_s)
+        if depth <= 0:
+            continue
+        key = (-depth, scores[t])
+        if best_key is None or key < best_key:
+            best_tag, best_key = t, key
+    return best_tag
 
 
 def pick_pow2(tags, score_of, ewma_of_tag) -> object:

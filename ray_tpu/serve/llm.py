@@ -63,12 +63,19 @@ class HFTokenizer:
 
 class _Request:
     def __init__(self, prompt_ids: List[int], max_tokens: int,
-                 temperature: float, top_k: int = 0, top_p: float = 1.0):
+                 temperature: float, top_k: int = 0, top_p: float = 1.0,
+                 prefix_future=None, prefix_wait_s: float = 30.0):
         self.prompt_ids = prompt_ids
         self.max_tokens = max_tokens
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
+        # async prefill fetch: a concurrent.futures.Future resolving to a
+        # KV blob (or None). The engine defers THIS request's slot
+        # placement until the blob lands — other lanes keep decoding —
+        # and falls back to local prefill at the deadline.
+        self.prefix_future = prefix_future
+        self.prefix_deadline = time.time() + prefix_wait_s
         self.generated: List[int] = []
         self.done = threading.Event()
         self.error: Optional[str] = None
@@ -138,7 +145,8 @@ class LLMEngine:
                  scheduler: str = "continuous",
                  prefill_chunk_size: int = 16,
                  max_num_batched_tokens: Optional[int] = None,
-                 params_override=None, cfg_override=None):
+                 params_override=None, cfg_override=None,
+                 weights_id: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
@@ -168,6 +176,20 @@ class LLMEngine:
             self.cfg = gpt2.GPT2Config.preset(preset, **overrides)
             self.params = gpt2.init_params(jax.random.key(seed), self.cfg)
             self.checkpoint = None
+        # weight identity for the cluster prefix store: engines whose KV
+        # is interchangeable must agree on it. Checkpoint path or
+        # preset+seed derive it; params_override callers (LoRA adapters)
+        # pass the BASE engine's id explicitly so adapters share
+        # base-model prefix entries — an override without one gets a
+        # unique id, which can never collide into a wrong-KV hit.
+        if weights_id is not None:
+            self.weights_id = weights_id
+        elif params_override is not None:
+            import uuid
+
+            self.weights_id = f"override-{uuid.uuid4().hex[:12]}"
+        else:
+            self.weights_id = checkpoint or f"{preset}@seed{seed}"
         self.max_batch = max_batch
         # serving window: the caller's bound caps KV-cache memory even
         # when a checkpoint's architecture allows a longer context (the
@@ -250,26 +272,54 @@ class LLMEngine:
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._slot_pos = [0] * max_batch
         self._slot_prefill: List[List[int]] = [[] for _ in range(max_batch)]
+        # async prefill fetch: requests whose KV blob is still in flight
+        # park here (other lanes keep decoding); resolved ones re-enter
+        # admission ahead of the queue
+        self._deferred: List[_Request] = []
+        self._ready: List[_Request] = []
         self._stop = threading.Event()
         self._stats_lock = threading.Lock()
         self.total_generated = 0
         self.engine_steps = 0          # jitted step calls (either kind)
         self.chunk_steps = 0           # steps that ran the chunked program
         self.tokens_prefilled = 0      # prompt tokens processed
+        self.prefix_imports = 0        # deferred blobs installed
+        self.prefix_blocks_imported = 0
+        self.prefix_wait_timeouts = 0  # deadline hit: local prefill
         self.ttft_sum = 0.0            # submit -> first generated token
         self.ttft_count = 0
         self.last_ttft_s = 0.0
+        # callables other threads need run ON the engine thread (the KV
+        # pool is engine-owned, unlocked state: exports must not race
+        # _alloc's block eviction/reuse)
+        self._engine_calls: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(target=self._engine_loop, daemon=True,
                                         name="llm-engine")
         self._thread.start()
 
     # ------------------------------------------------------------- public
+    @property
+    def prefix_model_key(self) -> Optional[str]:
+        """Cluster prefix-store key: engines with interchangeable KV
+        (same weights + cache geometry) agree; anything else differs."""
+        if self.kv is None:
+            return None
+        from ray_tpu.serve.prefix_store import model_cache_key
+
+        cfg = self.cfg
+        return model_cache_key(self.weights_id, cfg.n_layer, cfg.n_head,
+                               cfg.head_dim, self.jnp.dtype(cfg.dtype).name,
+                               self.kv.block_size)
+
     def generate(self, prompt: str = "", prompt_ids: Optional[List[int]] = None,
                  max_tokens: int = 16, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0,
-                 timeout: float = 120.0) -> Dict[str, Any]:
+                 timeout: float = 120.0, prefix_future=None,
+                 prefix_wait_s: float = 30.0) -> Dict[str, Any]:
         req = self._make_request(prompt, prompt_ids, max_tokens,
-                                 temperature, top_k, top_p)
+                                 temperature, top_k, top_p,
+                                 prefix_future=prefix_future,
+                                 prefix_wait_s=prefix_wait_s)
         ids = req.prompt_ids
         self._queue.put(req)
         if not req.done.wait(timeout):
@@ -283,25 +333,32 @@ class LLMEngine:
 
     # ----------------------------------------------------------- streaming
     def _make_request(self, prompt, prompt_ids, max_tokens, temperature,
-                      top_k, top_p) -> "_Request":
+                      top_k, top_p, prefix_future=None,
+                      prefix_wait_s: float = 30.0) -> "_Request":
         ids = prompt_ids if prompt_ids is not None else \
             self.tokenizer.encode(prompt)
         ids = ids or [self.tokenizer.eos_id]
         ids = ids[-(self.max_seq_len - 2):]
         budget = self.max_seq_len - len(ids) - 1
         return _Request(ids, max(0, min(max_tokens, budget)), temperature,
-                        top_k=top_k, top_p=top_p)
+                        top_k=top_k, top_p=top_p,
+                        prefix_future=prefix_future,
+                        prefix_wait_s=prefix_wait_s)
 
     def start_stream(self, prompt: str = "",
                      prompt_ids: Optional[List[int]] = None,
                      max_tokens: int = 16, temperature: float = 0.0,
-                     top_k: int = 0, top_p: float = 1.0) -> str:
+                     top_k: int = 0, top_p: float = 1.0,
+                     prefix_future=None,
+                     prefix_wait_s: float = 30.0) -> str:
         """Admit a request for incremental consumption via stream_next
         (the engine path behind OpenAI `stream: true`)."""
         import uuid
 
         req = self._make_request(prompt, prompt_ids, max_tokens,
-                                 temperature, top_k, top_p)
+                                 temperature, top_k, top_p,
+                                 prefix_future=prefix_future,
+                                 prefix_wait_s=prefix_wait_s)
         sid = uuid.uuid4().hex
         self._streams[sid] = (req, time.time())
         self._queue.put(req)
@@ -364,19 +421,46 @@ class LLMEngine:
         connectors: nixl/lmcache behind serve.llm)."""
         if self.kv is None:
             raise RuntimeError("prefix caching disabled: no KV to export")
-        from ray_tpu.serve.kv_cache import export_prefix as _export
-
         ids = prompt_ids if prompt_ids is not None else \
             self.tokenizer.encode(prompt)
         ids = ids[-(self.max_seq_len - 2):]
-        blob = _export(self.kv, ids[:-1])
+        blob = self.export_pooled(ids[:-1])
         if blob is None or len(blob["ids"]) < len(ids) - 1 - \
                 (len(ids) - 1) % self.kv.block_size:
             # not pooled yet: run the prefill (generate 1 token) which
             # publishes the prompt's blocks, then export
             self.generate(prompt_ids=ids, max_tokens=1)
-            blob = _export(self.kv, ids[:-1])
+            blob = self.export_pooled(ids[:-1])
         return blob
+
+    def export_pooled(self, ids: List[int], timeout: float = 30.0):
+        """Export `ids`' pooled KV blocks ON the engine thread. The pool
+        is unlocked engine-owned state: an export racing `_alloc`'s block
+        eviction/reuse could copy another request's bytes under this
+        prompt's content hash, so off-thread callers marshal through the
+        engine-call queue. Falls back to a direct (pre-PR-13-semantics)
+        export if the engine thread is wedged past `timeout`."""
+        from ray_tpu.serve.kv_cache import export_prefix as _export
+
+        if (threading.current_thread() is self._thread
+                or not self._thread.is_alive()):
+            return _export(self.kv, ids)
+        from concurrent.futures import Future
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        fut: Future = Future()
+
+        def _do():
+            try:
+                fut.set_result(_export(self.kv, list(ids)))
+            except BaseException as e:   # engine thread must survive
+                fut.set_exception(e)
+
+        self._engine_calls.put(_do)
+        try:
+            return fut.result(timeout=timeout)
+        except _FutTimeout:
+            return _export(self.kv, list(ids))
 
     def import_prefix(self, blob) -> int:
         """Decode side: install a prefill replica's exported KV blocks;
@@ -392,6 +476,7 @@ class LLMEngine:
 
     # ------------------------------------------------------------- engine
     def _admit(self):
+        self._admit_deferred()
         if self.scheduler == "fixed":
             # admit-then-run: a new batch forms only once EVERY slot is
             # free (the seed loop the continuous scheduler replaces; kept
@@ -400,24 +485,86 @@ class LLMEngine:
                 return
         for i in range(self.max_batch):
             if self._slots[i] is None:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
+                req = self._next_ready()
+                if req is None:
                     return
-                self._slots[i] = req
-                self._slot_pos[i] = 0
-                self._slot_prefill[i] = list(req.prompt_ids)
-                if self.kv is not None and len(req.prompt_ids) > 1:
-                    # the last prompt token is always re-run (its logits
-                    # seed generation), so match against ids[:-1]
-                    n_hit, blocks = self.kv.match_prefix(
-                        req.prompt_ids[:-1])
-                    if n_hit:
-                        self.cache = self.kv.copy_into_slot(
-                            self.cache, i, blocks)
-                        self._slot_pos[i] = n_hit
-                        self._slot_prefill[i] = list(
-                            req.prompt_ids[n_hit:])
+                self._place(i, req)
+
+    def _next_ready(self) -> Optional[_Request]:
+        """Next admittable request: resolved deferred requests first,
+        then the queue. A queued request whose KV blob fetch is still in
+        flight parks in `_deferred` (its slot goes to the next request —
+        other lanes decode while the blob crosses the network) instead
+        of blocking admission."""
+        while True:
+            if self._ready:
+                return self._ready.pop(0)
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return None
+            fut = req.prefix_future
+            if fut is not None and not fut.done() \
+                    and time.time() < req.prefix_deadline:
+                self._deferred.append(req)
+                continue
+            self._resolve_prefix(req)
+            return req
+
+    def _admit_deferred(self) -> None:
+        """Re-admit parked requests whose blob landed (import happens
+        HERE, on the engine thread — the KV pool is engine-owned state)
+        or whose wait deadline passed (degrade to local prefill)."""
+        if not self._deferred:
+            return
+        now = time.time()
+        still: List[_Request] = []
+        for req in self._deferred:
+            fut = req.prefix_future
+            if fut is not None and not fut.done() \
+                    and now < req.prefix_deadline:
+                still.append(req)
+                continue
+            self._resolve_prefix(req)
+            self._ready.append(req)
+        self._deferred = still
+
+    def _resolve_prefix(self, req: _Request) -> None:
+        fut, req.prefix_future = req.prefix_future, None
+        if fut is None:
+            return
+        blob = None
+        if fut.done():
+            try:
+                blob = fut.result()
+            except Exception:
+                blob = None
+        else:
+            fut.cancel()    # deadline passed: prefill locally instead
+            self.prefix_wait_timeouts += 1
+        if blob and self.kv is not None:
+            try:
+                installed = self.import_prefix(blob)
+                self.prefix_imports += 1
+                self.prefix_blocks_imported += installed
+            except Exception:
+                pass        # bad blob: local prefill is always correct
+
+    def _place(self, i: int, req: _Request) -> None:
+        self._slots[i] = req
+        self._slot_pos[i] = 0
+        self._slot_prefill[i] = list(req.prompt_ids)
+        if self.kv is not None and len(req.prompt_ids) > 1:
+            # the last prompt token is always re-run (its logits
+            # seed generation), so match against ids[:-1]
+            n_hit, blocks = self.kv.match_prefix(
+                req.prompt_ids[:-1])
+            if n_hit:
+                self.cache = self.kv.copy_into_slot(
+                    self.cache, i, blocks)
+                self._slot_pos[i] = n_hit
+                self._slot_prefill[i] = list(
+                    req.prompt_ids[n_hit:])
 
     def _sweep_streams(self) -> None:
         """Expire abandoned stream entries (client vanished): the sweep
@@ -436,6 +583,17 @@ class LLMEngine:
             if time.time() - last_sweep > 60:
                 last_sweep = time.time()
                 self._sweep_streams()
+            # marshalled work (KV exports) runs between steps: the pool
+            # can't mutate under an export that shares this thread
+            for _ in range(8):
+                try:
+                    fn = self._engine_calls.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    fn()
+                except Exception:
+                    pass
             self._admit()
             live = [i for i, r in enumerate(self._slots) if r is not None]
             if not live:
@@ -598,7 +756,11 @@ class LLMEngine:
                 "engine_steps": self.engine_steps,
                 "chunk_steps": self.chunk_steps,
                 "tokens_prefilled": self.tokens_prefilled,
+                "prefix_imports": self.prefix_imports,
+                "prefix_blocks_imported": self.prefix_blocks_imported,
+                "prefix_wait_timeouts": self.prefix_wait_timeouts,
                 "queued": self._queue.qsize(),
+                "deferred": len(self._deferred),
                 "slots_busy": sum(r is not None for r in self._slots),
                 "ttft_avg_s": round(ttft_avg, 6),
                 "last_ttft_s": round(last_ttft, 6)}
@@ -610,21 +772,95 @@ class LLMServer:
     def __init__(self, preset: str = "gpt2-tiny", max_batch: int = 4,
                  max_seq_len: int = 128, model_overrides: Optional[dict] = None,
                  checkpoint: Optional[str] = None, tokenizer: Any = None,
+                 cluster_prefix_cache: bool = False,
                  **engine_kwargs):
         self.engine = LLMEngine(preset=preset, max_batch=max_batch,
                                 max_seq_len=max_seq_len,
                                 model_overrides=model_overrides,
                                 checkpoint=checkpoint, tokenizer=tokenizer,
                                 **engine_kwargs)
+        # cluster prefix tier: any replica warm-starts from prefixes
+        # computed anywhere in the cluster (serve/prefix_store.py)
+        self.prefix_store = None
+        if cluster_prefix_cache and self.engine.kv is not None:
+            from ray_tpu.serve import prefix_store as _ps
+
+            self.prefix_store = _ps.store_for_engine(self.engine)
+        self._prefix_pool = None
+        self._prefix_pool_lock = threading.Lock()
+        import uuid
+
+        # distinguishes replicas when a caller aggregates stats() rows
+        # sampled through a load-balanced handle
+        self.server_id = uuid.uuid4().hex[:12]
+
+    # ------------------------------------------------- cluster prefix tier
+    def _prefix_submit(self, fn, *args):
+        if self._prefix_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._prefix_pool_lock:
+                if self._prefix_pool is None:
+                    self._prefix_pool = ThreadPoolExecutor(
+                        max_workers=2, thread_name_prefix="prefix-fetch")
+        return self._prefix_pool.submit(fn, *args)
+
+    def _warm_start_future(self, eng: "LLMEngine", ids: List[int],
+                           tenant: str = "base"):
+        """Residency-tier fall-through for one prompt: local engine pool
+        (peek, no fetch wins below a block of gain) -> cluster store
+        lookup (zero RPCs, cached directory) -> background data-plane
+        fetch whose future the engine imports while other lanes decode.
+        Returns the blob future, or None when nothing beats local."""
+        store = self.prefix_store
+        if store is None or eng.kv is None or len(ids) < 2:
+            return None
+        need = ids[:-1]
+        covered = eng.kv.peek_prefix_len(need)
+        if len(need) - covered < eng.kv.block_size:
+            return None
+        hit = store.lookup(need, tenant=tenant)
+        if hit is None or hit["n"] <= covered:
+            return None
+        return self._prefix_submit(store.fetch, hit, tenant)
+
+    def _publish_prefix(self, eng: "LLMEngine", ids: List[int]) -> None:
+        """After a completed generation the prompt's blocks are pooled:
+        announce them so any OTHER replica can warm-start (dedup'd —
+        shared prefixes are stored once cluster-wide). Runs on the
+        prefetch executor — the export's device->host copy + seal +
+        announce must not be charged to the response's tail latency."""
+        store = self.prefix_store
+        if store is None or eng.kv is None or len(ids) < 2:
+            return
+        self._prefix_submit(self._publish_prefix_sync, store, eng, ids)
+
+    @staticmethod
+    def _publish_prefix_sync(store, eng: "LLMEngine", ids: List[int]) -> None:
+        try:
+            store.maybe_publish(eng.kv, ids[:-1],
+                                exporter=eng.export_pooled)
+        except Exception:
+            pass   # publication is an optimization, never a failure path
+
+    def _request_ids(self, eng: "LLMEngine", body: dict,
+                     prompt: str = "") -> List[int]:
+        ids = body.get("prompt_ids")
+        if ids is None:
+            ids = eng.tokenizer.encode(prompt or body.get("prompt", ""))
+        ids = ids or [eng.tokenizer.eos_id]
+        return ids[-(eng.max_seq_len - 2):]
 
     def __call__(self, request: Any) -> dict:
         body = request if isinstance(request, dict) else getattr(
             request, "json", None) or {}
+        ids = self._request_ids(self.engine, body)
         out = self.engine.generate(
-            prompt=body.get("prompt", ""),
-            prompt_ids=body.get("prompt_ids"),
+            prompt_ids=ids,
             max_tokens=int(body.get("max_tokens", 16)),
-            temperature=float(body.get("temperature", 0.0)))
+            temperature=float(body.get("temperature", 0.0)),
+            prefix_future=self._warm_start_future(self.engine, ids))
+        self._publish_prefix(self.engine, ids)
         return {
             "object": "text_completion",
             "choices": [{"text": out["text"], "index": 0,
@@ -639,8 +875,11 @@ class LLMServer:
 
     def stats(self) -> dict:
         out = self.engine.engine_stats()
+        out["server_id"] = self.server_id
         if self.engine.kv is not None:
             out["kv_cache"] = self.engine.kv.stats()
+        if self.prefix_store is not None:
+            out["prefix_store"] = self.prefix_store.stats()
         return out
 
     def check_health(self):
@@ -666,10 +905,20 @@ class OpenAIServer(LLMServer):
         self.max_loras = max_loras
         self._lora_engines: "OrderedDict[str, LLMEngine]" = OrderedDict()
         self._engine_kwargs = dict(kwargs)
-        self._stream_owner: Dict[str, LLMEngine] = {}
+        # sid -> (engine, prompt_ids): the ids publish the prompt's
+        # prefix into the cluster store when the stream completes
+        self._stream_owner: Dict[str, tuple] = {}
 
     def loaded_lora_ids(self):
         return list(self._lora_engines)
+
+    def _tenant_of(self, body: dict) -> str:
+        """Adapter id of the request (`model="<base>:<adapter>"`), or
+        "base" — the per-tenant tag on prefix-store hit counters."""
+        model = (body or {}).get("model")
+        if model and ":" in str(model):
+            return str(model).rsplit(":", 1)[1]
+        return "base"
 
     def _engine_for(self, body: dict) -> "LLMEngine":
         model = (body or {}).get("model")
@@ -688,11 +937,21 @@ class OpenAIServer(LLMServer):
         merged = apply_lora(self.engine.params, load_lora_npz(path))
         kwargs = dict(self._engine_kwargs)
         kwargs.pop("checkpoint", None)
+        kwargs.pop("cluster_prefix_cache", None)
         # the merged params have the BASE engine's architecture (which may
         # come from a checkpoint sidecar, not the preset): hand its
-        # resolved cfg over instead of re-deriving from the preset
+        # resolved cfg over instead of re-deriving from the preset.
+        # weights_id is the BASE's: adapters share base-model prefix
+        # entries in the cluster store (one blob per prefix, hits
+        # counted per adapter). DELIBERATE approximation: an adapter
+        # whose LoRA retargets attention projections produces slightly
+        # different prefix KV than the base — sharing trades that
+        # deviation for cluster-wide TTFT, the same trade cross-adapter
+        # prompt caches make. Tenants needing exact per-adapter KV pass
+        # their own weights_id through engine kwargs to opt out.
         eng = LLMEngine(params_override=merged,
-                        cfg_override=self.engine.cfg, **kwargs)
+                        cfg_override=self.engine.cfg,
+                        weights_id=self.engine.weights_id, **kwargs)
         while len(self._lora_engines) >= self.max_loras:
             _, old = self._lora_engines.popitem(last=False)
             old.shutdown()   # LRU eviction must stop the engine thread
@@ -700,7 +959,7 @@ class OpenAIServer(LLMServer):
         return eng
 
     def stream_next(self, stream_id: str, cursor: int = 0) -> dict:
-        eng = self._stream_owner.get(stream_id, self.engine)
+        eng, ids = self._stream_owner.get(stream_id, (self.engine, None))
         try:
             out = eng.stream_next(stream_id, cursor=cursor)
         except KeyError:
@@ -708,15 +967,19 @@ class OpenAIServer(LLMServer):
             raise
         if out.get("done"):
             self._stream_owner.pop(stream_id, None)
+            # stream-heavy deployments must feed the cluster store too:
+            # the prompt's blocks are pooled once the request finishes
+            if ids is not None and not out.get("error"):
+                self._publish_prefix(eng, ids)
         return out
 
-    def _note_stream(self, sid: str, eng) -> None:
+    def _note_stream(self, sid: str, eng, ids=None) -> None:
         # abandoned SSE clients leave entries behind; bound the map (the
         # engines sweep their own stream state independently)
         if len(self._stream_owner) > 1024:
             for k in list(self._stream_owner)[:512]:
                 self._stream_owner.pop(k, None)
-        self._stream_owner[sid] = eng
+        self._stream_owner[sid] = (eng, ids)
 
     def __call__(self, request: Any) -> dict:
         path = getattr(request, "path", "/v1/completions")
@@ -735,21 +998,29 @@ class OpenAIServer(LLMServer):
         top_k = int(body.get("top_k", 0))
         stream = bool(body.get("stream"))
         eng = self._engine_for(body)
+        # multi-tenant prefix sharing: all adapter engines key the store
+        # by the BASE weights, so a system prompt prefilled under one
+        # adapter warm-starts every other; hits are counted per tenant
+        tenant = self._tenant_of(body)
         if path.endswith("/chat/completions"):
             msgs = body.get("messages", [])
             prompt = "".join(f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
                              for m in msgs) + "<|assistant|>"
+            ids = self._request_ids(eng, {}, prompt)
+            fut = self._warm_start_future(eng, ids, tenant=tenant)
             if stream:
                 sid = eng.start_stream(
-                    prompt=prompt, max_tokens=max_tokens,
-                    temperature=temperature, top_k=top_k, top_p=top_p)
-                self._note_stream(sid, eng)
+                    prompt_ids=ids, max_tokens=max_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    prefix_future=fut)
+                self._note_stream(sid, eng, ids)
                 return {"__sse_stream__": {"stream_id": sid,
                                            "model": self.model_id,
                                            "mode": "chat"}}
-            out = eng.generate(prompt=prompt, max_tokens=max_tokens,
+            out = eng.generate(prompt_ids=ids, max_tokens=max_tokens,
                                temperature=temperature, top_k=top_k,
-                               top_p=top_p)
+                               top_p=top_p, prefix_future=fut)
+            self._publish_prefix(eng, ids)
             finish = ("length" if out["completion_tokens"] >= max_tokens
                       else "stop")
             return {
@@ -768,20 +1039,22 @@ class OpenAIServer(LLMServer):
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
+        ids = self._request_ids(eng, body, prompt)
+        fut = self._warm_start_future(eng, ids, tenant=tenant)
         if stream:
             sid = eng.start_stream(
-                prompt=prompt, prompt_ids=body.get("prompt_ids"),
+                prompt_ids=ids,
                 max_tokens=max_tokens, temperature=temperature,
-                top_k=top_k, top_p=top_p)
-            self._note_stream(sid, eng)
+                top_k=top_k, top_p=top_p, prefix_future=fut)
+            self._note_stream(sid, eng, ids)
             return {"__sse_stream__": {"stream_id": sid,
                                        "model": self.model_id,
                                        "mode": "completion"}}
-        out = eng.generate(prompt=prompt,
-                           prompt_ids=body.get("prompt_ids"),
+        out = eng.generate(prompt_ids=ids,
                            max_tokens=max_tokens,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p)
+                           top_p=top_p, prefix_future=fut)
+        self._publish_prefix(eng, ids)
         finish = ("length" if out["completion_tokens"] >= max_tokens
                   else "stop")
         return {

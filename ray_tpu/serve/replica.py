@@ -130,14 +130,25 @@ class ReplicaActor:
                     "replica": self.replica_tag}
             self._metrics["queue"].set(self._ongoing, tags=tags)
             self._metrics["inflight"].set(self._executing, tags=tags)
-            m.publish_workload("serve_replica", self.replica_tag, {
+            row = {
                 "deployment": self.deployment_name,
                 "queue_depth": self._ongoing,
                 "inflight": self._executing,
                 "ewma_latency_s": round(self._ewma_latency_s, 6),
                 "last_latency_s": round(last_latency_s, 6),
                 "total": self._total,
-            })
+            }
+            # deployment-specific routing hints (e.g. a prefill replica's
+            # resident-prefix hashes) ride the same gossiped row: zero
+            # new channels, and routers see them exactly as fresh as the
+            # load signal itself
+            extra = getattr(self.callable, "live_signal_extra", None)
+            if extra is not None:
+                try:
+                    row.update(extra() or {})
+                except Exception:
+                    pass
+            m.publish_workload("serve_replica", self.replica_tag, row)
         except Exception:
             pass
 

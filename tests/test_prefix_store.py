@@ -1,0 +1,495 @@
+"""Cluster-wide content-addressed KV/prefix cache tier (ISSUE 13).
+
+The acceptance surfaces: prefix bindings ride the gossiped object
+directory (per-block-boundary content hashes -> exported blob), a prefix
+computed by replica A warm-starts decode on replica B with ZERO head
+RPCs on the warm path, blob import overlaps other lanes' decode (async
+prefill fetch), prefill routing prefers resident prefixes, LoRA
+adapters share base-model entries, and the owner dying mid-fetch
+degrades to local prefill without failing the request.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import object_directory as objdir
+from ray_tpu.core.ids import NodeID, ObjectID
+from ray_tpu.core.store import ObjectMeta
+from ray_tpu.serve.kv_cache import chain_hashes
+
+# 4 layers so a ~90-token prompt's KV blob (~360 KiB) is well past the
+# inline threshold: prefix blobs must ride the object DATA PLANE
+MODEL = dict(preset="gpt2-tiny", max_seq_len=96, seed=7,
+             model_overrides={"vocab_size": 512, "attn_impl": "dense",
+                              "n_layer": 4},
+             kv_blocks=64, kv_block_size=8)
+ENGINE_KW = {k: v for k, v in MODEL.items()
+             if k not in ("kv_blocks", "kv_block_size")}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=12, num_tpu_chips=0, max_workers=16)
+    yield info
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _ids(n, salt=0):
+    return [(i * 7 + salt * 131) % 500 + 1 for i in range(n)]
+
+
+def _meta(node: NodeID, size=1 << 20) -> ObjectMeta:
+    m = ObjectMeta(ObjectID.generate(), size, "shm", segment="seg_px")
+    m.node_id = node
+    return m
+
+
+# ------------------------------------------------ directory prefix rows
+def test_directory_prefix_rows_bind_lookup_purge():
+    """Prefix bindings ride directory records: longest-resident-first
+    lookup, model-key isolation, and free() purging every binding of the
+    freed blob (no phantom warm hits)."""
+    d = objdir.ObjectDirectory()
+    node = NodeID.generate()
+    m = _meta(node)
+    d.apply({"v": 1, "delta": [objdir.seal_record(m)]})
+    chain = chain_hashes(_ids(24), 8)            # 3 block boundaries
+    d.apply({"v": 2, "delta": [
+        objdir.prefix_record("mk", ph, m.object_id, n, 8)
+        for ph, n in chain]})
+    assert d.prefix_count() == 3
+    hit = d.longest_prefix("mk", chain)
+    assert hit["n"] == 24 and hit["oid"] == m.object_id.binary()
+    # a prompt sharing only the first block matches at depth 1
+    assert d.longest_prefix("mk", chain[:1])["n"] == 8
+    # divergent chain and foreign model key: no match
+    assert d.longest_prefix("mk", chain_hashes(_ids(24, salt=9), 8)) is None
+    assert d.longest_prefix("other-mk", chain) is None
+    # free purges the blob's bindings everywhere
+    d.apply({"v": 3, "delta": [objdir.free_record(m.object_id)]})
+    assert d.longest_prefix("mk", chain) is None
+    assert d.prefix_count() == 0
+
+
+def test_directory_prefix_residency_check_and_node_death():
+    """A binding whose blob is NOT resident is skipped (lookup falls back
+    to a shallower resident one); the owner node dying purges its blob's
+    bindings; a full resync payload carries the surviving rows."""
+    d = objdir.ObjectDirectory()
+    node = NodeID.generate()
+    chain = chain_hashes(_ids(24), 8)
+    shallow = _meta(node)
+    d.apply({"v": 1, "delta": [objdir.seal_record(shallow)]})
+    ghost = ObjectID.generate()                  # never sealed anywhere
+    d.apply({"v": 2, "delta": [
+        objdir.prefix_record("mk", chain[0][0], shallow.object_id, 8, 8),
+        objdir.prefix_record("mk", chain[2][0], ghost, 24, 8)]})
+    # deepest binding is non-resident: the shallow resident one wins
+    assert d.longest_prefix("mk", chain)["n"] == 8
+    # the owner node dies -> its blob's bindings purge with the entry
+    d.apply({"v": 3, "delta": [objdir.node_dead_record(node.hex())]})
+    assert d.longest_prefix("mk", chain) is None
+    # full resync round trip preserves prefix rows
+    d2 = objdir.ObjectDirectory()
+    m2 = _meta(NodeID.generate())
+    d2.apply({"v": 1, "delta": [objdir.seal_record(m2)]})
+    d2.apply({"v": 2, "delta": [
+        objdir.prefix_record("mk", chain[1][0], m2.object_id, 16, 8)]})
+    d3 = objdir.ObjectDirectory()
+    d3.apply(d2.full_payload(7))
+    assert d3.longest_prefix("mk", chain)["n"] == 16
+    assert d3.last_v == 7
+
+
+# ------------------------------------------------ prefix-affinity routing
+def test_prefix_affinity_pick_prefers_deepest_fresh_match():
+    """PREFILL routing satellite (pure policy): deepest advertised
+    resident match wins; queue score breaks depth ties; stale rows —
+    including a departed replica's lingering row — advertise nothing."""
+    from ray_tpu.serve.live_signals import (pick_prefix_affinity,
+                                            prefix_match_len)
+
+    now = time.time()
+    hint = ["h1", "h2", "h3"]
+    rows = {
+        "r1": {"prefix_roots": ["h1"], "queue_depth": 0, "ts": now},
+        "r2": {"prefix_roots": ["h1", "h2"], "queue_depth": 5, "ts": now},
+        "r3": {"prefix_roots": [], "queue_depth": 0, "ts": now},
+    }
+    assert prefix_match_len(rows["r2"], hint, now, 5.0) == 2
+    assert prefix_match_len(rows["r3"], hint, now, 5.0) == 0
+    assert prefix_match_len({**rows["r2"], "ts": now - 60},
+                            hint, now, 5.0) == 0
+    picked = pick_prefix_affinity(
+        ["r1", "r2", "r3"], hint, lambda t: rows[t],
+        lambda t: rows[t]["queue_depth"], now, 5.0)
+    assert picked == "r2", "deeper match must beat a shorter queue"
+    # the deep replica's row goes stale (replica departed): next-best
+    # FRESH match wins instead
+    rows["r2"] = {**rows["r2"], "ts": now - 60}
+    assert pick_prefix_affinity(
+        ["r1", "r2", "r3"], hint, lambda t: rows[t],
+        lambda t: rows[t]["queue_depth"], now, 5.0) == "r1"
+    # depth tie -> lower queue score
+    rows["r2"] = {"prefix_roots": ["h1"], "queue_depth": 5, "ts": now}
+    assert pick_prefix_affinity(
+        ["r1", "r2"], hint, lambda t: rows[t],
+        lambda t: rows[t]["queue_depth"], now, 5.0) == "r1"
+    # nobody advertises a match -> None (caller falls back to pow-2)
+    assert pick_prefix_affinity(
+        ["r3"], hint, lambda t: rows["r3"],
+        lambda t: 0, now, 5.0) is None
+    # overload guard: the deep replica's queue running far past an idle
+    # peer excludes it — the shallower IDLE warm replica wins instead of
+    # hot-spotting the deep one
+    rows["r1"] = {"prefix_roots": ["h1"], "queue_depth": 0, "ts": now}
+    rows["r2"] = {"prefix_roots": ["h1", "h2"], "queue_depth": 50,
+                  "ts": now}
+    assert pick_prefix_affinity(
+        ["r1", "r2", "r3"], hint, lambda t: rows[t],
+        lambda t: rows[t]["queue_depth"], now, 5.0) == "r1"
+    # ...and with no other warm candidate, overload falls back to pow-2
+    assert pick_prefix_affinity(
+        ["r2", "r3"], hint, lambda t: rows[t],
+        lambda t: rows[t]["queue_depth"], now, 5.0) is None
+
+
+def test_prefix_store_counters_reach_metrics_exposition():
+    """`prefix_store_{hits,misses,bytes}_total` are tenant-tagged process
+    metrics: a lookup lands in the registry snapshot and renders into the
+    Prometheus exposition the head scrapes (the pusher ships the same
+    snapshot into the `_metrics` KV, PR 2 plane)."""
+    from ray_tpu.serve.prefix_store import PrefixStoreClient
+    from ray_tpu.util import metrics as m
+
+    store = PrefixStoreClient("mk-metrics", 8)
+    # no cluster, no pins: a lookup is a deterministic per-tenant miss
+    assert store.lookup(_ids(16), tenant="adapterX") is None
+    text = m.render_prometheus({"proc0": m.snapshot_all()})
+    assert "ray_tpu_prefix_store_misses_total" in text
+    assert 'tenant="adapterX"' in text
+
+
+# ------------------------------------------------ async prefill fetch
+def test_async_prefix_fetch_overlaps_other_lane_decode():
+    """Satellite: a request whose KV blob is still in flight does NOT
+    block admission — another lane decodes to completion while the fetch
+    future is pending; when the blob lands the engine imports it on its
+    own thread and the deferred request skips prefill for the covered
+    span, byte-identical to a cold run."""
+    from concurrent.futures import Future
+
+    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.utils.platform import ensure_virtual_cpu
+
+    ensure_virtual_cpu(1)
+    kw = dict(max_batch=2, kv_blocks=64, kv_block_size=8, **ENGINE_KW)
+    donor = LLMEngine(**kw)
+    eng = LLMEngine(**kw)
+    try:
+        ids = _ids(41)                      # 5 full blocks in ids[:-1]
+        want = donor.generate(prompt_ids=ids, max_tokens=6)["token_ids"]
+        blob = donor.export_prefix(prompt_ids=ids)
+        assert blob is not None and len(blob["ids"]) == 40
+
+        fut = Future()                      # unresolved: blob "in flight"
+        result = {}
+
+        def deferred():
+            result["out"] = eng.generate(prompt_ids=ids, max_tokens=6,
+                                         prefix_future=fut,
+                                         prefix_wait_s=60, timeout=120)
+
+        t = threading.Thread(target=deferred, daemon=True)
+        t.start()
+        # while the blob is in flight, ANOTHER lane joins and completes
+        other = eng.generate(prompt_ids=_ids(5, salt=3), max_tokens=5,
+                             timeout=60)
+        assert len(other["token_ids"]) == 5, \
+            "other lane starved behind a deferred prefix fetch"
+        assert not result, "deferred request ran before its blob landed"
+        assert eng.engine_stats()["deferred"] == 1
+        fut.set_result(blob)
+        t.join(120)
+        assert result["out"]["token_ids"] == want, \
+            "deferred import diverged from cold decode"
+        st = eng.engine_stats()
+        assert st["prefix_imports"] >= 1
+        assert st["prefix_blocks_imported"] >= 5
+        # the deferred request prefilled ONLY past the imported span
+        assert st["tokens_prefilled"] < 40 + 10
+
+        # deadline degrade: a fetch that never lands falls back to
+        # decode-local prefill (correct output, timeout counted)
+        never = Future()
+        out = eng.generate(prompt_ids=_ids(30, salt=5), max_tokens=4,
+                           prefix_future=never, prefix_wait_s=0.3,
+                           timeout=60)
+        assert len(out["token_ids"]) == 4
+        assert eng.engine_stats()["prefix_wait_timeouts"] >= 1
+    finally:
+        donor.shutdown()
+        eng.shutdown()
+
+
+# ------------------------------------------- cross-replica warm start
+def test_cross_replica_warm_start_zero_head_rpcs(cluster):
+    """Tentpole acceptance: a prefix exported by replica A (prefill
+    pool) warm-starts decode on replica B — which has NO prefill handle,
+    so the cluster store is its ONLY source — with zero head round trips
+    on the warm path (interposer-verified inside replica B) and output
+    byte-identical to a monolithic engine."""
+    from ray_tpu.serve.api import deployment
+    from ray_tpu.serve.disagg import DisaggLLMServer, PrefillServer
+    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.utils.platform import ensure_virtual_cpu
+
+    ensure_virtual_cpu(1)
+    pre = deployment(PrefillServer, name="px-prefill", num_replicas=1,
+                     ray_actor_options={"num_cpus": 1},
+                     max_ongoing_requests=4).bind(max_batch=2, **MODEL)
+    serve.run(pre, name="px-prefill")
+    pre_h = serve.get_deployment_handle("px-prefill")
+    dec = deployment(DisaggLLMServer, name="px-decode", num_replicas=1,
+                     ray_actor_options={"num_cpus": 1},
+                     max_ongoing_requests=4).bind(
+        prefill_handle=None, max_batch=2, **MODEL)
+    serve.run(dec, name="px-decode")
+    h = serve.get_deployment_handle("px-decode")
+    ref_eng = LLMEngine(enable_prefix_caching=False, max_batch=2,
+                        **ENGINE_KW)
+    try:
+        ids = _ids(90)
+        # replica A computes the prefix and PUBLISHES it to the store
+        res = pre_h.prefill.remote(ids).result(timeout=240)
+        assert res["n_tokens"] == 88
+        assert res.get("ref") is not None, \
+            "blob rode the inline path: publication under test needs shm"
+        # the binding rides the cluster_view broadcast: wait until
+        # replica B's cached directory can resolve it
+        deadline = time.time() + 30
+        covered = None
+        while time.time() < deadline:
+            covered = h.prefix_store_probe.remote(ids[:-1]).result(
+                timeout=30)
+            if covered:
+                break
+            time.sleep(0.2)
+        assert covered == 88, \
+            f"binding never reached replica B's directory: {covered}"
+
+        # warm-path audit: the whole lookup->fetch->import->decode cycle
+        # runs with replica B's head connection watched
+        assert h.rpc_audit_start.remote().result(timeout=30) is True
+        want = ref_eng.generate(prompt_ids=ids, max_tokens=6)["token_ids"]
+        out = h.remote({"prompt_ids": ids, "max_tokens": 6}).result(
+            timeout=240)
+        events = h.rpc_audit_stop.remote().result(timeout=30)
+        assert out["choices"][0]["token_ids"] == want, \
+            "store-tier warm start diverged from monolithic decode"
+        reqs = [m for k, m in events if k == "req"]
+        assert not reqs, \
+            f"decode replica made head round trips on warm path: {reqs}"
+        st = h.stats.remote().result(timeout=60)
+        assert st["store_fetches"] >= 1, st
+        assert st["blocks_imported"] >= 11, st
+        assert st["prefill_fetches"] == 0, \
+            "decode called a prefill pool it does not have"
+        assert st["prefix_store"]["store_hits"] >= 1, st
+    finally:
+        ref_eng.shutdown()
+        serve.delete("px-decode")
+        serve.delete("px-prefill")
+
+
+# --------------------------------------------------- multi-tenant LoRA
+def test_lora_adapters_share_base_prefix_entries(cluster):
+    """Satellite: adapter engines key the store by the BASE weights, so
+    a system prompt prefilled under adapter a1 warm-starts adapter a2 —
+    one store entry for the shared span, hits counted per adapter."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from ray_tpu.serve.llm import OpenAIServer
+    from ray_tpu.utils.platform import ensure_virtual_cpu
+
+    ensure_virtual_cpu(1)
+    root = tempfile.mkdtemp(prefix="lora_px_")
+    L, D = 4, 128                       # gpt2-tiny + n_layer=4 override
+    rng = np.random.default_rng(0)
+    for name in ("a1", "a2"):
+        np.savez(os.path.join(root, f"{name}.npz"), **{
+            "blocks.attn.wqkv.A": (rng.normal(size=(L, D, 4))
+                                   * 0.05).astype(np.float32),
+            "blocks.attn.wqkv.B": (rng.normal(size=(L, 4, 3 * D))
+                                   * 0.05).astype(np.float32),
+        })
+    srv = OpenAIServer(model_id="tiny", lora_root=root, max_loras=2,
+                       max_batch=2, kv_blocks=64, kv_block_size=8,
+                       cluster_prefix_cache=True, **ENGINE_KW)
+    try:
+        shared = _ids(80)               # 10 shared full blocks
+        body1 = {"prompt_ids": shared + _ids(4, salt=1), "max_tokens": 3,
+                 "model": "tiny:a1"}
+        srv(body1)
+        # publication rides the prefetch executor (not the response's
+        # tail latency): wait for it to land before the cross-adapter hit
+        deadline = time.time() + 30
+        while (time.time() < deadline
+               and not srv.prefix_store.stats()["published"]):
+            time.sleep(0.05)
+        st1 = srv.prefix_store.stats()
+        assert st1["published"] >= 1, st1
+        # a DIFFERENT adapter, same system prompt, different suffix:
+        # warm-starts from a1's published entry
+        body2 = {"prompt_ids": shared + _ids(4, salt=2), "max_tokens": 3,
+                 "model": "tiny:a2"}
+        srv(body2)
+        st2 = srv.prefix_store.stats()
+        assert st2["hits_by_tenant"].get("a2", 0) >= 1, st2
+        deadline = time.time() + 30     # import lands on a2's engine loop
+        eng2 = srv._lora_engines["a2"]
+        while time.time() < deadline and not eng2.prefix_blocks_imported:
+            time.sleep(0.1)
+        assert eng2.prefix_blocks_imported >= 10, \
+            "adapter a2 recomputed a prefix the base store already held"
+        # one store entry for the shared span: every shared-span boundary
+        # is served by a SINGLE pinned blob (a2's publish deduped it)
+        owners = {srv.prefix_store._pin_rows[ph][0]
+                  for ph, _n in chain_hashes(shared, 8)
+                  if ph in srv.prefix_store._pin_rows}
+        assert len(owners) == 1, \
+            f"shared prefix stored {len(owners)} times"
+    finally:
+        srv.engine.shutdown()
+        for e in srv._lora_engines.values():
+            e.shutdown()
+
+
+# ------------------------------------------------------- chaos drill
+@pytest.mark.chaos
+def test_prefix_owner_death_degrades_to_local_prefill():
+    """Chaos satellite: the node owning the prefix blob dies; a decode
+    consumer's fetch degrades to local prefill (request completes, no
+    error surfaces), the directory binding is purged by the node-death
+    record, and the next export re-announces the prefix."""
+    import os
+
+    from ray_tpu.cluster_utils import Cluster
+
+    # needs its own multi-node cluster with store isolation; the module
+    # fixture's in-process cluster cannot coexist (idempotent teardown)
+    serve.shutdown()
+    ray_tpu.shutdown()
+    saved = os.environ.get("RAY_TPU_STORE_ISOLATION")
+    os.environ["RAY_TPU_STORE_ISOLATION"] = "1"
+    cluster = Cluster(num_cpus=0)
+    owner_node = cluster.add_node(num_cpus=2, resources={"owner_pool": 4})
+    cluster.add_node(num_cpus=2, resources={"consumer_pool": 4})
+
+    def _actor_src():
+        import numpy as np
+
+        from ray_tpu.serve import kv_cache, prefix_store
+
+        class _Base:
+            def __init__(self, seed=0):
+                from ray_tpu.utils.platform import ensure_virtual_cpu
+
+                ensure_virtual_cpu(1)
+                import jax.numpy as jnp
+
+                self.kv = kv_cache.PagedKVCache(
+                    n_layer=4, n_head=4, head_dim=32, num_blocks=8,
+                    block_size=8)
+                rng = np.random.default_rng(seed)
+                self.cache = {
+                    "k": jnp.asarray(rng.normal(size=(4, 1, 4, 64, 32)),
+                                     jnp.float32),
+                    "v": jnp.asarray(rng.normal(size=(4, 1, 4, 64, 32)),
+                                     jnp.float32)}
+                self.store = prefix_store.PrefixStoreClient(
+                    "drill-model", 8, fetch_timeout_s=15.0)
+
+            def publish(self, ids):
+                self.kv.store_prefix(list(ids), self.cache, 0)
+                blob = kv_cache.export_prefix(self.kv, list(ids))
+                return {"ok": self.store.publish(blob),
+                        "n": len(blob["ids"])}
+
+            def probe(self, ids):
+                hit = self.store.lookup(list(ids))
+                return None if hit is None else hit["n"]
+
+            def warm_or_local(self, ids):
+                """The decode degrade path under test: store fetch on a
+                dead owner must fall back, never raise."""
+                hit = self.store.lookup(list(ids))
+                if hit is not None:
+                    blob = self.store.fetch(hit)
+                    if blob is not None:
+                        n = kv_cache.import_prefix(self.kv, blob)
+                        return {"mode": "store", "blocks": n}
+                return {"mode": "local"}
+
+        return _Base
+
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+        Base = _actor_src()
+        owner = ray_tpu.remote(Base).options(
+            resources={"owner_pool": 1}).remote(seed=3)
+        consumer = ray_tpu.remote(Base).options(
+            resources={"consumer_pool": 1}).remote(seed=99)
+        ids = list(range(1, 33))                  # 4 full blocks
+        pub = ray_tpu.get(owner.publish.remote(ids), timeout=180)
+        assert pub["ok"] and pub["n"] == 32
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ray_tpu.get(consumer.probe.remote(ids),
+                           timeout=60) == 32:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("binding never reached the consumer node")
+
+        # the owner node dies; the consumer's very next fetch attempt
+        # finds a dead data plane mid-pull and must degrade cleanly
+        cluster.kill_node(owner_node)
+        out = ray_tpu.get(consumer.warm_or_local.remote(ids), timeout=120)
+        assert out["mode"] == "local", \
+            f"fetch from a dead owner should degrade, got {out}"
+
+        # the node-death record purges the binding from every cache
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if ray_tpu.get(consumer.probe.remote(ids),
+                           timeout=60) is None:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("dead owner's binding never evicted")
+
+        # next export re-announces: the consumer itself computes the
+        # prefix and the store serves the cluster again
+        pub2 = ray_tpu.get(consumer.publish.remote(ids), timeout=180)
+        assert pub2["ok"]
+        assert ray_tpu.get(consumer.probe.remote(ids), timeout=60) == 32
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        if saved is None:
+            os.environ.pop("RAY_TPU_STORE_ISOLATION", None)
+        else:
+            os.environ["RAY_TPU_STORE_ISOLATION"] = saved

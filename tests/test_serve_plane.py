@@ -38,6 +38,23 @@ def _post(url: str, body: dict, timeout: float = 60):
         return resp.status, dict(resp.headers), json.loads(resp.read())
 
 
+def test_live_cache_refresh_never_autoinits_runtime():
+    """A live-load refresh consulted OUTSIDE an initialized runtime must
+    stay a no-op: the state-API fallback auto-inits a default single-node
+    runtime, and a router unit test (or standalone tooling) leaving that
+    runtime behind starved the next module's real cluster — its serve
+    replicas were health-killed mid-test (latent until the suite got fast
+    enough to reach this file after the router units)."""
+    from ray_tpu.core import api as core_api
+    from ray_tpu.serve.live_signals import LiveLoadCache
+
+    if core_api.is_initialized():
+        pytest.skip("runtime already initialized in this process")
+    LiveLoadCache().refresh(force=True)
+    assert not core_api.is_initialized(), \
+        "live-signal cold fallback must not auto-init a runtime"
+
+
 # ---------------------------------------------------- continuous batching
 def test_chunk_budget_plan_reserves_decode_first():
     """Token-budget scheduler invariants: decode lanes always advance
@@ -171,11 +188,12 @@ def test_disagg_prefill_decode_ships_kv_zero_head_rpcs(cluster):
             assert not reqs, \
                 f"{name} replica made head round trips on warm path: {reqs}"
             # permitted head-bound traffic is fire-and-forget telemetry
-            # only: refcount batches, metrics snapshots, object seal
-            # announcements, and worker blocked/unblocked state
+            # only: refcount batches, metrics snapshots, object seal +
+            # prefix-binding announcements, and worker blocked/unblocked
+            # state
             pushes = {m for k, m in events if k == "push"}
             assert pushes <= {"ref_update", "metrics_push", "put_meta",
-                              "blocked"}, \
+                              "announce_prefix", "blocked"}, \
                 f"{name} replica pushed more than telemetry/seal: {pushes}"
     finally:
         ref_eng.shutdown()
