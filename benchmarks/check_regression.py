@@ -61,6 +61,8 @@ SUITES = {
               "runner": "serve_plane"},
     "collective": {"baseline": "collective_microbench.json",
                    "runner": "collective_plane"},
+    "dag": {"baseline": "dag_microbench.json",
+            "runner": "dag_plane"},
 }
 DEFAULT_BASELINE = os.path.join(HERE, SUITES["control"]["baseline"])
 

@@ -28,6 +28,14 @@ FIXED seed, so a failure replays identically:
   control, and the controller's health loop must hold ZERO non-shed
   failures (429s are allowed and counted; 5xx are not).
 
+  phase 3b — compiled serve chain: sustained load through a
+  CompiledServeChain (pre-negotiated channel rings; zero per-request
+  control-plane RPCs) while the chain's replica chaos-self-kills
+  mid-load: the generation must fence, in-flight ring entries drain or
+  fail over to the dynamic handle path with ZERO failures, and the
+  chain must recompile over the replacement replica and serve compiled
+  traffic again before the phase ends.
+
   phase 4 — elastic-train drill: a 2-worker GPT-2-DDP run
   (microbenchmark._elastic_train_loop); once the gang makes progress, a
   `kill:*:n=1` plan is injected into one daemon over the chaos control
@@ -359,6 +367,101 @@ def serve_soak(seed: int, duration_s: float = 8.0, clients: int = 6) -> dict:
             "chaos": f"seed={seed},kill:*:n=1 (replica self-kill)"}
 
 
+def compiled_chain_soak(seed: int, duration_s: float = 8.0,
+                        clients: int = 6) -> dict:
+    """Compiled serve chain phase (ISSUE 14): sustained load through a
+    CompiledServeChain (pre-negotiated channel rings, zero per-request
+    control-plane RPCs) while a chain replica chaos-self-kills mid-load
+    (`protocol.configure_chaos("kill:*:n=1")` armed inside the replica —
+    it SIGKILLs itself on its next outbound telemetry push). Acceptance:
+    the generation fences, in-flight ring entries drain or fail over to
+    the dynamic handle path, ZERO request failures, and the chain
+    recompiles and serves compiled traffic again before the phase ends."""
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.compiled_chain import CompiledServeChain
+
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+
+    @serve.deployment
+    class ChainTarget:
+        def __call__(self, v):
+            time.sleep(0.02)
+            return {"ok": True, "x": v.get("x")}
+
+        def arm_chaos(self, spec: str) -> bool:
+            from ray_tpu.core import protocol
+
+            protocol.configure_chaos(spec)
+            return True
+
+    handle = serve.run(ChainTarget.options(max_ongoing_requests=16).bind(),
+                       name="soak-chain")
+    chain = CompiledServeChain(["soak-chain"], lanes=2, max_inflight=2,
+                               batch_max=8, entry_timeout_s=60,
+                               recompile_timeout_s=120).start()
+    ok, failed, lats = [], [], []
+    lock = threading.Lock()
+    stop = time.monotonic() + duration_s
+
+    def client():
+        i = 0
+        while time.monotonic() < stop:
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                out = chain.call({"x": i}, timeout=90)
+                assert out["ok"] and out["x"] == i
+                with lock:
+                    ok.append(i)
+                    lats.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    failed.append(repr(e))
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s / 3)
+    # chaos-inject the replica kill mid-load (the dynamic handle routes
+    # the arm call to the same single replica the chain compiled over)
+    assert handle.arm_chaos.remote(
+        f"seed={seed},kill:*:n=1").result(timeout=30) is True
+    for t in threads:
+        t.join(duration_s + 120)
+    elapsed = time.perf_counter() - t_start
+    recompiled = chain.wait_compiled(120)
+    # compiled traffic resumes on the replacement replica
+    before = chain.stats["compiled"]
+    post = [chain.submit({"x": -i}) for i in range(1, 9)]
+    post_ok = all(r.result(60)["ok"] for r in post)
+    stats = dict(chain.stats)
+    try:
+        chain.shutdown()
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+    assert not failed, f"{len(failed)} chain request failures: {failed[:3]}"
+    assert stats["fenced"] >= 1, f"chaos kill never fenced: {stats}"
+    assert recompiled, f"chain never recompiled: {stats}"
+    assert post_ok and stats["compiled"] > before, \
+        f"compiled traffic did not resume: {stats}"
+    return {"duration_s": round(elapsed, 2), "served": len(ok),
+            "failed": len(failed),
+            "rps": round(len(ok) / elapsed, 1),
+            "p99_s": round(float(np.percentile(lats, 99)), 4),
+            "fenced": stats["fenced"],
+            "dynamic_fallback": stats["dynamic_fallback"],
+            "recompiles": stats["recompiles"],
+            "chaos": f"seed={seed},kill:*:n=1 (replica self-kill)"}
+
+
 def elastic_train_drill(seed: int, steps: int = 30) -> dict:
     """The tentpole acceptance drill as a soak phase: the shared harness
     (`microbenchmark.run_elastic_drill`), with the kill delivered by the
@@ -390,6 +493,9 @@ def main(seed: int = 7, out: str | None = None, rounds: int = 6,
     print(f"[soak] serve plane under replica chaos kill (seed={seed})",
           file=sys.stderr)
     report["serve"] = serve_soak(seed)
+    print(f"[soak] compiled chain under replica chaos kill (seed={seed})",
+          file=sys.stderr)
+    report["compiled_chain"] = compiled_chain_soak(seed)
     print(f"[soak] elastic train drill (seed={seed})", file=sys.stderr)
     report["elastic_train"] = elastic_train_drill(seed, steps=steps)
     print(json.dumps(report, indent=2))

@@ -1,9 +1,20 @@
 // Mutable shared-memory channels for compiled graphs — the native
 // counterpart of the reference's mutable plasma objects + semaphores
 // (src/ray/core_worker/experimental_mutable_object_manager.{h,cc},
-// python/ray/experimental/channel/shared_memory_channel.py): a single-slot
-// value in shm, one writer, N readers, blocking handoff via a process-shared
+// python/ray/experimental/channel/shared_memory_channel.py): values in
+// shm, one writer, N readers, blocking handoff via a process-shared
 // mutex + condvar. Steady-state hop latency is a condvar wake, not an RPC.
+//
+// The slot store is an N-SLOT RING (num_slots >= 1): the writer appends
+// value seq W into slot (W-1) % num_slots and blocks only when the slot
+// it is about to overwrite still has unacked readers — i.e. when the ring
+// is full across ALL reader cursors. Readers consume strictly in sequence
+// (each reader sees every value exactly once); per-reader cursors live
+// with the reader (local handles keep last_seq; remote readers carry it
+// through the dag_chan_read RPC). num_slots = 1 degenerates to the
+// original single-slot handoff. The ring is what lets CompiledDAG keep
+// max_inflight iterations pipelined instead of serializing every stage
+// on the slowest consumer.
 
 #include <cerrno>
 #include <cstdint>
@@ -21,15 +32,22 @@ constexpr uint64_t kChanMagic = 0x52545055'4348414eull;  // "RTPUCHAN"
 
 struct ChanHeader {
   uint64_t magic;
-  uint64_t capacity;      // payload capacity
+  uint64_t capacity;      // per-slot payload capacity
   uint64_t total_size;
   pthread_mutex_t mutex;
   pthread_cond_t cond;
-  uint64_t seq;           // id of the value currently in the slot (0 = none)
-  uint64_t acks;          // readers that consumed the current value
+  uint64_t seq;           // seq of the NEWEST value written (0 = none yet)
   uint32_t num_readers;
   uint32_t closed;
-  uint64_t len;           // payload length of current value
+  uint32_t num_slots;
+  uint32_t _pad;
+};
+
+// per-slot metadata, laid out as an array right after the header
+struct SlotMeta {
+  uint64_t seq;           // value id held by this slot (0 = never written)
+  uint64_t len;           // payload length of that value
+  uint64_t acks;          // readers that consumed that value
 };
 
 struct ChanHandle {
@@ -42,8 +60,17 @@ struct ChanHandle {
 inline ChanHeader* chdr(ChanHandle* h) {
   return reinterpret_cast<ChanHeader*>(h->base);
 }
-inline uint8_t* payload(ChanHandle* h) {
-  return reinterpret_cast<uint8_t*>(h->base) + sizeof(ChanHeader);
+inline SlotMeta* slots(ChanHandle* h) {
+  return reinterpret_cast<SlotMeta*>(
+      reinterpret_cast<uint8_t*>(h->base) + sizeof(ChanHeader));
+}
+inline uint8_t* payload(ChanHandle* h, uint32_t slot) {
+  ChanHeader* H = chdr(h);
+  return reinterpret_cast<uint8_t*>(h->base) + sizeof(ChanHeader) +
+         sizeof(SlotMeta) * H->num_slots + (uint64_t)slot * H->capacity;
+}
+inline uint32_t slot_of(ChanHeader* H, uint64_t seq) {
+  return (uint32_t)((seq - 1) % H->num_slots);
 }
 
 int chan_lock(ChanHandle* h) {
@@ -77,8 +104,10 @@ int chan_wait(ChanHandle* h, int64_t timeout_ms) {
 extern "C" {
 
 void* rtpu_chan_create(const char* name, uint64_t capacity,
-                       uint32_t num_readers) {
-  uint64_t total = sizeof(ChanHeader) + capacity;
+                       uint32_t num_readers, uint32_t num_slots) {
+  if (num_slots == 0) num_slots = 1;
+  uint64_t total = sizeof(ChanHeader) + sizeof(SlotMeta) * num_slots +
+                   capacity * num_slots;
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0666);
   if (fd < 0) return nullptr;
   if (ftruncate(fd, (off_t)total) != 0) {
@@ -95,10 +124,11 @@ void* rtpu_chan_create(const char* name, uint64_t capacity,
   ChanHandle* h = new ChanHandle{base, total, fd, {0}};
   strncpy(h->name, name, sizeof(h->name) - 1);
   ChanHeader* H = chdr(h);
-  memset(H, 0, sizeof(ChanHeader));
+  memset(H, 0, sizeof(ChanHeader) + sizeof(SlotMeta) * num_slots);
   H->capacity = capacity;
   H->total_size = total;
   H->num_readers = num_readers ? num_readers : 1;
+  H->num_slots = num_slots;
 
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
@@ -143,6 +173,21 @@ void* rtpu_chan_attach(const char* name) {
   return h;
 }
 
+// Mark the channel closed and wake every blocked reader/writer WITHOUT
+// unmapping — safe to call while other threads of this process are
+// blocked inside read/write on the same handle (close() would unmap the
+// segment under them). Used to fence a channel whose peer process died:
+// the creator can no longer set the flag, so any attached handle does.
+void rtpu_chan_shutdown(void* hp) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  if (!h) return;
+  if (chan_lock(h) == 0) {
+    chdr(h)->closed = 1;
+    pthread_cond_broadcast(&chdr(h)->cond);
+    pthread_mutex_unlock(&chdr(h)->mutex);
+  }
+}
+
 void rtpu_chan_close(void* hp, int unlink_segment) {
   ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
   if (!h) return;
@@ -157,67 +202,95 @@ void rtpu_chan_close(void* hp, int unlink_segment) {
   delete h;
 }
 
-// Blocks until the slot is free (all readers acked the previous value).
-// 0 ok; -2 closed; -3 timeout; -4 payload too large.
+// Appends value seq+1 into its ring slot. Blocks while that slot still
+// holds a value not yet acked by every reader (ring full across reader
+// cursors). 0 ok; -2 closed; -3 timeout; -4 payload too large.
 int rtpu_chan_write(void* hp, const uint8_t* data, uint64_t len,
                     int64_t timeout_ms) {
   ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
   ChanHeader* H = chdr(h);
   if (len > H->capacity) return -4;
   if (chan_lock(h) != 0) return -1;
-  while (!H->closed && H->seq != 0 && H->acks < H->num_readers) {
+  SlotMeta* S = slots(h);
+  uint32_t slot;
+  for (;;) {
+    if (H->closed) {
+      pthread_mutex_unlock(&H->mutex);
+      return -2;
+    }
+    slot = slot_of(H, H->seq + 1);
+    if (S[slot].seq == 0 || S[slot].acks >= H->num_readers) break;
     if (chan_wait(h, timeout_ms) == ETIMEDOUT) {
       pthread_mutex_unlock(&H->mutex);
       return -3;
     }
   }
-  if (H->closed) {
-    pthread_mutex_unlock(&H->mutex);
-    return -2;
-  }
-  memcpy(payload(h), data, len);
-  H->len = len;
-  H->seq++;
-  H->acks = 0;
+  memcpy(payload(h, slot), data, len);
+  S[slot].len = len;
+  S[slot].acks = 0;
+  S[slot].seq = ++H->seq;
   pthread_cond_broadcast(&H->cond);
   pthread_mutex_unlock(&H->mutex);
   return 0;
 }
 
-// Blocks until a value newer than last_seq arrives; copies it into out.
-// 0 ok; -2 closed (and nothing newer); -3 timeout; -4 out buffer too small.
-// On success *seq_out/*len_out describe the value.
+// Reads the next value after last_seq (strictly in sequence; a reader
+// that attached after values were already overwritten fast-forwards to
+// the oldest value still in the ring). Blocks until it is written.
+// 0 ok; -2 closed (and nothing newer); -3 timeout; -4 out buffer too
+// small. On success *seq_out/*len_out describe the value. After close,
+// values still in the ring DRAIN before -2 is reported — in-flight ring
+// entries are never silently dropped.
 int rtpu_chan_read(void* hp, uint64_t last_seq, uint8_t* out,
                    uint64_t out_cap, uint64_t* seq_out, uint64_t* len_out,
                    int64_t timeout_ms) {
   ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
   ChanHeader* H = chdr(h);
   if (chan_lock(h) != 0) return -1;
-  while (!H->closed && (H->seq == 0 || H->seq == last_seq)) {
+  SlotMeta* S = slots(h);
+  uint64_t wanted;
+  for (;;) {
+    // oldest value still resident: seq - num_slots + 1 (ring wrapped)
+    wanted = last_seq + 1;
+    if (H->seq >= H->num_slots && wanted < H->seq - H->num_slots + 1)
+      wanted = H->seq - H->num_slots + 1;
+    if (wanted <= H->seq) break;   // written and still in the ring
+    if (H->closed) {               // closed with nothing newer
+      pthread_mutex_unlock(&H->mutex);
+      return -2;
+    }
     if (chan_wait(h, timeout_ms) == ETIMEDOUT) {
       pthread_mutex_unlock(&H->mutex);
       return -3;
     }
   }
-  if (H->seq == 0 || H->seq == last_seq) {  // closed with nothing newer
-    pthread_mutex_unlock(&H->mutex);
-    return -2;
-  }
-  if (H->len > out_cap) {
+  uint32_t slot = slot_of(H, wanted);
+  if (S[slot].len > out_cap) {
     pthread_mutex_unlock(&H->mutex);
     return -4;
   }
-  memcpy(out, payload(h), H->len);
-  *seq_out = H->seq;
-  *len_out = H->len;
-  H->acks++;
-  if (H->acks >= H->num_readers) pthread_cond_broadcast(&H->cond);
+  memcpy(out, payload(h, slot), S[slot].len);
+  *seq_out = wanted;
+  *len_out = S[slot].len;
+  S[slot].acks++;
+  if (S[slot].acks >= H->num_readers) pthread_cond_broadcast(&H->cond);
   pthread_mutex_unlock(&H->mutex);
   return 0;
 }
 
 uint64_t rtpu_chan_capacity(void* hp) {
   return chdr(reinterpret_cast<ChanHandle*>(hp))->capacity;
+}
+
+// header introspection: attach-side handles restore the true reader
+// count and ring depth from shm instead of guessing (a re-serialized
+// attached handle must keep capacity checks honest)
+uint32_t rtpu_chan_num_readers(void* hp) {
+  return chdr(reinterpret_cast<ChanHandle*>(hp))->num_readers;
+}
+
+uint32_t rtpu_chan_num_slots(void* hp) {
+  return chdr(reinterpret_cast<ChanHandle*>(hp))->num_slots;
 }
 
 }  // extern "C"

@@ -99,6 +99,12 @@ class CoreClient:
         self._dag_read_pool = None
         # user pubsub subscriptions: channel -> [callback]
         self._pubsub_callbacks: Dict[str, list] = {}
+        # post-reconnect hooks (pool_reconcile pattern for client-held
+        # state): after a successful head reconnect each callback runs
+        # once so publishers re-announce state the restarted head lost
+        # (e.g. prefix-store pin tables). Fired on the loop thread —
+        # callbacks must be non-blocking (pushes, not round trips).
+        self._reconnect_callbacks: list = []
         self.loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(target=self._run_loop, daemon=True,
                                              name="ray_tpu-client-loop")
@@ -238,12 +244,13 @@ class CoreClient:
     # channel lives in its WRITER's process; cross-node readers read
     # through these RPCs on the writer process's direct server.
 
-    async def _on_dag_chan_create(self, name, capacity, num_readers):
+    async def _on_dag_chan_create(self, name, capacity, num_readers,
+                                  num_slots=1):
         from ray_tpu.dag.channel import Channel
 
         if name not in self._dag_channels:
             ch = Channel(name=name, capacity=capacity,
-                         num_readers=num_readers)
+                         num_readers=num_readers, num_slots=num_slots)
             ch._rlock = threading.Lock()
             self._dag_channels[name] = ch
         return True
@@ -284,7 +291,20 @@ class CoreClient:
     async def _on_dag_chan_close(self, name, unlink):
         ch = self._dag_channels.pop(name, None)
         if ch is not None:
-            ch.close(unlink=unlink)
+            # shutdown first: wakes any read blocked in the pool (new
+            # ops see closed); the munmap-ing close then runs under the
+            # read lock OFF the event loop, so it can never pull the
+            # mapping out from under an in-flight blocking() read
+            ch.shutdown()
+
+            def _close():
+                with ch._rlock:
+                    ch.close(unlink=unlink)
+
+            if self._dag_read_pool is not None:
+                self._dag_read_pool.submit(_close)
+            else:
+                _close()
         return True
 
     async def _on_pubsub(self, channel, msg):
@@ -765,10 +785,27 @@ class CoreClient:
                     with self._inflight_lock:
                         self._inflight_specs.pop(rid0, None)
             self._connected.set()
+            for cb in list(self._reconnect_callbacks):
+                try:
+                    cb()
+                except Exception:
+                    pass
             return
         self._connected.set()  # unblock waiters into their errors
         if self.on_disconnect:
             self.on_disconnect()
+
+    def add_reconnect_callback(self, cb) -> None:
+        """Run `cb()` after every successful head reconnect (loop
+        thread; must not block). Used by publishers whose head-side
+        state is rebuilt from client truth — the prefix store re-pushes
+        its pin-table bindings the way pool_reconcile re-reports pools."""
+        if cb not in self._reconnect_callbacks:
+            self._reconnect_callbacks.append(cb)
+
+    def remove_reconnect_callback(self, cb) -> None:
+        if cb in self._reconnect_callbacks:
+            self._reconnect_callbacks.remove(cb)
 
     def head_recovering(self) -> bool:
         """True inside the window where a restarted head may still be

@@ -1,14 +1,22 @@
 """Python wrapper over the native mutable shm channel (channel.cc).
 
-Single-writer / N-reader single-slot handoff; values are serialized with the
-core serializer. This is the data plane of compiled DAGs (reference:
-`python/ray/experimental/channel/shared_memory_channel.py`).
+Single-writer / N-reader handoff over an N-slot ring; values are
+serialized with the core serializer. This is the data plane of compiled
+DAGs (reference: `python/ray/experimental/channel/shared_memory_channel.py`).
+
+``num_slots=1`` is the classic single-slot mutable object (writer blocks
+until every reader consumed the previous value). ``num_slots=k`` turns the
+slot into a ring: the writer runs up to k values ahead of the slowest
+reader cursor before blocking, which is what lets a compiled DAG keep
+`max_inflight` iterations pipelined across stages.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
+import threading
+import time
 from typing import Any, Optional
 
 from ray_tpu.core import serialization
@@ -30,10 +38,11 @@ def _lib():
     if not hasattr(lib.rtpu_chan_create, "_configured"):
         lib.rtpu_chan_create.restype = ctypes.c_void_p
         lib.rtpu_chan_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
-                                         ctypes.c_uint32]
+                                         ctypes.c_uint32, ctypes.c_uint32]
         lib.rtpu_chan_attach.restype = ctypes.c_void_p
         lib.rtpu_chan_attach.argtypes = [ctypes.c_char_p]
         lib.rtpu_chan_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rtpu_chan_shutdown.argtypes = [ctypes.c_void_p]
         lib.rtpu_chan_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                         ctypes.c_uint64, ctypes.c_int64]
         lib.rtpu_chan_read.argtypes = [
@@ -42,24 +51,68 @@ def _lib():
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
         lib.rtpu_chan_capacity.restype = ctypes.c_uint64
         lib.rtpu_chan_capacity.argtypes = [ctypes.c_void_p]
+        lib.rtpu_chan_num_readers.restype = ctypes.c_uint32
+        lib.rtpu_chan_num_readers.argtypes = [ctypes.c_void_p]
+        lib.rtpu_chan_num_slots.restype = ctypes.c_uint32
+        lib.rtpu_chan_num_slots.argtypes = [ctypes.c_void_p]
         lib.rtpu_chan_create._configured = True
     return lib
 
 
+# ------------------------------------------------------------------ metrics
+# dag_channel_wait_seconds: time spent BLOCKED on channel handoffs (writer
+# waiting for a free ring slot / reader waiting for the next value) — the
+# compiled hot path's analogue of rpc_latency_seconds. Lazily created so
+# plain channel users outside a runtime never touch the metrics registry.
+_wait_hist = None
+_wait_enabled = None
+
+
+def _observe_wait(op: str, dt: float) -> None:
+    global _wait_hist, _wait_enabled
+    if _wait_enabled is None:
+        try:
+            from ray_tpu.core import config as _config
+
+            _wait_enabled = bool(_config.get("rpc_metrics"))
+        except Exception:
+            _wait_enabled = False
+    if not _wait_enabled:
+        return
+    if _wait_hist is None:
+        try:
+            from ray_tpu.util import metrics
+
+            _wait_hist = metrics.Histogram(
+                "dag_channel_wait_seconds",
+                "Time blocked on compiled-DAG channel handoffs",
+                boundaries=[1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01,
+                            0.05, 0.1, 0.5, 1.0, 5.0],
+                tag_keys=("op",))
+        except Exception:
+            _wait_enabled = False
+            return
+    _wait_hist.observe(dt, tags={"op": op})
+
+
 class Channel:
-    """A named single-slot channel. Writers block until all readers consumed
-    the previous value; readers block until a new value arrives."""
+    """A named ring channel. Writers block when the ring is full across
+    all reader cursors; readers block until their next value arrives."""
 
     def __init__(self, name: Optional[str] = None, capacity: int = 4 << 20,
-                 num_readers: int = 1, _create: bool = True):
+                 num_readers: int = 1, num_slots: int = 1,
+                 _create: bool = True):
         self.name = name or f"rtpu_chan_{os.urandom(6).hex()}"
         self.capacity = capacity
         self.num_readers = num_readers
+        self.num_slots = max(1, int(num_slots))
         self._last_seq = 0
+        self._oplock = threading.Lock()
+        self._close_lock = threading.Lock()
         lib = _lib()
         if _create:
             self._h = lib.rtpu_chan_create(self.name.encode(), capacity,
-                                           num_readers)
+                                           num_readers, self.num_slots)
             self._owner = True
         else:
             self._h = lib.rtpu_chan_attach(self.name.encode())
@@ -73,21 +126,36 @@ class Channel:
         ch = cls.__new__(cls)
         ch.name = name
         ch._last_seq = 0
+        ch._oplock = threading.Lock()
+        ch._close_lock = threading.Lock()
         lib = _lib()
         ch._h = lib.rtpu_chan_attach(name.encode())
         if not ch._h:
             raise ChannelError(f"cannot attach channel {name}")
         ch._owner = False
         ch._lib_ref = lib
+        # the shm header is the source of truth: an attached handle keeps
+        # the creator's reader count and ring depth, so re-serializing it
+        # (__reduce__ -> attach) loses nothing and capacity checks stay
+        # honest
         ch.capacity = lib.rtpu_chan_capacity(ch._h)
-        ch.num_readers = 0  # unknown on attach; only the header knows
+        ch.num_readers = lib.rtpu_chan_num_readers(ch._h)
+        ch.num_slots = lib.rtpu_chan_num_slots(ch._h)
         return ch
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
         data = serialization.dumps(value)
-        rc = self._lib_ref.rtpu_chan_write(
-            self._h, data, len(data),
-            -1 if timeout is None else int(timeout * 1000))
+        t0 = time.perf_counter()
+        # _oplock serializes native ops on THIS handle so close() can
+        # never munmap the segment under a thread still inside the
+        # native call; shutdown() (lock-free) wakes a blocked op first
+        with self._oplock:
+            if not self._h:
+                raise ChannelClosedError(self.name)
+            rc = self._lib_ref.rtpu_chan_write(
+                self._h, data, len(data),
+                -1 if timeout is None else int(timeout * 1000))
+        _observe_wait("write", time.perf_counter() - t0)
         if rc == -2:
             raise ChannelClosedError(self.name)
         if rc == -3:
@@ -99,19 +167,29 @@ class Channel:
         if rc != 0:
             raise ChannelError(f"write failed rc={rc}")
 
-    def read(self, timeout: Optional[float] = None) -> Any:
+    def _buf(self):
         # reuse one capacity-sized buffer: create_string_buffer zero-fills,
         # which would dominate per-read cost for multi-MB channels
         buf = getattr(self, "_read_buf", None)
         if buf is None:
             cap = self._lib_ref.rtpu_chan_capacity(self._h)
             buf = self._read_buf = ctypes.create_string_buffer(cap)
-        cap = len(buf)
+        return buf
+
+    def read(self, timeout: Optional[float] = None) -> Any:
         seq = ctypes.c_uint64()
         ln = ctypes.c_uint64()
-        rc = self._lib_ref.rtpu_chan_read(
-            self._h, self._last_seq, buf, cap, ctypes.byref(seq),
-            ctypes.byref(ln), -1 if timeout is None else int(timeout * 1000))
+        t0 = time.perf_counter()
+        with self._oplock:
+            if not self._h:
+                raise ChannelClosedError(self.name)
+            buf = self._buf()
+            rc = self._lib_ref.rtpu_chan_read(
+                self._h, self._last_seq, buf, len(buf), ctypes.byref(seq),
+                ctypes.byref(ln),
+                -1 if timeout is None else int(timeout * 1000))
+            data = (ctypes.string_at(buf, ln.value) if rc == 0 else b"")
+        _observe_wait("read", time.perf_counter() - t0)
         if rc == -2:
             raise ChannelClosedError(self.name)
         if rc == -3:
@@ -121,40 +199,65 @@ class Channel:
         self._last_seq = seq.value
         # string_at copies exactly len bytes (buf.raw would copy the whole
         # capacity-sized buffer first)
-        return serialization.loads(ctypes.string_at(buf, ln.value))
+        return serialization.loads(data)
 
     def read_raw(self, last_seq: int, timeout: Optional[float] = None
                  ) -> tuple:
-        """Stateless read: block for a value newer than `last_seq`, return
+        """Stateless read: block for the value after `last_seq`, return
         (seq, serialized bytes). The per-reader cursor lives with the
         CALLER — this is what lets one attached channel serve any number
         of remote readers through the dag_chan_read RPC (reference
         remote-reader mutable objects,
         `core_worker/experimental_mutable_object_provider.cc`)."""
-        buf = getattr(self, "_read_buf", None)
-        if buf is None:
-            cap = self._lib_ref.rtpu_chan_capacity(self._h)
-            buf = self._read_buf = ctypes.create_string_buffer(cap)
         seq = ctypes.c_uint64()
         ln = ctypes.c_uint64()
-        rc = self._lib_ref.rtpu_chan_read(
-            self._h, last_seq, buf, len(buf), ctypes.byref(seq),
-            ctypes.byref(ln), -1 if timeout is None else int(timeout * 1000))
+        with self._oplock:
+            if not self._h:
+                raise ChannelClosedError(self.name)
+            buf = self._buf()
+            rc = self._lib_ref.rtpu_chan_read(
+                self._h, last_seq, buf, len(buf), ctypes.byref(seq),
+                ctypes.byref(ln),
+                -1 if timeout is None else int(timeout * 1000))
+            data = (ctypes.string_at(buf, ln.value) if rc == 0 else b"")
         if rc == -2:
             raise ChannelClosedError(self.name)
         if rc == -3:
             raise TimeoutError(f"read from {self.name} timed out")
         if rc != 0:
             raise ChannelError(f"read failed rc={rc}")
-        return seq.value, ctypes.string_at(buf, ln.value)
+        return seq.value, data
+
+    def shutdown(self) -> None:
+        """Set the closed flag and wake blocked peers WITHOUT unmapping
+        (close() would pull the mapping out from under a thread still
+        blocked in read/write on this handle). Any attached handle may
+        fence a channel this way — the teardown path for channels whose
+        creator process died. `_close_lock` (never held across a
+        blocking native call) guards the handle against a concurrent
+        close() freeing it mid-use."""
+        with self._close_lock:
+            if self._h:
+                self._lib_ref.rtpu_chan_shutdown(self._h)
 
     def close(self, unlink: bool = False) -> None:
-        if self._h:
-            self._lib_ref.rtpu_chan_close(self._h, 1 if unlink else 0)
-            self._h = None
+        # shutdown first (under _close_lock only, which no blocking op
+        # holds): wakes any op blocked inside the native call so it
+        # releases _oplock; then munmap under BOTH locks — close can
+        # never pull the mapping out from under a concurrent
+        # read/write, and a concurrent close()/shutdown() can never
+        # touch the freed handle (lock order: _oplock then _close_lock)
+        self.shutdown()
+        with self._oplock:
+            with self._close_lock:
+                if self._h:
+                    self._lib_ref.rtpu_chan_close(self._h,
+                                                  1 if unlink else 0)
+                    self._h = None
 
     def __reduce__(self):
-        # channels travel by name; receivers attach
+        # channels travel by name; receivers attach (and recover the true
+        # num_readers / num_slots from the shm header)
         return (Channel.attach, (self.name,))
 
 
@@ -179,6 +282,7 @@ class RemoteChannelReader:
 
         client = _global_client()
         deadline = None if timeout is None else _time.monotonic() + timeout
+        t0 = _time.perf_counter()
         while True:
             # bounded per-RPC wait keeps the serving side's reader threads
             # from being parked indefinitely by an idle consumer
@@ -195,6 +299,7 @@ class RemoteChannelReader:
             if reply.get("data") is None:
                 continue   # server-side wait elapsed; retry until deadline
             self._last_seq = reply["seq"]
+            _observe_wait("remote_read", _time.perf_counter() - t0)
             return serialization.loads(reply["data"])
 
     def close(self, unlink: bool = False) -> None:

@@ -19,12 +19,38 @@ nodes — host-side PP stage pipelining across TPU slices over DCN.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.dag.channel import (Channel, ChannelClosedError,
                                  RemoteChannelReader)
 from ray_tpu.dag.nodes import (ClassMethodNode, DAGNode, InputNode,
                                MultiOutputNode)
+
+# dag_step_seconds: end-to-end latency of one compiled iteration as the
+# driver sees it (execute() write -> output ring read). Lazy: compiled
+# DAGs can run outside an initialized metrics registry.
+_step_hist = None
+
+
+def _observe_step(dt: float) -> None:
+    global _step_hist
+    if _step_hist is None:
+        try:
+            from ray_tpu.util import metrics
+
+            _step_hist = metrics.Histogram(
+                "dag_step_seconds",
+                "Compiled-DAG iteration latency (input write to output "
+                "read at the driver)",
+                boundaries=[1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1,
+                            0.5, 1.0, 5.0, 30.0])
+        except Exception:
+            return
+    try:
+        _step_hist.observe(dt)
+    except Exception:
+        pass
 
 
 class CompiledDAGRef:
@@ -37,16 +63,36 @@ class CompiledDAGRef:
         self._done = False
 
     def get(self, timeout: Optional[float] = 30):
-        if not self._done:
-            self._dag._drain_until(self._idx, timeout)
+        # each drain fills the OLDEST pending iteration's refs; loop
+        # until OURS is filled so out-of-order gets (natural with
+        # max_inflight > 1) resolve correctly instead of returning an
+        # unfilled placeholder. A timeout raises WITHOUT consuming or
+        # poisoning anything — ring cursors stay aligned with _pending,
+        # and a later get() simply resumes the drain.
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        while not self._done:
+            left = None
+            if deadline is not None:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    raise TimeoutError("compiled DAG output timed out")
+            self._dag._drain_until(self._idx, left)
         if isinstance(self._value, Exception):
             raise self._value
         return self._value
 
 
 class CompiledDAG:
-    def __init__(self, output_node: DAGNode, channel_capacity: int = 4 << 20):
+    def __init__(self, output_node: DAGNode, channel_capacity: int = 4 << 20,
+                 max_inflight: int = 2):
         self.capacity = channel_capacity
+        # ring depth of every edge: how many iterations the driver may
+        # keep in flight before execute() backpressures on the input
+        # ring. 1 = classic single-slot serialization on the slowest
+        # stage; >1 overlaps stages across iterations (the compiled
+        # pipelining win)
+        self.max_inflight = max(1, int(max_inflight))
         self.output_node = output_node
         order = output_node.topo_order()
 
@@ -83,10 +129,14 @@ class CompiledDAG:
         self.input_channels: Dict[str, Channel] = {}
         self.leaf_readers: List[Any] = []
         self._remote_created: List[Tuple[Tuple[str, int], str]] = []
+        import threading
+
         self._loop_refs = []
         self._started = False
         self._torn_down = False
         self._pending: List[List[CompiledDAGRef]] = []
+        self._drain_lock = threading.Lock()
+        self._teardown_lock = threading.Lock()
 
     # ------------------------------------------------------------ planning
     def _start(self) -> None:
@@ -142,7 +192,8 @@ class CompiledDAG:
                 continue
             self.input_channels[node.uuid] = Channel(
                 name=self.chan_names[node.uuid], capacity=self.capacity,
-                num_readers=self.consumers[node.uuid])
+                num_readers=self.consumers[node.uuid],
+                num_slots=self.max_inflight)
         for n in self.method_nodes:
             if n.uuid not in self.chan_names:
                 continue
@@ -150,7 +201,8 @@ class CompiledDAG:
             client.direct_request(
                 actor_addr[key], "dag_chan_create",
                 name=self.chan_names[n.uuid], capacity=self.capacity,
-                num_readers=self.consumers[n.uuid])
+                num_readers=self.consumers[n.uuid],
+                num_slots=self.max_inflight)
             self._remote_created.append(
                 (actor_addr[key], self.chan_names[n.uuid]))
 
@@ -199,8 +251,11 @@ class CompiledDAG:
         self._started = True
 
     # ------------------------------------------------------------- control
-    def execute(self, *inputs) -> Any:
-        """Write inputs; returns CompiledDAGRef(s) for the output value(s)."""
+    def execute(self, *inputs, timeout: Optional[float] = None) -> Any:
+        """Write inputs; returns CompiledDAGRef(s) for the output value(s).
+        `timeout` bounds the input-ring write — with max_inflight
+        iterations already in flight the write backpressures until a
+        ring slot frees (or raises TimeoutError, e.g. a dead stage)."""
         if self._torn_down:
             raise RuntimeError("compiled DAG was torn down")
         if not self._started:
@@ -208,33 +263,90 @@ class CompiledDAG:
         if len(inputs) < len(self.input_nodes):
             raise ValueError(
                 f"need {len(self.input_nodes)} inputs, got {len(inputs)}")
-        for node in self.input_nodes:
-            self.input_channels[node.uuid].write(inputs[node.index])
+        # `timeout` bounds only the FIRST ring write (the backpressure
+        # point): once any input is written the iteration is committed,
+        # and timing out a LATER input would leave the rings
+        # desynchronized (input k holding one more value than input
+        # k+1, silently mispairing every subsequent iteration). The
+        # remaining writes block until their ring frees a slot, which
+        # is guaranteed to happen as consumers drain earlier iterations.
+        for n, node in enumerate(self.input_nodes):
+            self.input_channels[node.uuid].write(
+                inputs[node.index], timeout=timeout if n == 0 else None)
         refs = [CompiledDAGRef(self, i) for i in range(len(self.leaf_nodes))]
+        t0 = time.perf_counter()
+        for r in refs:
+            r._t0 = t0
         self._pending.append(refs)
         return refs[0] if len(refs) == 1 else refs
 
     def _drain_until(self, idx: int, timeout: Optional[float]) -> None:
-        """Read one iteration's outputs into the oldest pending ref set."""
-        if not self._pending:
-            raise RuntimeError("no execution in flight")
-        from ray_tpu.dag.runtime import materialize_channel_value
+        """Read the oldest pending iteration's outputs into its ref set.
 
-        refs = self._pending.pop(0)
-        for i, reader in enumerate(self.leaf_readers):
-            try:
-                refs[i]._value = materialize_channel_value(
-                    reader.read(timeout=timeout))
-            except (ChannelClosedError, TimeoutError) as e:
-                refs[i]._value = e
-            refs[i]._done = True
+        Serialized (fence/teardown paths may race a drainer thread on
+        the same DAG — unsynchronized interleaved leaf reads would pair
+        iterations with the wrong refs), and RESUMABLE: a read timeout
+        propagates without popping the set or advancing other leaves'
+        work past it, so ring cursors and _pending stay aligned and the
+        next drain continues where this one stopped. Only terminal
+        channel closure poisons refs."""
+        acquired = self._drain_lock.acquire(
+            timeout=-1 if timeout is None else max(0.01, timeout))
+        if not acquired:
+            raise TimeoutError("compiled DAG output timed out")
+        try:
+            if not self._pending:
+                raise RuntimeError("no execution in flight")
+            from ray_tpu.dag.runtime import materialize_channel_value
+
+            refs = self._pending[0]
+            for i, reader in enumerate(self.leaf_readers):
+                if refs[i]._done:
+                    continue   # resumed drain: this leaf already read
+                try:
+                    refs[i]._value = materialize_channel_value(
+                        reader.read(timeout=timeout))
+                except ChannelClosedError as e:
+                    refs[i]._value = e
+                refs[i]._done = True
+            self._pending.pop(0)
+        finally:
+            self._drain_lock.release()
+        dt = time.perf_counter() - getattr(refs[0], "_t0", time.perf_counter())
+        _observe_step(dt)
+        from ray_tpu.util import tracing
+
+        if tracing.is_recording():
+            # one span per compiled iteration: start_span stamps start_ts
+            # at entry, so backdate it to the execute() write
+            with tracing.start_span(
+                    "dag.step",
+                    attributes={"ray_tpu.op": "dag_step",
+                                "duration_s": dt}) as span:
+                if span is not None:
+                    span.start_ts = time.time() - dt
 
     def teardown(self, kill_actors: bool = False) -> None:
-        if self._torn_down:
-            return
-        self._torn_down = True
+        # atomic check-then-set: the chain's shutdown and its recompile
+        # thread may race here; a double native close is a use-after-free
+        with self._teardown_lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+        # close() is shutdown-then-munmap-under-the-op-lock: it wakes a
+        # writer blocked in execute() / a drainer blocked on an output
+        # ring and only unmaps once they left the native call. Closing
+        # the leaf readers also fences rings whose stage process DIED
+        # (nobody else can set the closed flag), so blocked readers fail
+        # over promptly instead of waiting out their full timeout.
         for ch in self.input_channels.values():
             ch.close(unlink=True)
+        for reader in self.leaf_readers:
+            if isinstance(reader, Channel):
+                try:
+                    reader.close()
+                except Exception:
+                    pass
         if self._started:
             from ray_tpu.core.api import _global_client
 

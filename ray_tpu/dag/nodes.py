@@ -50,10 +50,12 @@ class DAGNode:
     def _run(self, results, input_values):
         raise NotImplementedError
 
-    def experimental_compile(self, channel_capacity: int = 4 << 20):
+    def experimental_compile(self, channel_capacity: int = 4 << 20,
+                             max_inflight: int = 2):
         from ray_tpu.dag.compiled import CompiledDAG
 
-        return CompiledDAG(self, channel_capacity=channel_capacity)
+        return CompiledDAG(self, channel_capacity=channel_capacity,
+                           max_inflight=max_inflight)
 
     def __rshift__(self, other):  # small convenience for linear pipelines
         if callable(getattr(other, "bind", None)):

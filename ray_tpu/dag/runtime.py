@@ -96,9 +96,10 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
 
     iterations = 0
     # device-edge lifetime: the producer holds the ONLY refs to its
-    # device outputs. Two generations stay alive — the value a reader may
-    # still be fetching and the value just written — released as newer
-    # writes land (single-slot backpressure bounds reader lag to one).
+    # device outputs. Ring backpressure bounds reader lag to num_slots
+    # values, so num_slots + 2 generations stay alive (the slots a
+    # reader may still be fetching plus the value just written) —
+    # released as newer writes land.
     from collections import deque as _deque
 
     dev_refs: Dict[str, "_deque"] = {}
@@ -138,7 +139,8 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
                         oref = _global_client().put_device(result)
                         gens = dev_refs.setdefault(out, _deque())
                         gens.append(oref)
-                        while len(gens) > 2:
+                        keep = writer(out).num_slots + 2
+                        while len(gens) > keep:
                             gens.popleft()   # GC -> dec -> device free
                         result = {DEVICE_DESC: oref.binary()}
                     # same-actor downstream steps re-read the channel (their

@@ -235,10 +235,29 @@ def named_sharding(*logical_axes: Optional[str], mesh: Optional[Mesh] = None) ->
     return NamedSharding(mesh, logical_to_spec(*logical_axes))
 
 
+_constrain_suppressed = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_constraints():
+    """Disable `constrain` inside the with-block (trace-time scope).
+
+    FULL-manual shard_map regions (the CPU pipeline lowering in
+    parallel/pipeline.py) reject with_sharding_constraint over manual
+    axes; stage functions written for auto sharding still call
+    `constrain`, so the manual lowering wraps their trace in this."""
+    prev = getattr(_constrain_suppressed, "on", False)
+    _constrain_suppressed.on = True
+    try:
+        yield
+    finally:
+        _constrain_suppressed.on = prev
+
+
 def constrain(x, *logical_axes: Optional[str]):
     """`with_sharding_constraint` by logical axis names; no-op without a mesh."""
     mesh = current_mesh()
-    if mesh is None:
+    if mesh is None or getattr(_constrain_suppressed, "on", False):
         return x
     spec = logical_to_spec(*logical_axes)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

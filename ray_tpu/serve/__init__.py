@@ -7,6 +7,7 @@ from ray_tpu.serve.api import (Deployment, delete, deployment,
                                status)
 from ray_tpu.serve.autoscaling import AutoscalingConfig
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.compiled_chain import ChainResponse, CompiledServeChain
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.grpc_proxy import start_grpc
 from ray_tpu.serve.live_signals import SLOConfig
@@ -19,4 +20,5 @@ __all__ = [
     "batch",
     "DeploymentHandle", "DeploymentResponse", "multiplexed",
     "get_multiplexed_model_id",
+    "CompiledServeChain", "ChainResponse",
 ]

@@ -1,0 +1,648 @@
+"""Compiled serve replica chain: the serving plane's standing fast path.
+
+The dynamic handle path (serve/handle.py) pays per-request work on every
+hop: routing-table refresh, replica pick, actor-call RPC submission,
+result resolution. At saturation that control-plane work is the p99.
+This module compiles a replica CHAIN (proxy -> preprocess -> ... -> LLM
+replica) ONCE into pre-negotiated channel edges (ray_tpu/dag): the
+caller-side client writes the input ring and reads the output ring —
+zero control-plane RPCs per warm request (interposer-verified in
+tests/test_compiled_chain.py). Scheduling work happens only at
+(re)compile time, exactly the SURVEY §3.7 Compiled Graphs contract.
+
+Batched ring entries: one ring entry carries up to `batch_max` queued
+requests, so the engine's continuous batching still applies across a
+compiled entry (replica-side `ReplicaActor.handle_chain` hands the whole
+entry to `batch_call` when the deployment callable exposes it). The
+writer coalesces ADAPTIVELY: an idle chain ships a lone request
+immediately (no fixed batching delay on the low-load path); once entries
+are already in flight it waits a few ms to fill the next entry — the
+admission shape continuous batching wants at saturation. At saturation
+the ring depth (`max_inflight`) keeps entries pipelined across stages
+while earlier entries still execute.
+
+Lanes: a replica's exec loop processes ring entries one at a time, which
+would serialize an LLM engine across entries. `lanes=k` compiles k
+INDEPENDENT channel rings over the same replica chain; each lane's exec
+loop occupies one replica executor thread, so up to k entries execute
+concurrently inside the replica and the engine's per-step join/evict
+batches across them — the compiled analogue of the dynamic path's
+concurrent actor calls, still with zero per-request RPCs.
+
+Failure model ("compiled chain actor dies -> recompile"): the chain
+records the cluster epoch + a local generation at compile time. A chain
+replica dying (actor_state pubsub, a drained error marker, or a ring
+read/write timeout) FENCES the generation: new submissions route to the
+dynamic handle path immediately, in-flight ring entries are drained
+where possible and failed over to the dynamic path otherwise, and a
+background thread recompiles against the deployment's surviving/replaced
+replicas under the new generation (the PR 6 generation machinery + PR 3
+epoch fences). Requests never observe a 500 for infrastructure reasons.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CHAIN_ERR = "__rtpu_chain_error__"
+
+
+def infra_error(detail: str) -> dict:
+    """Marker for an infrastructure failure: the chain client fails the
+    item over to the dynamic handle path instead of surfacing an error."""
+    return {CHAIN_ERR: detail, "infra": True}
+
+
+def is_chain_error(value) -> bool:
+    return isinstance(value, dict) and CHAIN_ERR in value
+
+
+class ChainResponse:
+    """Future for one request submitted to the chain."""
+
+    def __init__(self, value):
+        self.request = value
+        self._ev = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+    def _set(self, value) -> None:
+        self._value = value
+        self._ev.set()
+
+    def _set_exc(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("chain request timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class CompiledServeChain:
+    """Compile a sequential deployment chain into channel edges.
+
+    deployments: deployment names in chain order; each stage's output is
+    the next stage's input (`value = stage(value)`).
+    """
+
+    def __init__(self, deployments: List[str], *,
+                 lanes: int = 2, max_inflight: int = 4, batch_max: int = 8,
+                 coalesce_ms: float = 3.0,
+                 channel_capacity: int = 1 << 20,
+                 entry_timeout_s: float = 60.0,
+                 recompile_timeout_s: float = 60.0,
+                 controller=None):
+        if not deployments:
+            raise ValueError("need at least one deployment")
+        self.deployments = list(deployments)
+        self.lanes = max(1, int(lanes))
+        self.max_inflight = max(1, int(max_inflight))
+        self.batch_max = max(1, int(batch_max))
+        self.coalesce_s = max(0.0, coalesce_ms) / 1000.0
+        self.capacity = channel_capacity
+        self.entry_timeout_s = entry_timeout_s
+        self.recompile_timeout_s = recompile_timeout_s
+        self._controller = controller
+        self._cdags: List[Any] = []
+        self._targets: List[tuple] = []       # (deployment, tag, handle)
+        self._actor_ids: set = set()
+        self.generation = 0
+        self.epoch = None
+        self._broken = True                   # until first compile
+        self._shutdown = False
+        self._lock = threading.RLock()
+        self._subq: "queue.Queue" = queue.Queue()
+        self._pendqs: List["queue.Queue"] = []   # one FIFO per lane
+        self._lane_outstanding: List[int] = []
+        self._dyn_handles: Dict[str, Any] = {}
+        self._dyn_pool = None
+        self._death_cb = None
+        self._dead_aids: set = set()   # actor ids observed dead (pubsub)
+        self._threads: List[threading.Thread] = []
+        # lifetime counters (tests/bench/observability)
+        self.stats = {"compiled": 0, "dynamic_fallback": 0, "recompiles": 0,
+                      "fenced": 0, "entries": 0, "drained_on_fence": 0}
+        # bounded event log (fences, recompile attempts, failovers):
+        # the chain's own flight recorder for drills and debugging
+        self.events: List[tuple] = []
+
+    def _log(self, kind: str, **detail) -> None:
+        with self._lock:
+            self.events.append((round(time.time(), 3), kind, detail))
+            if len(self.events) > 200:
+                del self.events[:100]
+
+    # ----------------------------------------------------------- bring-up
+    def _ctrl(self):
+        if self._controller is None:
+            from ray_tpu.serve.api import _get_or_create_controller
+
+            self._controller = _get_or_create_controller()
+        return self._controller
+
+    def _resolve_targets(self, exclude: Optional[set] = None) -> List[tuple]:
+        """One healthy replica per deployment, from the controller's
+        routing table (compile-time only — never on the request path)."""
+        import ray_tpu
+
+        targets = []
+        deadline = time.monotonic() + self.recompile_timeout_s
+        for dep in self.deployments:
+            while True:
+                table = ray_tpu.get(
+                    self._ctrl().get_routing_table.remote(dep), timeout=30)
+                if table is None:
+                    raise KeyError(f"deployment {dep!r} not found")
+                replicas = {t: h for t, h in table["replicas"].items()
+                            if not exclude
+                            or h._actor_id.binary() not in exclude}
+                if replicas:
+                    tag = sorted(replicas)[0]
+                    targets.append((dep, tag, replicas[tag]))
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no live replicas for {dep!r} within "
+                        f"{self.recompile_timeout_s}s")
+                time.sleep(0.2)
+        return targets
+
+    def _compile(self, exclude: Optional[set] = None) -> None:
+        """(Re)build the compiled chain; only path that talks to the
+        control plane. Each lane is an independent channel ring over the
+        SAME replica chain: one replica executor thread per lane, so
+        entries on different lanes execute concurrently."""
+        from ray_tpu.core.api import _global_client
+        from ray_tpu.dag.nodes import InputNode
+
+        targets = self._resolve_targets(exclude=exclude)
+        cdags = []
+        for _lane in range(self.lanes):
+            with InputNode() as inp:
+                node = inp
+                for _dep, _tag, handle in targets:
+                    node = handle.handle_chain.bind(node)
+            cdags.append(node.experimental_compile(
+                channel_capacity=self.capacity,
+                max_inflight=self.max_inflight))
+        # warm every lane BEFORE publishing the generation: the writer /
+        # drainer threads must never share channel handles with these
+        # warm-up reads (same cursor + scratch buffer), so the chain
+        # stays broken (dynamic path) until the new rings proved alive
+        try:
+            refs = [cd.execute([], timeout=self.entry_timeout_s)
+                    for cd in cdags]
+            for ref in refs:
+                ref.get(timeout=self.entry_timeout_s)
+        except Exception:
+            # a dead target mid-warm: release the half-built generation
+            # (surviving stages' exec loops exit on channel close)
+            for cd in cdags:
+                try:
+                    cd.teardown()
+                except Exception:
+                    pass
+            raise
+        with self._lock:
+            self._targets = targets
+            self._actor_ids = {h._actor_id.binary() for _, _, h in targets}
+            self._cdags = cdags
+            self._pendqs = [queue.Queue() for _ in range(self.lanes)]
+            self._lane_outstanding = [0] * self.lanes
+            self.epoch = getattr(_global_client(), "cluster_epoch", None)
+            self.generation += 1
+            self._broken = False
+            self.stats["recompiles"] += 1
+        self._log("compiled", generation=self.generation,
+                  targets=[(d, t) for d, t, _h in targets])
+
+    def start(self) -> "CompiledServeChain":
+        from ray_tpu.core.api import _global_client
+
+        self._compile()   # compiles AND warms before going live
+
+        # event-time death detection (PR 6 pattern): a chain actor dying
+        # fences the generation immediately, not at the next timeout
+        def on_actor_state(msg):
+            if msg.get("state") not in ("DEAD", "RESTARTING"):
+                return
+            aid = msg.get("actor_id")
+            with self._lock:
+                hit = aid in self._actor_ids
+                if hit:
+                    self._dead_aids.add(aid)
+                hit = hit and not self._broken
+            if hit:
+                # pubsub callbacks run on the client loop thread: fence
+                # on a worker thread, never block the loop
+                threading.Thread(
+                    target=self._fence, args=("actor_death",),
+                    daemon=True, name="chain-fence").start()
+
+        self._death_cb = on_actor_state
+        _global_client().subscribe_channel("actor_state", on_actor_state)
+
+        t = threading.Thread(target=self._writer_loop, daemon=True,
+                             name="chain-writer")
+        t.start()
+        self._threads.append(t)
+        for lane in range(self.lanes):
+            t = threading.Thread(target=self._drainer_loop, args=(lane,),
+                                 daemon=True, name=f"chain-drainer-{lane}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    # ------------------------------------------------------------ request
+    def submit(self, value) -> ChainResponse:
+        """Enqueue one request; never raises for infra reasons — a broken
+        chain window routes to the dynamic handle path."""
+        if self._shutdown:
+            raise RuntimeError("chain was shut down")
+        resp = ChainResponse(value)
+        with self._lock:
+            broken = self._broken
+        if broken:
+            self._dynamic_submit([resp])
+        else:
+            self._subq.put(resp)
+        return resp
+
+    def call(self, value, timeout: Optional[float] = None):
+        return self.submit(value).result(timeout or self.entry_timeout_s)
+
+    __call__ = call
+
+    # ------------------------------------------------------- worker loops
+    def _writer_loop(self) -> None:
+        """Adaptive batching dispatcher. An entry dispatches when a FREE
+        lane exists AND (the entry is full, or the chain is idle, or the
+        coalesce window expired). While every lane is busy, arriving
+        requests keep joining the forming entry instead of queueing
+        behind a busy ring — at saturation this is exactly the admission
+        shape the engine's continuous batching wants, and an idle chain
+        ships a lone request with zero added latency."""
+        entries: List[ChainResponse] = []
+        window_end = 0.0
+        while not self._shutdown:
+            if not entries:
+                try:
+                    entries = [self._subq.get(timeout=0.2)]
+                except queue.Empty:
+                    continue
+                window_end = time.monotonic() + self.coalesce_s
+            while len(entries) < self.batch_max:
+                try:
+                    entries.append(self._subq.get_nowait())
+                except queue.Empty:
+                    break
+            with self._lock:
+                broken, gen = self._broken, self.generation
+                lane = None
+                if not broken and self._cdags:
+                    free = [i for i in range(len(self._cdags))
+                            if self._lane_outstanding[i] < self.max_inflight]
+                    if free:
+                        lane = min(free,
+                                   key=lambda i: self._lane_outstanding[i])
+                        busy = any(o > 0 for o in self._lane_outstanding)
+                        if (busy and len(entries) < self.batch_max
+                                and time.monotonic() < window_end):
+                            lane = None   # keep coalescing
+                        else:
+                            cdag = self._cdags[lane]
+                            pendq = self._pendqs[lane]
+                            self._lane_outstanding[lane] += 1
+            if broken:
+                self._dynamic_submit(entries)
+                entries = []
+                continue
+            if lane is None:
+                time.sleep(0.0005)
+                continue
+            try:
+                ref = cdag.execute([e.request for e in entries],
+                                   timeout=self.entry_timeout_s)
+                self.stats["entries"] += 1
+                pendq.put((gen, ref, entries))
+                with self._lock:
+                    fenced = gen != self.generation or self._broken
+                if fenced:
+                    # a fence swapped the pendqs while we were inside
+                    # execute(): this put may have landed on an orphaned
+                    # queue no drainer reads. Reclaim whatever is still
+                    # there (the fence's own drain pops items exactly
+                    # once too) so no caller is stranded.
+                    self._reclaim_pendq(pendq)
+            except Exception:
+                # ring write failed (dead stage / torn down mid-swap):
+                # fail over this batch, fence if still current
+                self._lane_done(lane, gen)
+                self._dynamic_submit(entries)
+                self._maybe_fence(gen, "execute_failed")
+            entries = []
+        # shutdown: requests popped into the local coalescing buffer but
+        # never dispatched still belong to callers — fail them over
+        if entries:
+            self._dynamic_submit(entries)
+
+    def _reclaim_pendq(self, pendq: "queue.Queue") -> None:
+        """Drain an orphaned (fenced-generation) pending queue: deliver
+        what the rings still produced, fail over the rest."""
+        while True:
+            try:
+                pgen, ref, entries = pendq.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                results = ref.get(timeout=2.0)
+                self._deliver(entries, results, pgen)
+            except Exception:
+                self._dynamic_submit([e for e in entries if not e.done()])
+
+    def _drainer_loop(self, lane: int) -> None:
+        while not self._shutdown:
+            with self._lock:
+                pendq = (self._pendqs[lane]
+                         if lane < len(self._pendqs) else None)
+            if pendq is None:
+                time.sleep(0.2)
+                continue
+            try:
+                gen, ref, entries = pendq.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                results = ref.get(timeout=self.entry_timeout_s)
+            except Exception:
+                self._lane_done(lane, gen)
+                self._dynamic_submit(entries)
+                self._maybe_fence(gen, "drain_failed")
+                continue
+            self._lane_done(lane, gen)
+            self._deliver(entries, results, gen)
+
+    def _lane_done(self, lane: int, gen: int) -> None:
+        with self._lock:
+            if gen == self.generation and lane < len(self._lane_outstanding):
+                self._lane_outstanding[lane] -= 1
+
+    def _deliver(self, entries, results, gen) -> None:
+        ok = isinstance(results, list) and len(results) == len(entries)
+        if not ok:
+            self._dynamic_submit(entries)
+            self._maybe_fence(gen, "bad_entry_shape")
+            return
+        infra_hit = False
+        for e, r in zip(entries, results):
+            if is_chain_error(r):
+                if r.get("infra"):
+                    infra_hit = True
+                    self._dynamic_submit([e])
+                else:
+                    e._set_exc(RuntimeError(r[CHAIN_ERR]))
+            else:
+                e._set(r)
+                self.stats["compiled"] += 1
+        if infra_hit:
+            self._maybe_fence(gen, "infra_marker")
+
+    # ------------------------------------------------------ failure plane
+    def _maybe_fence(self, gen: int, reason: str) -> None:
+        with self._lock:
+            if gen != self.generation or self._broken:
+                return
+        self._fence(reason)
+
+    def _fence(self, reason: str) -> None:
+        """Fence the current generation: stop the compiled path, drain
+        or fail over everything in flight, then recompile in background.
+        Epoch semantics match PR 3: anything stamped with the old
+        generation is rejected-and-reconciled, never silently retried."""
+        with self._lock:
+            if self._broken or self._shutdown:
+                return
+            self._broken = True
+            self.stats["fenced"] += 1
+            self.events.append((round(time.time(), 3), "fence",
+                                {"reason": reason, "gen": self.generation}))
+            cdags = self._cdags
+            self._cdags = []
+            pendqs = self._pendqs
+            self._pendqs = []
+            self._lane_outstanding = []
+            gen = self.generation
+        # drain-first: entries that already passed the dead stage may
+        # still complete from the output ring; everything else fails
+        # over. Bounded short — callers are waiting.
+        pending = []
+        for pq in pendqs:
+            while True:
+                try:
+                    pending.append(pq.get_nowait())
+                except queue.Empty:
+                    break
+        for pgen, ref, entries in pending:
+            # entries still in pendq were never delivered at all
+            try:
+                results = ref.get(timeout=2.0)
+                self._deliver(entries, results, pgen)
+                self.stats["drained_on_fence"] += len(entries)
+            except Exception:
+                self._dynamic_submit([e for e in entries if not e.done()])
+        # submissions queued but not yet written also fail over
+        backlog = []
+        while True:
+            try:
+                backlog.append(self._subq.get_nowait())
+            except queue.Empty:
+                break
+        if backlog:
+            self._dynamic_submit(backlog)
+
+        del gen   # fenced generation: superseded by the recompile below
+
+        def _teardown_and_recompile():
+            for cd in cdags:
+                try:
+                    cd.teardown()
+                except Exception:
+                    pass
+            deadline = time.monotonic() + self.recompile_timeout_s
+            while not self._shutdown and time.monotonic() < deadline:
+                try:
+                    # exclude pubsub-observed corpses: the controller may
+                    # not have reconciled the death yet, and recompiling
+                    # over one would fence again immediately
+                    with self._lock:
+                        dead = set(self._dead_aids)
+                    self._compile(exclude=dead)   # warms before going live
+                    return
+                except Exception as e:  # noqa: BLE001
+                    # stay broken: the dynamic path keeps serving while
+                    # the controller replaces the replica; retry
+                    self._log("recompile_retry", error=repr(e)[:200])
+                    with self._lock:
+                        stale = self._cdags
+                        self._cdags = []
+                        self._broken = True
+                    for cd in stale:
+                        try:
+                            cd.teardown()
+                        except Exception:
+                            pass
+                    time.sleep(0.5)
+
+        threading.Thread(target=_teardown_and_recompile, daemon=True,
+                         name="chain-recompile").start()
+
+    def recompile(self) -> None:
+        """Manual recompile (tests / membership change without a death)."""
+        self._fence("manual")
+
+    # ------------------------------------------------------- dynamic path
+    def _dyn_handle(self, dep: str):
+        if dep not in self._dyn_handles:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            self._dyn_handles[dep] = DeploymentHandle(dep, self._ctrl())
+        return self._dyn_handles[dep]
+
+    def _dynamic_submit(self, entries: List[ChainResponse]) -> None:
+        """Serve entries through the dynamic handle path (router-level
+        replica failover; never a 500 for infra reasons)."""
+        if not entries:
+            return
+        if self._dyn_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._lock:
+                if self._dyn_pool is None:
+                    self._dyn_pool = ThreadPoolExecutor(
+                        max_workers=16, thread_name_prefix="chain-dyn")
+
+        from ray_tpu.core.exceptions import (ActorDiedError,
+                                             ActorUnavailableError,
+                                             WorkerCrashedError)
+
+        infra_excs = (ActorDiedError, ActorUnavailableError,
+                      WorkerCrashedError, ConnectionError)
+
+        entries = [e for e in entries if not e.done()]
+        if not entries:
+            return
+
+        def run(e: ChainResponse):
+            # infra-aware retry: right after a replica death the routing
+            # table may still list the corpse until the controller
+            # reconciles — refresh and retry until the replacement lands
+            # (the never-500 contract), bounded by the entry timeout
+            deadline = time.monotonic() + self.entry_timeout_s
+            while True:
+                try:
+                    value = e.request
+                    for dep in self.deployments:
+                        h = self._dyn_handle(dep)
+                        value = h.remote(value).result(
+                            timeout=max(1.0, deadline - time.monotonic()))
+                    e._set(value)
+                    self.stats["dynamic_fallback"] += 1
+                    return
+                except infra_excs as exc:
+                    if time.monotonic() > deadline:
+                        e._set_exc(exc)
+                        return
+                    for dep in self.deployments:
+                        try:
+                            self._dyn_handle(dep)._refresh_table(force=True)
+                        except Exception:
+                            pass
+                    time.sleep(0.2)
+                except Exception as exc:
+                    e._set_exc(exc)
+                    return
+
+        for e in entries:
+            self._dyn_pool.submit(run, e)
+
+    # ------------------------------------------------------------ control
+    def targets(self) -> List[tuple]:
+        with self._lock:
+            return [(d, t) for d, t, _h in self._targets]
+
+    def is_compiled(self) -> bool:
+        with self._lock:
+            return not self._broken
+
+    def wait_compiled(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.is_compiled():
+                return True
+            time.sleep(0.1)
+        return False
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        if self._death_cb is not None:
+            try:
+                from ray_tpu.core.api import _global_client
+
+                _global_client().unsubscribe_channel("actor_state",
+                                                     self._death_cb)
+            except Exception:
+                pass
+        with self._lock:
+            cdags = self._cdags
+            self._cdags = []
+            pendqs = self._pendqs
+            self._pendqs = []
+            self._broken = True
+        # fail over anything still queued/in flight before teardown
+        leftovers: List[ChainResponse] = []
+        while True:
+            try:
+                leftovers.append(self._subq.get_nowait())
+            except queue.Empty:
+                break
+        pend = []
+        for pq in pendqs:
+            while True:
+                try:
+                    pend.append(pq.get_nowait())
+                except queue.Empty:
+                    break
+        for _gen, ref, entries in pend:
+            try:
+                results = ref.get(timeout=5.0)
+                self._deliver(entries, results, _gen)
+            except Exception:
+                leftovers.extend(e for e in entries if not e.done())
+        if leftovers:
+            self._dynamic_submit(leftovers)
+        for cd in cdags:
+            try:
+                cd.teardown()
+            except Exception:
+                pass
+        # the writer may have been blocked inside execute() and a drainer
+        # inside ref.get(); teardown woke them, and their exit/failover
+        # paths submit through the dynamic pool — join them ALL before
+        # closing the pool so no caller's entry is stranded by a
+        # submit-after-shutdown
+        for t in self._threads:
+            t.join(timeout=15)
+        if self._dyn_pool is not None:
+            self._dyn_pool.shutdown(wait=True)

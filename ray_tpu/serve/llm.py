@@ -788,6 +788,7 @@ class LLMServer:
             self.prefix_store = _ps.store_for_engine(self.engine)
         self._prefix_pool = None
         self._prefix_pool_lock = threading.Lock()
+        self._chain_pool = None
         import uuid
 
         # distinguishes replicas when a caller aggregates stats() rows
@@ -868,6 +869,32 @@ class LLMServer:
                          "finish_reason": "length"}],
             "usage": {"completion_tokens": len(out["token_ids"])},
         }
+
+    def batch_call(self, requests: list) -> list:
+        """Compiled-chain batch entry: admit every request of a ring
+        entry to the engine CONCURRENTLY, so the continuous-batching
+        scheduler joins them into shared steps — a sequential map here
+        would silently serialize the engine and forfeit batching on the
+        compiled path. Per-item failures come back as chain error
+        markers (user errors never fail batch neighbours)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu.serve.compiled_chain import CHAIN_ERR
+
+        if self._chain_pool is None:
+            with self._prefix_pool_lock:
+                if self._chain_pool is None:
+                    self._chain_pool = ThreadPoolExecutor(
+                        max_workers=max(8, self.engine.max_batch),
+                        thread_name_prefix="chain-batch")
+        futs = [self._chain_pool.submit(self, r) for r in requests]
+        out = []
+        for f in futs:
+            try:
+                out.append(f.result())
+            except Exception as e:
+                out.append({CHAIN_ERR: repr(e), "infra": False})
+        return out
 
     def stream_next(self, stream_id: str, cursor: int = 0) -> dict:
         """Incremental tokens for an SSE stream (proxy-driven pull)."""

@@ -127,7 +127,46 @@ class PrefixStoreClient:
         self.fetch_errors = 0
         self.bytes_fetched = 0
         self.published = 0
+        self.reannounced = 0
         self.hits_by_tenant: Dict[str, int] = {}
+        # head-restart resilience (the pool_reconcile pattern): the head
+        # rebuilds prefix bindings from publisher truth — on reconnect
+        # this client re-pushes announce rows for every live pin, so
+        # bindings survive a head restart instead of waiting for the
+        # next fresh export per prefix. Registered here AND retried at
+        # publish time (a store built before ray_tpu.init would
+        # otherwise never arm the hook).
+        self._reconnect_cb = None
+        self._ensure_reconnect_hook(_client())
+
+    def _ensure_reconnect_hook(self, client) -> None:
+        """Idempotently arm the reconnect re-announce hook once a core
+        client exists. WeakMethod: the client must not keep an evicted
+        store alive; a fired hook whose store died self-unregisters."""
+        if client is None or self._reconnect_cb is not None:
+            return
+        import weakref
+
+        ref = weakref.WeakMethod(self.reannounce_pins)
+
+        def _on_reconnect(_ref=ref, _client=client):
+            m = _ref()
+            if m is None:
+                # store was GC'd: self-unregister so a long-lived
+                # process recreating engines doesn't accumulate dead
+                # closures on the shared client
+                try:
+                    _client.remove_reconnect_callback(_on_reconnect)
+                except Exception:
+                    pass
+                return
+            m()
+
+        try:
+            client.add_reconnect_callback(_on_reconnect)
+            self._reconnect_cb = _on_reconnect
+        except Exception:
+            self._reconnect_cb = None
 
     # ------------------------------------------------------------- publish
     def _bound_in_directory(self, phash: bytes, client) -> bool:
@@ -159,6 +198,7 @@ class PrefixStoreClient:
         client = _client()
         if client is None:
             return False
+        self._ensure_reconnect_hook(client)
         chain = chain_hashes(list(blob["ids"]), self.block_size)
         if not chain:
             return False
@@ -240,6 +280,32 @@ class PrefixStoreClient:
         if exporter is None:
             exporter = lambda i: export_prefix(kv, i)  # noqa: E731
         return self.publish(exporter(list(ids)))
+
+    def reannounce_pins(self) -> int:
+        """Re-push announce rows for every pinned publication (fired by
+        the client's reconnect hook). The restarted head lost its prefix
+        index; its objects come back through pool_reconcile, and these
+        pushes rebind their content hashes — same source-of-truth
+        inversion, zero new RPC channels. Idempotent head-side (a
+        binding that already exists is overwritten with itself)."""
+        client = _client()
+        if client is None:
+            return 0
+        with self._lock:
+            pins = [(ref, list(rows)) for ref, rows in self._pins.values()]
+        n = 0
+        for ref, rows in pins:
+            try:
+                client.head_push(
+                    "announce_prefix", model_key=self.model_key,
+                    oid=ref.id.binary(), block_size=self.block_size,
+                    rows=rows)
+                n += 1
+            except Exception:
+                pass
+        with self._lock:
+            self.reannounced += n
+        return n
 
     # -------------------------------------------------------------- lookup
     def lookup(self, ids: List[int], tenant: str = "base",
@@ -331,6 +397,7 @@ class PrefixStoreClient:
                     "block_size": self.block_size,
                     "pinned": len(self._pins),
                     "published": self.published,
+                    "reannounced": self.reannounced,
                     "store_hits": self.hits,
                     "store_misses": self.misses,
                     "store_fetches": self.fetches,

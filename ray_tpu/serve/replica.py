@@ -152,6 +152,83 @@ class ReplicaActor:
         except Exception:
             pass
 
+    # -------------------------------------------------------- compiled chain
+    def handle_chain(self, batch: list) -> list:
+        """Compiled-chain entry (serve/compiled_chain.py): one ring entry
+        carries a LIST of request values. Per-item failures come back as
+        error markers — one bad request must not fail its batch
+        neighbours, and an infra failure (draining replica) marks every
+        item failover-eligible instead of raising out of the exec loop
+        (which would wedge the chain until the driver's read times out).
+        A callable exposing `batch_call` (LLMEngine servers) gets the
+        whole entry at once so continuous batching applies across it."""
+        from ray_tpu.serve.compiled_chain import CHAIN_ERR, infra_error
+
+        if self._draining:
+            return [infra_error(f"replica {self.replica_tag} is draining")
+                    for _ in batch]
+        n = len(batch)
+        with self._ongoing_lock:
+            self._ongoing += n
+            self._total += n
+            self._executing += n
+        t0 = time.perf_counter()
+        try:
+            # error markers from an UPSTREAM stage pass through untouched
+            # — feeding one into this stage's callable would either
+            # swallow an infra failure or re-wrap it as a user error,
+            # breaking the failover contract on multi-stage chains
+            from ray_tpu.serve.compiled_chain import is_chain_error
+
+            live = [(i, v) for i, v in enumerate(batch)
+                    if not is_chain_error(v)]
+            out = list(batch)
+            bc = getattr(self.callable, "batch_call", None)
+            if bc is not None:
+                try:
+                    results = bc([v for _i, v in live])
+                    if not isinstance(results, list) \
+                            or len(results) != len(live):
+                        # a short/odd return must not silently leave
+                        # request values in the output positions (they
+                        # would be delivered to callers as results)
+                        raise RuntimeError(
+                            f"batch_call returned "
+                            f"{len(results) if isinstance(results, list) else type(results)} "
+                            f"for {len(live)} inputs")
+                except Exception:
+                    results = [infra_error(traceback.format_exc())
+                               for _ in live]
+                for (i, _v), r in zip(live, results):
+                    out[i] = r
+            else:
+                for i, v in live:
+                    try:
+                        # __init__ already resolved self.callable to an
+                        # instance or function; a non-callable raises
+                        # into the per-item error marker
+                        out[i] = self.callable(v)
+                    except Exception as e:  # user error: this item only
+                        out[i] = {CHAIN_ERR: repr(e), "infra": False}
+            return out
+        finally:
+            dur = time.perf_counter() - t0
+            with self._ongoing_lock:
+                self._ongoing -= n
+                self._executing -= n
+                self._latency_samples += 1
+                per = dur / max(1, n)
+                self._ewma_latency_s = (
+                    per if self._latency_samples == 1
+                    else 0.9 * self._ewma_latency_s + 0.1 * per)
+            # rate-limited: the compiled hot path must not turn load
+            # publishing into per-entry overhead; the gossiped row stays
+            # fresh at the metrics-push cadence
+            now = time.monotonic()
+            if now - getattr(self, "_chain_pub_ts", 0.0) > 1.0:
+                self._chain_pub_ts = now
+                self._publish_load(dur)
+
     def _report_models(self, model_ids):
         """Push the loaded-model set so routers prefer warm replicas."""
         try:

@@ -17,6 +17,24 @@ except ImportError:  # older jax: pre-promotion location + old kwarg names
     _LEGACY = True
 
 
+def axis_index_operand(n, dtype=None):
+    """Sharded-operand replacement for `lax.axis_index` inside
+    PARTIAL-MANUAL shard_map regions.
+
+    jax 0.4.x lowers `lax.axis_index` in a partial-manual region (some
+    mesh axes auto) to a raw `partition-id` HLO instruction, which the
+    SPMD partitioner for the remaining auto axes rejects
+    ("UNIMPLEMENTED: PartitionId instruction is not supported for SPMD
+    partitioning"). Passing `axis_index_operand(n)` into the shard_map
+    with `in_specs=P(axis)` gives each shard a length-1 slice whose
+    single element IS its index along that axis — same value, no
+    partition-id in the lowering, identical on newer jax. Read it inside
+    the region as `ids[0]`."""
+    import jax.numpy as jnp
+
+    return jnp.arange(n, dtype=dtype or jnp.int32)
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
     if _LEGACY:
         if "check_vma" in kwargs:
@@ -30,4 +48,4 @@ def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
                              out_specs=out_specs, **kwargs)
 
 
-__all__ = ["shard_map"]
+__all__ = ["axis_index_operand", "shard_map"]

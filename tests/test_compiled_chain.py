@@ -1,0 +1,169 @@
+"""Compiled serve replica chain (ISSUE 14): pre-negotiated channel
+edges between serve replicas, zero control-plane RPCs per warm request,
+epoch-fenced recompile on replica death with dynamic-handle failover —
+never a 500 for infrastructure reasons.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import protocol
+from ray_tpu.core.native_store import native_available
+from ray_tpu.serve.compiled_chain import CompiledServeChain
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+    yield info
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+class _Pre:
+    def __call__(self, v):
+        return {**v, "x": v["x"] + 1}
+
+
+class _Main:
+    def __call__(self, v):
+        if v.get("boom"):
+            raise ValueError("user boom")
+        return {"y": v["x"] * 10}
+
+    def pid(self, _=None):
+        return os.getpid()
+
+
+def _deploy(tag: str):
+    serve.run(serve.deployment(_Pre, name=f"pre-{tag}").bind(),
+              name=f"pre-{tag}")
+    serve.run(serve.deployment(_Main, name=f"main-{tag}").bind(),
+              name=f"main-{tag}")
+    return [f"pre-{tag}", f"main-{tag}"]
+
+
+def test_chain_correctness_and_user_error_isolation(cluster):
+    """Values flow stage to stage through the rings; a user error fails
+    ONLY its own request (error marker, not a chain failure), and the
+    chain stays compiled."""
+    deps = _deploy("basic")
+    chain = CompiledServeChain(deps, lanes=2, max_inflight=2,
+                               batch_max=4).start()
+    try:
+        assert chain.call({"x": 1}, timeout=30) == {"y": 20}
+        # concurrent burst: batching + lane pipelining, all in order
+        resps = [chain.submit({"x": i}) for i in range(20)]
+        assert [r.result(30) for r in resps] == \
+            [{"y": (i + 1) * 10} for i in range(20)]
+        # user error isolated to its own future
+        bad = chain.submit({"x": 1, "boom": True})
+        good = chain.submit({"x": 2})
+        assert good.result(30) == {"y": 30}
+        with pytest.raises(RuntimeError, match="user boom"):
+            bad.result(30)
+        assert chain.is_compiled()
+        assert chain.stats["fenced"] == 0
+        assert chain.stats["dynamic_fallback"] == 0
+    finally:
+        chain.shutdown()
+        for d in deps:
+            serve.delete(d)
+
+
+def test_chain_warm_path_makes_zero_head_rpcs(cluster):
+    """The compiled contract (SURVEY §3.7): a warm request is shm ring
+    writes + condvar wakes — ZERO head round trips, proven through the
+    RPC interposition hook. Only background telemetry pushes are
+    permitted."""
+    deps = _deploy("rpc")
+    chain = CompiledServeChain(deps, lanes=2, max_inflight=2,
+                               batch_max=4).start()
+    try:
+        for i in range(5):   # warm every lane + both replicas
+            assert chain.call({"x": i}, timeout=30) == {"y": (i + 1) * 10}
+        time.sleep(0.3)      # let registration stragglers flush
+
+        events = []
+
+        def hook(conn_name, kind, method):
+            if conn_name == "head":
+                events.append((kind, method))
+
+        protocol.add_rpc_interposer(hook)
+        try:
+            resps = [chain.submit({"x": i}) for i in range(25)]
+            out = [r.result(30) for r in resps]
+        finally:
+            protocol.remove_rpc_interposer(hook)
+        assert out == [{"y": (i + 1) * 10} for i in range(25)]
+        reqs = [m for k, m in events if k == "req"]
+        assert not reqs, f"warm compiled path made head round trips: {reqs}"
+        pushes = {m for k, m in events if k == "push"}
+        assert pushes <= {"ref_update", "metrics_push"}, \
+            f"warm compiled path pushed more than telemetry: {pushes}"
+        assert chain.stats["dynamic_fallback"] == 0
+    finally:
+        chain.shutdown()
+        for d in deps:
+            serve.delete(d)
+
+
+@pytest.mark.chaos
+def test_chain_actor_sigkill_mid_step_recompiles(cluster):
+    """Chaos drill (ISSUE 14): SIGKILL a compiled-chain replica's worker
+    process mid-burst. Acceptance: the generation fences, in-flight ring
+    entries drain or fail over to the dynamic handle path, ZERO non-shed
+    request failures, and the chain recompiles over the controller's
+    replacement replica and serves compiled traffic again."""
+    deps = _deploy("chaos")
+    chain = CompiledServeChain(deps, lanes=2, max_inflight=2, batch_max=4,
+                               entry_timeout_s=30,
+                               recompile_timeout_s=90).start()
+    try:
+        assert chain.call({"x": 1}, timeout=30) == {"y": 20}
+        victim_tag = dict(chain.targets())[deps[1]]
+        victim_pid = serve.get_deployment_handle(deps[1]).options(
+            method_name="pid").remote({}).result(timeout=30)
+        gen0 = chain.generation
+
+        # burst across the kill: SIGKILL (not graceful) mid-step
+        resps = [chain.submit({"x": i}) for i in range(8)]
+        os.kill(victim_pid, signal.SIGKILL)
+        resps += [chain.submit({"x": i}) for i in range(8, 24)]
+        vals = [r.result(120) for r in resps]
+        assert vals == [{"y": (i + 1) * 10} for i in range(24)], \
+            "request failed across the replica kill"
+        assert chain.stats["fenced"] >= 1
+        assert chain.stats["dynamic_fallback"] >= 1
+
+        # epoch-fenced recompile lands on the REPLACEMENT replica
+        assert chain.wait_compiled(90), "chain never recompiled"
+        assert chain.generation > gen0
+        new_tag = dict(chain.targets())[deps[1]]
+        assert new_tag != victim_tag, (new_tag, victim_tag)
+
+        # compiled traffic resumes (not just the dynamic fallback);
+        # allow the in-flight dynamic failovers to finish draining first
+        deadline = time.time() + 30
+        while (time.time() < deadline
+               and not (chain.is_compiled() and chain._subq.empty())):
+            time.sleep(0.2)
+        before = chain.stats["compiled"]
+        resps = [chain.submit({"x": i}) for i in range(8)]
+        assert [r.result(60) for r in resps] == \
+            [{"y": (i + 1) * 10} for i in range(8)]
+        assert chain.stats["compiled"] > before, \
+            (chain.stats, chain.events)
+    finally:
+        chain.shutdown()
+        for d in deps:
+            serve.delete(d)

@@ -7,6 +7,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu.dag import Channel, ChannelClosedError, InputNode, MultiOutputNode
+from ray_tpu.dag.channel import ChannelError
 from ray_tpu.core.native_store import native_available
 
 pytestmark = pytest.mark.skipif(not native_available(),
@@ -70,6 +71,101 @@ def test_channel_close_unblocks_reader():
     ch.close(unlink=True)
     t.join(timeout=5)
     assert errs
+
+
+# ------------------------------------------------------------ ring channels
+def test_ring_channel_wrap_around():
+    """An N-slot ring delivers every value in order across many wraps,
+    and attach() recovers capacity/num_readers/num_slots from the shm
+    header."""
+    ch = Channel(capacity=1 << 16, num_readers=1, num_slots=3)
+    try:
+        r = Channel.attach(ch.name)
+        assert (r.capacity, r.num_readers, r.num_slots) == (1 << 16, 1, 3)
+        # writer runs num_slots ahead without any reader progress
+        for i in range(3):
+            ch.write(i, timeout=5)
+        for i in range(3):
+            assert r.read(timeout=5) == i
+        # dozens of wraps, strictly in order
+        for i in range(50):
+            ch.write(("v", i), timeout=5)
+            assert r.read(timeout=5) == ("v", i)
+    finally:
+        ch.close(unlink=True)
+
+
+def test_ring_slow_reader_backpressure():
+    """The writer blocks only when the ring is full across ALL reader
+    cursors — num_slots values deep, not one."""
+    ch = Channel(capacity=1 << 16, num_readers=1, num_slots=2)
+    try:
+        r = Channel.attach(ch.name)
+        ch.write("a", timeout=5)
+        ch.write("b", timeout=5)   # second slot: no reader progress needed
+        with pytest.raises(TimeoutError):
+            ch.write("c", timeout=0.2)   # ring full -> backpressure
+        assert r.read(timeout=5) == "a"
+        ch.write("c", timeout=5)         # freed slot accepts the write
+        assert r.read(timeout=5) == "b"
+        assert r.read(timeout=5) == "c"
+    finally:
+        ch.close(unlink=True)
+
+
+def test_ring_reader_cursor_isolation():
+    """Two readers advance independent cursors; the writer is gated by
+    the SLOWEST one, and each reader sees every value exactly once."""
+    ch = Channel(capacity=1 << 16, num_readers=2, num_slots=2)
+    try:
+        fast, slow = Channel.attach(ch.name), Channel.attach(ch.name)
+        ch.write("x", timeout=5)
+        ch.write("y", timeout=5)
+        assert fast.read(timeout=5) == "x"
+        assert fast.read(timeout=5) == "y"
+        # slow reader still holds slot "x": the ring is full for it
+        with pytest.raises(TimeoutError):
+            ch.write("z", timeout=0.2)
+        assert slow.read(timeout=5) == "x"
+        ch.write("z", timeout=5)
+        assert slow.read(timeout=5) == "y"
+        assert slow.read(timeout=5) == "z"
+        assert fast.read(timeout=5) == "z"
+    finally:
+        ch.close(unlink=True)
+
+
+def test_ring_drains_after_close():
+    """Values still in the ring DRAIN after close(); only then does the
+    reader observe ChannelClosedError — in-flight entries are never
+    silently dropped at teardown."""
+    ch = Channel(capacity=1 << 16, num_readers=1, num_slots=4)
+    r = Channel.attach(ch.name)
+    for i in range(3):
+        ch.write(i, timeout=5)
+    ch.close()
+    assert [r.read(timeout=5) for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(ChannelClosedError):
+        r.read(timeout=5)
+    r.close(unlink=True)
+
+
+def test_attached_channel_reserializes_with_true_counts():
+    """__reduce__ of an ATTACHED handle keeps the creator's reader count
+    and ring depth (read from the shm header) — a handle that traveled
+    twice still enforces honest capacity checks."""
+    import pickle
+
+    ch = Channel(capacity=1 << 12, num_readers=3, num_slots=2)
+    try:
+        hop1 = pickle.loads(pickle.dumps(ch))
+        hop2 = pickle.loads(pickle.dumps(hop1))
+        for h in (hop1, hop2):
+            assert (h.capacity, h.num_readers, h.num_slots) == (1 << 12, 3, 2)
+        with pytest.raises(ChannelError):
+            hop2.write(b"x" * (1 << 13))   # over capacity: still rejected
+    finally:
+        ch.close(unlink=True)
 
 
 # -------------------------------------------------------------- eager DAGs
@@ -188,6 +284,35 @@ def test_compiled_throughput_beats_actor_calls(cluster):
         cdag.teardown(kill_actors=True)
     assert compiled_dt < actor_call_dt, (
         f"compiled {compiled_dt:.4f}s not faster than RPC {actor_call_dt:.4f}s")
+
+
+def test_compiled_max_inflight_pipelines(cluster):
+    """max_inflight ring depth: the driver submits several iterations
+    WITHOUT blocking on the slow stage — the input ring absorbs them —
+    and every result still arrives in order. With single-slot channels
+    the second execute() would block for a full stage latency."""
+
+    @ray_tpu.remote
+    class Slow:
+        def fwd(self, x):
+            time.sleep(0.25)
+            return x + 1
+
+    s = Slow.remote()
+    with InputNode() as inp:
+        dag = s.fwd.bind(inp)
+    cdag = dag.experimental_compile(max_inflight=4)
+    try:
+        cdag.execute(0).get(timeout=60)   # warm the loop
+        t0 = time.perf_counter()
+        refs = [cdag.execute(i) for i in range(1, 4)]
+        submit_dt = time.perf_counter() - t0
+        # 3 submits against a 0.25s stage: pipelined submission must not
+        # serialize on stage latency (generous bound for slow CI hosts)
+        assert submit_dt < 0.25, f"submits serialized: {submit_dt:.3f}s"
+        assert [r.get(timeout=60) for r in refs] == [2, 3, 4]
+    finally:
+        cdag.teardown(kill_actors=True)
 
 
 def test_compiled_dag_device_channel(cluster):

@@ -139,6 +139,43 @@ def test_cross_node_fan_in(two_node_cluster):
         cdag.teardown(kill_actors=True)
 
 
+@pytest.mark.chaos
+def test_cross_node_edge_survives_chaos_delay(two_node_cluster):
+    """A seeded chaos delay plan on the remote-reader edge
+    (`dag_chan_read` RPCs) stretches hops but never corrupts them: the
+    compiled pipeline keeps producing correct, in-order results, and
+    the ring keeps iterations pipelined across the delayed edge."""
+    from ray_tpu.core import protocol
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote(resources={"stage1": 1})
+    class A:
+        def fwd(self, x):
+            return x * 3
+
+    @ray_tpu.remote(resources={"stage2": 1})
+    class B:
+        def fwd(self, x):
+            return x + 7
+
+    a, b = A.remote(), B.remote()
+    with InputNode() as inp:
+        dag = b.fwd.bind(a.fwd.bind(inp))
+    cdag = dag.experimental_compile(max_inflight=4)
+    try:
+        assert cdag.execute(0).get(timeout=60) == 7   # warm
+        protocol.configure_chaos(
+            "seed=11,delay:dag_chan_read@*:p=0.5:t=0.05")
+        try:
+            refs = [cdag.execute(i) for i in range(1, 9)]
+            got = [r.get(timeout=120) for r in refs]
+        finally:
+            protocol.configure_chaos("")
+        assert got == [i * 3 + 7 for i in range(1, 9)]
+    finally:
+        cdag.teardown(kill_actors=True)
+
+
 def test_cross_node_device_tensor_pipeline(two_node_cluster):
     """The PP-over-DCN story end-to-end: a 2-stage pipeline on DIFFERENT
     nodes whose inter-stage edge carries DEVICE tensors — the shm/RPC

@@ -493,3 +493,129 @@ def test_prefix_owner_death_degrades_to_local_prefill():
             os.environ.pop("RAY_TPU_STORE_ISOLATION", None)
         else:
             os.environ["RAY_TPU_STORE_ISOLATION"] = saved
+
+
+@pytest.mark.chaos
+def test_prefix_bindings_survive_head_restart_via_reannounce():
+    """ISSUE-14 satellite (PR-13 known limit closed): publishers re-push
+    their pin tables on head reconnect — the `pool_reconcile` pattern
+    applied to prefix bindings. A restarted head re-learns every live
+    binding from publisher truth instead of waiting for the next fresh
+    export per prefix."""
+    import os
+
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.serve.prefix_store import PrefixStoreClient
+
+    _ = PrefixStoreClient   # publisher lives in the actor below
+    serve.shutdown()
+    ray_tpu.shutdown()
+    saved = os.environ.get("RAY_TPU_STORE_ISOLATION")
+    os.environ["RAY_TPU_STORE_ISOLATION"] = "1"
+    cluster = Cluster(num_cpus=0, enable_snapshots=True)
+    cluster.add_node(num_cpus=2, resources={"pub_pool": 4})
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(2)
+        client = ray_tpu.core.api._global_client()
+        model_key = "restart-test|L4H2D16|float32|bs8"
+        ids = [(i * 13) % 400 + 1 for i in range(32)]   # 4 block boundaries
+
+        # the publisher is a replica-like actor on a DAEMON node: after
+        # a head restart its blob re-advertises through pool_reconcile
+        # (daemon truth) and its pin table re-announces through the
+        # client reconnect hook (publisher truth) — both must land for a
+        # residency-checked lookup to hit again
+        @ray_tpu.remote(resources={"pub_pool": 1})
+        class Publisher:
+            def __init__(self):
+                self.store = None
+
+            def publish(self, model_key, ids):
+                import numpy as np
+
+                from ray_tpu.serve.prefix_store import PrefixStoreClient
+
+                self.store = PrefixStoreClient(model_key, block_size=8)
+                blob = {"ids": list(ids),
+                        "k": np.zeros((4, 32, 2, 8, 16), np.float32),
+                        "v": np.zeros((4, 32, 2, 8, 16), np.float32)}
+                return self.store.publish(blob)
+
+            def reannounced(self):
+                return self.store.reannounced
+
+        pub = Publisher.remote()
+        assert ray_tpu.get(pub.publish.remote(model_key, ids),
+                           timeout=120), "publication failed"
+        chain = chain_hashes(ids, 8)
+
+        def bound() -> bool:
+            try:
+                return client.object_dir.longest_prefix(
+                    model_key, chain) is not None
+            except Exception:
+                return False
+
+        deadline = time.time() + 30
+        while time.time() < deadline and not bound():
+            time.sleep(0.2)
+        assert bound(), "binding never reached the gossiped directory"
+
+        cluster.kill_head()
+        cluster.restart_head(restore=True)
+
+        # the restored snapshot has object metas but NO prefix index —
+        # only the publisher's reconnect re-announce can rebind. Wait
+        # for the worker to ride the restart and fire the hook (actor
+        # calls fail over while its lease re-establishes).
+        deadline = time.time() + 90
+        reann = 0
+        while time.time() < deadline and reann < 1:
+            try:
+                reann = ray_tpu.get(pub.reannounced.remote(), timeout=30)
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert reann >= 1, "reconnect hook never re-announced"
+
+        # head-side proof (not the driver's retained cache): a FRESH
+        # consumer registering AFTER the restart gets the binding in its
+        # directory sync, residency-checked against the re-advertised
+        # blob
+        @ray_tpu.remote(resources={"pub_pool": 1})
+        class Consumer:
+            def probe(self, model_key, ids):
+                from ray_tpu.core.api import _global_client
+                from ray_tpu.serve.kv_cache import chain_hashes as ch
+
+                d = _global_client().object_dir
+                hit = d.longest_prefix(model_key, ch(list(ids), 8))
+                return None if hit is None else hit["n"]
+
+        consumer = Consumer.remote()
+        depth = None
+        deadline = time.time() + 60
+        while time.time() < deadline and depth is None:
+            try:
+                depth = ray_tpu.get(consumer.probe.remote(model_key, ids),
+                                    timeout=30)
+            except Exception:
+                pass
+            if depth is None:
+                time.sleep(0.5)
+        assert depth == 32, \
+            f"fresh consumer resolves depth {depth}, want full prefix"
+        assert bound(), "binding did not survive the head restart"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        if saved is None:
+            os.environ.pop("RAY_TPU_STORE_ISOLATION", None)
+        else:
+            os.environ["RAY_TPU_STORE_ISOLATION"] = saved

@@ -31,6 +31,10 @@ def test_soak_single_command(tmp_path):
     assert report["large_object"]["mb_per_s"] > 0
     assert report["serve"]["failed"] == 0
     assert report["serve"]["served"] > 0
+    assert report["compiled_chain"]["failed"] == 0
+    assert report["compiled_chain"]["served"] > 0
+    assert report["compiled_chain"]["fenced"] >= 1
+    assert report["compiled_chain"]["recompiles"] >= 2
     assert report["elastic_train"]["final_world_size"] == 1
     assert report["elastic_train"]["restarts"] >= 1
     assert report["elastic_train"]["recovery_s"] > 0
