@@ -57,6 +57,8 @@ SUITES = {
                 "runner": "control_plane"},
     "data": {"baseline": "data_plane_microbench.json",
              "runner": "data_plane"},
+    "data-pipeline": {"baseline": "data_pipeline_microbench.json",
+                      "runner": "data_pipeline_plane"},
     "serve": {"baseline": "serve_microbench.json",
               "runner": "serve_plane"},
     "collective": {"baseline": "collective_microbench.json",

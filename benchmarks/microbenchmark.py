@@ -567,6 +567,143 @@ def data_plane(out_path: str | None = None) -> dict:
     return report
 
 
+def run_shuffle_kill_drill(seed: int = 0, P: int = 4,
+                           n_blocks: int = 4) -> dict:
+    """Shared kill-a-shuffle-node drill (the `run_elastic_drill`
+    pattern): an isolation-mode cluster lands every map sub-block on one
+    node, that node is SIGKILLed before reduce consumes them, and the
+    shuffle must complete byte-identical through lineage reconstruction
+    on a replacement node. Used by the `--data-pipeline` bench row
+    (`shuffle_recovery_s`) and soak.py's shuffle phase — one drill body,
+    two reporters."""
+    import numpy as np
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.data import shuffle as shf
+
+    saved = os.environ.get("RAY_TPU_STORE_ISOLATION")
+    os.environ["RAY_TPU_STORE_ISOLATION"] = "1"
+    cluster = Cluster(num_cpus=0)
+    node_a = cluster.add_node(num_cpus=2, resources={"nodeA": 4})
+    cluster.add_node(num_cpus=2, resources={"nodeB": 4})
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+        rng = np.random.default_rng(seed)
+        blocks = [{"k": np.arange(1600, dtype=np.int64) + 1600 * i,
+                   "x": rng.random((1600, 64))} for i in range(n_blocks)]
+        parts = [shf._map_partition(b, [], P, "hash", "k", None, None)
+                 for b in blocks]
+        expected = [shf._reduce_concat(*[pp[p] for pp in parts])
+                    for p in range(P)]
+        map_task = ray_tpu.remote(shf._map_partition).options(
+            num_returns=P, name="data_shuffle_map", data_stage=True,
+            resources={"nodeA": 1})
+        reducer = ray_tpu.remote(shf._reduce_concat).options(
+            name="data_shuffle_reduce", lineage=True, data_stage=True,
+            resources={"nodeB": 1})
+        refs = [map_task.remote(b, [], P, "hash", "k", None, None)
+                for b in blocks]
+        flat = [r for rs in refs for r in rs]
+        ready, _ = ray_tpu.wait(flat, num_returns=len(flat), timeout=120)
+        assert len(ready) == len(flat), "map stage never completed"
+        cluster.kill_node(node_a)
+        t0 = time.perf_counter()
+        cluster.add_node(num_cpus=2, resources={"nodeA": 4})
+        out = [reducer.remote(*[refs[m][p] for m in range(n_blocks)])
+               for p in range(P)]
+        got = ray_tpu.get(out, timeout=240)
+        recovery_s = time.perf_counter() - t0
+        for g, e in zip(got, expected):
+            for col in e:
+                assert np.array_equal(np.asarray(g[col]),
+                                      np.asarray(e[col])), \
+                    f"column {col} diverged after reconstruction"
+        from ray_tpu.util import state
+
+        recon = 0
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            recon = next((row.get("data_reconstructs", 0)
+                          for row in state.list_scheduler_stats()
+                          if row.get("is_head")), 0)
+            if recon >= n_blocks * P:
+                break
+            time.sleep(0.2)
+        assert recon > 0, "no lineage reconstruction recorded"
+        return {"partitions": P, "sub_blocks_lost": n_blocks * P,
+                "sub_blocks_reconstructed": recon,
+                "recovery_s": round(recovery_s, 2)}
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        if saved is None:
+            os.environ.pop("RAY_TPU_STORE_ISOLATION", None)
+        else:
+            os.environ["RAY_TPU_STORE_ISOLATION"] = saved
+
+
+def data_pipeline_plane(out_path: str | None = None) -> dict:
+    """Streaming data-pipeline gate rows (ISSUE 15):
+
+      data_pipeline_rows_per_s — rows/s through a 3-stage streaming
+      pipeline (read → map_batches → map_batches) over the operator-graph
+      executor on a local cluster (lineage registration, dep-meta
+      shipping and eager release all ON — this is the production path);
+
+      shuffle_recovery_s — SIGKILL the node holding every map sub-block
+      of a distributed shuffle after the map stage lands, then measure
+      kill → reduce completion: covers node-death detection, lazy lineage
+      reconstruction of exactly the lost partitions, and the P2P re-pull.
+      Seconds, lower is better.
+    """
+    import numpy as np
+    import ray_tpu
+    from ray_tpu import data as rdata
+
+    results = {}
+
+    phase("data_pipeline_rows_per_s")
+    ray_tpu.init(num_cpus=4, max_workers=6)
+    try:
+        def run_once(n):
+            ds = (rdata.range(n, parallelism=8)
+                  .map_batches(lambda b: {"id": b["id"],
+                                          "x": b["id"].astype(np.float64)})
+                  .map_batches(lambda b: {"id": b["id"],
+                                          "x": b["x"] * 2.0}))
+            t0 = time.perf_counter()
+            rows = ds.count()
+            dt = time.perf_counter() - t0
+            assert rows == n
+            return n / dt
+
+        run_once(20_000)   # warm leases + fn exports
+        results["data_pipeline_rows_per_s"] = float(np.median(
+            [run_once(200_000) for _ in range(3)]))
+    finally:
+        ray_tpu.shutdown()
+
+    phase("shuffle_recovery_s")
+    results["shuffle_recovery_s"] = run_shuffle_kill_drill(
+        seed=0)["recovery_s"]
+
+    report = {"metrics": {k: round(v, 2) for k, v in results.items()},
+              "unit": "data_pipeline_rows_per_s: rows/s (higher better); "
+                      "shuffle_recovery_s: seconds kill -> reduce "
+                      "completion (lower better)",
+              "host": {"cpus": os.cpu_count()}}
+    print(json.dumps(report, indent=2))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    return report
+
+
 def _drive_handle(handle, bodies, concurrency: int = 8,
                   timeout: float = 180.0):
     """Drive `bodies` through a DeploymentHandle from `concurrency`
@@ -1495,6 +1632,10 @@ if __name__ == "__main__":
                    help="run only the peer-to-peer data-plane gate rows "
                         "(p2p_pull_mb_s, head_restart_large_object_"
                         "recovery_s) and emit the regression artifact")
+    p.add_argument("--data-pipeline", action="store_true",
+                   help="run only the streaming data-pipeline gate rows "
+                        "(data_pipeline_rows_per_s, shuffle_recovery_s) "
+                        "and emit the regression artifact")
     p.add_argument("--train-ft", action="store_true",
                    help="run only the elastic-train recovery drill and "
                         "print its recovery time")
@@ -1515,6 +1656,8 @@ if __name__ == "__main__":
         dag_plane(args.out)
     elif args.serve:
         serve_plane(args.out)
+    elif args.data_pipeline:
+        data_pipeline_plane(args.out)
     elif args.data_plane:
         data_plane(args.out)
     elif args.train_ft:

@@ -21,6 +21,13 @@ FIXED seed, so a failure replays identically:
   round exercises the daemon pull manager's chunk retry + the gossiped
   object directory under injected faults, bit-exactness asserted.
 
+  phase 2b — shuffle node kill: a distributed hash shuffle lands every
+  map sub-block on one isolated node, which is SIGKILLed before the
+  reduce stage consumes them; lineage reconstruction must re-run exactly
+  the lost map tasks on a replacement node, the reduce output must be
+  byte-identical to the in-process reference, and
+  data_blocks_reconstructed_total must count the rebuilt sub-blocks.
+
   phase 3 — serve plane: an autoscaled deployment behind the HTTP proxy
   takes sustained multi-client load; mid-load a replica arms a seeded
   `kill:*:n=1` chaos plan in its own process and SIGKILLs itself on its
@@ -462,6 +469,19 @@ def compiled_chain_soak(seed: int, duration_s: float = 8.0,
             "chaos": f"seed={seed},kill:*:n=1 (replica self-kill)"}
 
 
+def shuffle_kill_soak(seed: int, P: int = 4) -> dict:
+    """Kill-a-shuffle-node phase (ISSUE 15): a distributed hash shuffle
+    lands its map sub-blocks on one isolated node; that node is
+    SIGKILLed before the reduce stage consumes them. Lineage
+    reconstruction re-runs exactly the lost map tasks on a replacement
+    node and the reduce output must be byte-identical to the in-process
+    reference. One drill body, shared with the `shuffle_recovery_s`
+    bench row (the `run_elastic_drill` pattern)."""
+    from microbenchmark import run_shuffle_kill_drill
+
+    return run_shuffle_kill_drill(seed=seed, P=P)
+
+
 def elastic_train_drill(seed: int, steps: int = 30) -> dict:
     """The tentpole acceptance drill as a soak phase: the shared harness
     (`microbenchmark.run_elastic_drill`), with the kill delivered by the
@@ -490,6 +510,9 @@ def main(seed: int = 7, out: str | None = None, rounds: int = 6,
     print(f"[soak] large-object data plane under chaos (seed={seed})",
           file=sys.stderr)
     report["large_object"] = large_object_soak(seed)
+    print(f"[soak] shuffle node kill mid-shuffle (seed={seed})",
+          file=sys.stderr)
+    report["shuffle_kill"] = shuffle_kill_soak(seed)
     print(f"[soak] serve plane under replica chaos kill (seed={seed})",
           file=sys.stderr)
     report["serve"] = serve_soak(seed)

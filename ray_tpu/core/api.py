@@ -254,6 +254,13 @@ class RemoteFunction:
                      "label_selector": opts.get("label_selector"),
                      "scheduling_strategy": opts.get("scheduling_strategy", "hybrid"),
                      "name": opts.get("name") or getattr(self._fn, "__name__", "task")}
+        for k in ("lineage", "data_stage"):
+            # lineage: lease-path dispatches ALSO register the spec in the
+            # head's lineage ledger (reconstructable on node loss);
+            # data_stage: counts reconstructions into
+            # data_blocks_reconstructed_total. Set by the data library.
+            if opts.get(k):
+                task_opts[k] = True
         with tracing.submit_span(task_opts["name"]):
             # inject INSIDE the span so the worker's execution span parents
             # to the submission span, not to its parent

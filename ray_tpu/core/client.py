@@ -1197,6 +1197,76 @@ class CoreClient:
         except (FileNotFoundError, OSError):
             return False
 
+    def _dep_metas(self, deps: list) -> list:
+        """Metas of a task's non-inline deps that this process already
+        holds (e.g. results of lease tasks it submitted) — shipped with
+        the spec so the executing worker skips the per-dep get_meta."""
+        from ray_tpu.core.object_directory import PULLABLE_KINDS
+
+        out = []
+        for dep in deps:
+            m = self.local_metas.get(ObjectID(dep))
+            if m is not None and m.kind in PULLABLE_KINDS and not m.error:
+                out.append(m)
+        return out
+
+    def lease_data_addr(self, fn_key: bytes, options: dict):
+        """Data-server address of the node the current lease for this
+        task shape lives on, or None — the push-side prefetch target for
+        a pipeline stage's pending inputs. Resolved entirely from cache:
+        the lease's granting-daemon sched address matched against the
+        gossiped view entries."""
+        shape = self._lease_shape(fn_key, options)
+        with self._lease_lock:
+            lease = self._leases.get(shape)
+            via = None if lease is None or lease.dead else lease.via
+        if via is None:
+            return None
+        via = tuple(via)
+        for e in self.cluster_view.entries.values():
+            sched = e.get("sched_addr")
+            if sched is not None and tuple(sched) == via:
+                addr = e.get("data_addr")
+                return tuple(addr) if addr else None
+        return None
+
+    def prefetch_object(self, ref, addr) -> bool:
+        """Fire-and-forget: ask the data server at `addr` (the node a
+        consuming task will run on) to pull `ref`'s object into its node
+        store ahead of dispatch, so the task's dependency fetch finds the
+        bytes already local. The node PullManager's in-flight dedup
+        merges this with the real fetch if they race. Best-effort by
+        design — a lost prefetch only costs the overlap."""
+        meta = self.local_metas.get(ref.id) if hasattr(ref, "id") else ref
+        from ray_tpu.core.object_directory import PULLABLE_KINDS
+
+        if (meta is None or meta.kind not in PULLABLE_KINDS or meta.error
+                or addr is None):
+            return False
+        if meta.node_id is not None and self.cluster_view.data_addr_of(
+                meta.node_id.hex()) == tuple(addr):
+            return False  # already home: nothing to stage
+
+        async def _go():
+            key = tuple(addr)
+            try:
+                conn = self._data_conns.get(key)
+                if conn is None or conn.closed:
+                    conn = await protocol.connect(key[0], key[1],
+                                                  name=f"data-{key[1]}")
+                    self._data_conns[key] = conn
+                await asyncio.wait_for(
+                    conn.request("pull_object", meta=meta, sources=None),
+                    timeout=120 + meta.size / (4 << 20))
+            except Exception:
+                pass  # prefetch is advisory; the dispatch-time pull wins
+
+        try:
+            asyncio.run_coroutine_threadsafe(_go(), self.loop)
+        except Exception:
+            return False
+        return True
+
     def _sources_from_view(self, meta: ObjectMeta) -> list:
         """Candidate data-server addresses resolved ENTIRELY from cache:
         the gossiped object directory's locations (primary first, then
@@ -2267,6 +2337,28 @@ class CoreClient:
                 "deps": deps, "return_ids": [return_id.binary()],
                 "borrows": [(o.binary(), t) for o, t in tokens],
                 "options": options}
+        dep_metas = self._dep_metas(deps)
+        if dep_metas:
+            # ship the deps' metas with the push: the executing worker
+            # resolves each block straight through its node PullManager
+            # instead of round-tripping get_meta per dependency — the
+            # warm inter-stage handoff of a data pipeline makes zero
+            # head RPCs
+            spec["dep_metas"] = dep_metas
+        if options.get("lineage"):
+            # out-of-band lineage registration: lease-path tasks never
+            # reach the head's submit_task, so a data-stage task opts its
+            # spec into the lineage ledger with one fire-and-forget push
+            # (reconstruction re-runs it through the normal queue). The
+            # recorded spec drops the borrow tokens (the live dispatch
+            # below owns the handoff; a re-run must not re-commit them)
+            # and the shipped dep metas (the head re-attaches FRESH ones
+            # at reconstruction dispatch — recording these would pin
+            # stale locations in the ledger).
+            self.head_push(
+                "record_lineage",
+                spec={k: v for k, v in spec.items()
+                      if k != "dep_metas"} | {"borrows": []})
         if self._head_suspect():
             # headless dispatch: the granted worker may never have run
             # this function, and its KV fetch would stall on the dead/

@@ -261,6 +261,23 @@ FLAGS: List[Flag] = [
     Flag("data_memory_budget_bytes", "RAY_TPU_DATA_MEMORY_BUDGET_BYTES",
          int, 256 << 20,
          "Streaming executor in-flight byte budget (adaptive window)."),
+    Flag("data_store_highwater", "RAY_TPU_DATA_STORE_HIGHWATER", float, 0.85,
+         "Gossiped object-store pressure (used/capacity, any node) above "
+         "which the streaming executor stops admitting NEW pipeline "
+         "inputs — stages keep draining, so pressure falls instead of "
+         "OOMing the store. 0 disables the signal."),
+    Flag("data_input_retries", "RAY_TPU_DATA_INPUT_RETRIES", int, 3,
+         "Per-(stage, partition) retries of a pipeline consumer task "
+         "whose input block went lost (ObjectLostError result); each "
+         "retry rides lineage reconstruction of the lost input."),
+    Flag("data_prefetch", "RAY_TPU_DATA_PREFETCH", bool, True,
+         "Push-side prefetch: stage a completed block into the consuming "
+         "stage's node store before its task dispatches (overlaps the "
+         "pull with queue wait; the node PullManager dedups)."),
+    Flag("data_eager_release", "RAY_TPU_DATA_EAGER_RELEASE", bool, True,
+         "Release consumed intermediate blocks' lineage entries when a "
+         "partition's final output is consumed, so a long pipeline's "
+         "store footprint stays bounded by the in-flight window."),
     # -------------------------------------------------------------- train
     Flag("torch_backend", "RAY_TPU_TORCH_BACKEND", str, "gloo",
          "torch.distributed backend for TorchTrainer."),

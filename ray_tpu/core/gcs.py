@@ -362,7 +362,13 @@ class Head:
         self._anomaly_counter = None
         self.sched_totals = {"head_grants": 0, "pool_acquires": 0,
                              "pool_releases": 0, "stale_epoch_rejects": 0,
-                             "reconciles": 0}
+                             "reconciles": 0,
+                             # lineage recovery: objects re-sealed by
+                             # re-running their producing task after every
+                             # copy was lost; data_reconstructs counts the
+                             # data library's stage/shuffle blocks
+                             # (data_blocks_reconstructed_total on /metrics)
+                             "reconstructs": 0, "data_reconstructs": 0}
         # epoch fencing: a cluster epoch stamped into cluster_view and
         # every grant/carve-out; daemons and clients tag pool/lease traffic
         # with the epoch they observed, and stale-epoch operations are
@@ -911,19 +917,33 @@ class Head:
                     continue
                 self._add_holder(oid, w.worker_id)
             if spec["options"].get("num_returns") != "streaming":
-                entry = {"spec": spec, "produced": set(),
-                         "recon_left": spec["options"].get("max_retries", 3),
-                         "bytes": self._spec_bytes(spec)}
-                self._lineage_add_entry(entry)
-                for rid in spec["return_ids"]:
-                    self._lineage_pop(ObjectID(rid))
-                    self.lineage[ObjectID(rid)] = entry
-                    self.lineage_bytes += entry["bytes"]
-                while (len(self.lineage) > self.lineage_cap
-                       or self.lineage_bytes > self.lineage_bytes_cap):
-                    oldest = next(iter(self.lineage))
-                    self._lineage_pop(oldest)
+                self._lineage_record_spec(spec)
             self._enqueue(rec)
+            return True
+
+        async def record_lineage(spec):
+            """Out-of-band lineage registration for tasks dispatched
+            WITHOUT the head (the lease/peer warm path): the client ships
+            the full spec so a result lost to node death can re-run
+            through the normal queue. Opt-in per task via
+            options['lineage'] — set by the data library's stage tasks —
+            so the default warm path stays zero-head-message."""
+            if spec["options"].get("num_returns") == "streaming":
+                return False
+            self._lineage_record_spec(spec)
+            return True
+
+        async def release_lineage(return_ids):
+            """Eager lineage retirement for consumed intermediates (the
+            streaming data executor's per-partition chain release): pop
+            the entries so their input dep pins release and the blocks
+            follow normal refcount eviction — a long pipeline's store
+            footprint stays bounded by the in-flight window, not the
+            lineage cap."""
+            for rid in return_ids:
+                oid = ObjectID(rid)
+                self._lineage_pop(oid)
+                self._maybe_evict(oid)
             return True
 
         async def create_actor(spec):
@@ -1110,6 +1130,7 @@ class Head:
                 return None
             addr = None
             sources = []
+            serving = []
             if meta.kind in objdir.PULLABLE_KINDS:
                 for node_hex in (self.object_dir.locations(meta.object_id)
                                  or ([meta.node_id.hex()]
@@ -1120,8 +1141,36 @@ class Head:
                         n = None
                     if n is not None and n.alive and n.data_addr:
                         sources.append(n.data_addr)
+                        # serving-node hexes ride the reply so a scoped
+                        # subscriber can widen its shard interest to the
+                        # nodes it actually pulls from (interest-on-demand)
+                        serving.append(node_hex)
                 addr = sources[0] if sources else None
-            return {"meta": meta, "data_addr": addr, "sources": sources}
+            return {"meta": meta, "data_addr": addr, "sources": sources,
+                    "nodes": serving}
+
+        async def widen_interest(shards):
+            """Interest-on-demand (scoped daemon push): the subscriber
+            cold-missed a data-plane pull into the locate_object fallback;
+            widening its shard subscription to the serving node's shard
+            makes subsequent pulls from that neighborhood resolve from
+            the gossiped directory instead. Replies with a fresh scoped
+            view so the newly-covered shards' entries and directory rows
+            arrive immediately."""
+            node = conn_state.get("node")
+            nshards = int(_config.get("view_shards"))
+            if node is None or node.view_sub is None or nshards <= 1:
+                return False
+            cur = set(node.view_sub["interest"])
+            new = {int(s) % nshards for s in shards} - cur
+            if not new:
+                return True
+            node.view_sub["interest"] = sorted(cur | new)
+            self.lease_events.append(
+                {"ts": time.time(), "kind": "interest_widen",
+                 "node_id": node.node_id.hex(), "shards": sorted(new)})
+            self._push_full_view(node.conn, sub=node.view_sub)
+            return True
 
         async def wait_objects(object_ids, num_returns, timeout):
             object_ids = [ObjectID(b) if not isinstance(b, ObjectID) else b
@@ -1830,6 +1879,29 @@ class Head:
             for b in (meta.contained or []):
                 self._unpin(ObjectID(b))
 
+    def _lineage_record_spec(self, spec: dict) -> None:
+        """Register a task spec as the producer of its return ids (shared
+        by head-path submits and out-of-band `record_lineage` pushes)."""
+        entry = {"spec": spec, "produced": set(),
+                 "recon_left": spec["options"].get("max_retries", 3),
+                 "bytes": self._spec_bytes(spec)}
+        self._lineage_add_entry(entry)
+        for rid in spec["return_ids"]:
+            oid = ObjectID(rid)
+            self._lineage_pop(oid)
+            self.lineage[oid] = entry
+            self.lineage_bytes += entry["bytes"]
+            if oid in self.objects:
+                # the result's seal outraced this record (lease results
+                # ride the worker's connection, the record the driver's):
+                # mark produced NOW or loss handling would treat the
+                # object as still in flight and never reconstruct it
+                entry["produced"].add(oid)
+        while (len(self.lineage) > self.lineage_cap
+               or self.lineage_bytes > self.lineage_bytes_cap):
+            oldest = next(iter(self.lineage))
+            self._lineage_pop(oldest)
+
     def _lineage_add_entry(self, entry: dict) -> None:
         """Pin a reconstructable task's inputs: reconstruction needs them
         (reference: lineage pinning in ReferenceCounter)."""
@@ -1897,6 +1969,7 @@ class Head:
                 # node — sealing it would resurrect a dangling pointer and
                 # mask reconstruction
                 return
+        was_reconstructing = meta.object_id in self._reconstructing
         self._reconstructing.discard(meta.object_id)
         lin = self.lineage.get(meta.object_id)
         if lin is not None:
@@ -1930,6 +2003,13 @@ class Head:
                 self._free_meta(meta)  # a genuinely distinct duplicate copy
             return
         self.objects[meta.object_id] = meta
+        if was_reconstructing and lin is not None and not meta.error:
+            # a genuinely NEW seal of a lost return id (a surviving
+            # sibling's duplicate re-seal returns above, so this counts
+            # exactly the lost partitions that were rebuilt)
+            self.sched_totals["reconstructs"] += 1
+            if lin["spec"]["options"].get("data_stage"):
+                self.sched_totals["data_reconstructs"] += 1
         if meta.kind in objdir.PULLABLE_KINDS:
             self._dir_announce(objdir.seal_record(meta))
         self._publish("object_state", {"object_id": meta.object_id.binary(),
@@ -2098,7 +2178,20 @@ class Head:
         self._last_dispatch_ts = time.monotonic()
         self._task_event(rec.task_id, rec.spec["options"].get("name", "task"),
                          "RUNNING", worker=w)
-        w.conn.push("exec_task", spec=rec.spec)
+        spec = rec.spec
+        if spec["options"].get("data_stage") and spec.get("deps"):
+            # ship the deps' metas with the dispatch so the worker's
+            # argument resolution pulls straight through its node's
+            # PullManager instead of round-tripping get_meta per block
+            # (a reconstructed reduce task resolves rebuilt sub-blocks
+            # the same way: a stale meta falls back to locate_object)
+            dm = [self.objects.get(ObjectID(d)) for d in spec["deps"]]
+            dm = [m for m in dm
+                  if m is not None and m.kind in objdir.PULLABLE_KINDS]
+            if dm:
+                spec = dict(spec)
+                spec["dep_metas"] = dm
+        w.conn.push("exec_task", spec=spec)
         return None
 
     def _kick(self) -> None:
@@ -2430,6 +2523,11 @@ class Head:
             self._reconstructing.add(ObjectID(rid))
         self._task_event(spec["task_id"], spec["options"].get("name", "task"),
                          "PENDING_RECONSTRUCTION")
+        self.lease_events.append({
+            "ts": time.time(), "kind": "object_reconstruct",
+            "object_id": oid.hex()[:16],
+            "task": spec["options"].get("name", "task"),
+            "data_stage": bool(spec["options"].get("data_stage"))})
         self._enqueue(TaskRecord(spec, None))
 
     @staticmethod
@@ -2662,11 +2760,26 @@ class Head:
         for n in self.nodes.values():
             if not n.alive:
                 continue
+            # per-node object-store pressure rides the view entries so
+            # data-plane producers (the streaming executor's admission)
+            # can shed load with zero extra RPCs; daemons gossip
+            # store_used/store_cap in their stats, the head reads its own
+            frac = None
+            if n.is_head:
+                cap = getattr(self.store, "capacity", 0)
+                if cap:
+                    frac = self.store.used / cap
+            else:
+                st = n.sched_stats or {}
+                cap = st.get("store_cap") or 0
+                if cap:
+                    frac = st.get("store_used", 0) / cap
             nodes.append(rv.make_entry(
                 n.node_id.hex(), version=n.view_version, free=n.available,
                 total=n.resources, labels=n.labels,
                 idle_workers=n.pool_idle, sched_addr=n.sched_addr,
-                data_addr=n.data_addr, is_head=n.is_head))
+                data_addr=n.data_addr, is_head=n.is_head,
+                store_frac=round(frac, 4) if frac is not None else None))
         return {"version": self._view_seq, "nodes": nodes,
                 "epoch": self.cluster_epoch}
 

@@ -73,7 +73,16 @@ class NodeDaemon:
         self.sched_stats = {"local_grants": 0, "spillbacks": 0,
                             "pool_acquires": 0, "lease_returns": 0,
                             "pool_releases": 0, "pool_worker_deaths": 0,
-                            "peer_spillbacks": 0, "peer_grants": 0}
+                            "peer_spillbacks": 0, "peer_grants": 0,
+                            # data-plane cold misses: pulls that fell back
+                            # to the head's locate_object (the scoped
+                            # directory didn't cover the serving node —
+                            # interest-on-demand widening should make
+                            # these stop recurring per node)
+                            "locate_fallbacks": 0}
+        # interest-on-demand: shards this daemon widened its scoped view
+        # subscription to (beyond its own), re-asserted after reconnects
+        self._interest_extra: set = set()
         self._fr_metrics_ts = 0.0   # last registry snapshot ride-along
         self._last_gossip_ts = 0.0  # heartbeat bookkeeping (monotonic)
         # partition tolerance: the cluster epoch observed from the head
@@ -287,6 +296,14 @@ class NodeDaemon:
                 self.head_epoch = reply.get("epoch", 0)
                 self._fr("head_reconnect", epoch=self.head_epoch)
                 await self._send_reconcile()
+                if self._interest_extra:
+                    # re-assert on-demand interest widening: the fresh
+                    # registration reset our view_sub to the auto scope
+                    try:
+                        conn.push("widen_interest",
+                                  shards=sorted(self._interest_extra))
+                    except Exception:
+                        pass
                 # drain queued telemetry + re-advertise pool state under
                 # the (possibly new) epoch
                 self._gossip_send(bump=True)
@@ -653,6 +670,12 @@ class NodeDaemon:
         if self.pull is not None:
             stats.update(self.pull.stats)
             stats["replica_count"] = self.pull.replica_count()
+        if self.store is not None:
+            # object-store pressure rides the gossip so the head can stamp
+            # store_frac into the broadcast view entries — the data
+            # plane's backpressure signal, zero extra RPCs
+            stats["store_used"] = int(self.store.used)
+            stats["store_cap"] = int(getattr(self.store, "capacity", 0))
         metrics_snap = None
         drained_spans = None
         now = time.monotonic()
@@ -778,6 +801,7 @@ class NodeDaemon:
                             self.cluster_view.data_addr_of,
                             self.head_host, exclude=self.node_id.hex())
         if not out and self.conn is not None and not self.conn.closed:
+            self.sched_stats["locate_fallbacks"] += 1
             try:
                 rep = await self.conn.request(
                     "locate_object",
@@ -789,7 +813,33 @@ class NodeDaemon:
                           or ([rep["data_addr"]]
                               if rep.get("data_addr") else [])):
                     out.append((s[0] or self.head_host, s[1]))
+                self._maybe_widen_interest(rep.get("nodes") or ())
         return out
+
+    def _maybe_widen_interest(self, serving_hexes) -> None:
+        """Interest-on-demand (ROADMAP item 1 follow-on): a cold miss on
+        a scoped view means the serving node lives outside our interest
+        shards — widen the subscription to its shard so repeated
+        data-plane pulls from that neighborhood stop paying the
+        locate_object fallback. One fire-and-forget push per new shard;
+        the head replies with a fresh scoped view covering it."""
+        nshards = self.cluster_view.nshards
+        if nshards <= 1 or not serving_hexes:
+            return
+        from ray_tpu.core.resource_view import shard_of
+
+        own = shard_of(self.node_id.hex(), nshards)
+        new = {shard_of(h, nshards) for h in serving_hexes}
+        new -= self._interest_extra | {own}
+        if not new:
+            return
+        self._interest_extra |= new
+        self._fr("interest_widen", shards=sorted(new))
+        if self.conn is not None and not self.conn.closed:
+            try:
+                self.conn.push("widen_interest", shards=sorted(new))
+            except Exception:
+                pass
 
     def _on_replica_created(self, local_meta) -> None:
         from ray_tpu.core import object_directory as objdir

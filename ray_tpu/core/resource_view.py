@@ -55,15 +55,19 @@ def fits(free: Dict[str, float], resources: Dict[str, float]) -> bool:
 def make_entry(node_id_hex: str, *, version: int, free: Dict[str, float],
                total: Dict[str, float], labels: Dict[str, str],
                idle_workers: int = 0, sched_addr=None,
-               data_addr=None, is_head: bool = False) -> dict:
+               data_addr=None, is_head: bool = False,
+               store_frac=None) -> dict:
     # data_addr: the node's object data server — consumers of the gossiped
     # object directory resolve pull sources from the cached view instead
     # of asking the head (host None = "the head's host", substituted by
-    # each consumer from its own route to the head)
+    # each consumer from its own route to the head).
+    # store_frac: that store's used/capacity fraction (None = unknown) —
+    # the data plane's live memory-pressure signal.
     return {"node_id": node_id_hex, "version": version, "free": dict(free),
             "total": dict(total), "labels": dict(labels),
             "idle_workers": idle_workers, "sched_addr": sched_addr,
-            "data_addr": data_addr, "is_head": is_head}
+            "data_addr": data_addr, "is_head": is_head,
+            "store_frac": store_frac}
 
 
 class ClusterView:
@@ -196,6 +200,18 @@ class ClusterView:
         e = self.entries.get(node_id_hex)
         addr = e.get("data_addr") if e else None
         return tuple(addr) if addr else None
+
+    def max_store_frac(self) -> float:
+        """Highest gossiped object-store pressure (used/capacity) across
+        the cached view entries; 0.0 when no node reports one. The data
+        plane's zero-RPC backpressure signal: a producer consults this
+        before admitting more blocks into the cluster."""
+        frac = 0.0
+        for e in self.entries.values():
+            f = e.get("store_frac")
+            if f is not None and f > frac:
+                frac = f
+        return frac
 
     # ------------------------------------------------------------ routing
     def select_node(self, resources: Dict[str, float],

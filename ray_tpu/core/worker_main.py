@@ -33,7 +33,8 @@ from typing import Dict, Optional
 
 from ray_tpu.core import serialization
 from ray_tpu.core.client import CoreClient
-from ray_tpu.core.exceptions import TaskCancelledError, TaskError
+from ray_tpu.core.exceptions import (ObjectLostError, TaskCancelledError,
+                                     TaskError)
 from ray_tpu.core.ids import ActorID, ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.serialization import SerializedObject
@@ -126,6 +127,16 @@ class WorkerRuntime:
         except Exception:
             pass
 
+    def _adopt_dep_metas(self, spec) -> None:
+        """Dep metas shipped with a task spec (lease push or head
+        dispatch of data-stage tasks): adopt them so argument resolution
+        pulls straight through the node PullManager instead of paying a
+        get_meta round trip per dependency. A meta we already hold wins
+        (it may be a fresher pulled copy); a stale shipped meta falls
+        back to locate_object inside the pull path."""
+        for m in spec.get("dep_metas") or ():
+            self.client.local_metas.setdefault(m.object_id, m)
+
     def _resolve_args(self, payload) -> tuple:
         if "inline" in payload:
             if payload["inline"] == _empty_args_blob():
@@ -195,6 +206,7 @@ class WorkerRuntime:
 
             fn = self.client.fn_manager.load(spec["fn_key"],
                                  blob=spec.get("fn_blob"))
+            self._adopt_dep_metas(spec)
             # dependency fetches land in the dispatch phase (outside the
             # run span) but still carry the task's trace context, so
             # object-pull spans parent to the submitting trace
@@ -209,7 +221,9 @@ class WorkerRuntime:
                 prof["end"] = time.time()
             meta = self.client.store_result(rid, result, register=False)
         except BaseException as e:  # noqa: BLE001 - failures become error objects
-            err = e if isinstance(e, (TaskError, TaskCancelledError)) else \
+            # ObjectLostError passes unwrapped (retryable input loss)
+            err = e if isinstance(
+                e, (TaskError, TaskCancelledError, ObjectLostError)) else \
                 TaskError(repr(e), traceback.format_exc())
             meta = self.client.store_result(rid, err, register=False,
                                             is_error=True)
@@ -259,6 +273,7 @@ class WorkerRuntime:
 
             fn = self.client.fn_manager.load(spec["fn_key"],
                                  blob=spec.get("fn_blob"))
+            self._adopt_dep_metas(spec)
             with tracing.adopt_context(opts.get("trace_ctx")):
                 args, kwargs = self._resolve_args(spec["args"])
             with tracing.execute_span(opts.get("name", "task"),
@@ -280,7 +295,10 @@ class WorkerRuntime:
         except BaseException as e:  # noqa: BLE001 - all failures become error objects
             err = e if isinstance(e, TaskError) else TaskError(
                 repr(e), traceback.format_exc())
-            if isinstance(e, TaskCancelledError):
+            if isinstance(e, (TaskCancelledError, ObjectLostError)):
+                # ObjectLostError stays unwrapped: a consumer whose INPUT
+                # went lost (vs. its own code failing) is retryable by
+                # the submitting executor once the input reconstructs
                 err = e
             for rid in return_ids:
                 try:

@@ -174,6 +174,7 @@ def _scheduler_metrics_snapshot(head) -> list:
     local_grants, spillbacks, staleness, lag, pool_idle = [], [], [], [], []
     pool_leased, peer_spillbacks, peer_grants = [], [], []
     dir_staleness, node_pulls, node_pull_bytes, node_replicas = [], [], [], []
+    store_frac = []
     for n in head.nodes.values():
         if n.is_head or not n.alive:
             continue
@@ -193,6 +194,9 @@ def _scheduler_metrics_snapshot(head) -> list:
         node_pulls.append((tags, stats.get("object_pulls", 0)))
         node_pull_bytes.append((tags, stats.get("object_pull_bytes", 0)))
         node_replicas.append((tags, stats.get("replica_count", 0)))
+        if stats.get("store_cap"):
+            store_frac.append(
+                (tags, stats.get("store_used", 0) / stats["store_cap"]))
         pool_idle.append((tags, n.pool_idle))
         pool_leased.append((tags, getattr(n, "pool_leased", 0)))
     head_tags = {"node_id": "head"}
@@ -228,6 +232,15 @@ def _scheduler_metrics_snapshot(head) -> list:
         series("lease_head_grants_total", "counter",
                "Leases granted by the head (cold path or spillback)",
                [(head_tags, head.sched_totals.get("head_grants", 0))]),
+        series("objects_reconstructed_total", "counter",
+               "Lost objects re-sealed by re-running their producing "
+               "task from the lineage ledger",
+               [(head_tags, head.sched_totals.get("reconstructs", 0))]),
+        series("data_blocks_reconstructed_total", "counter",
+               "Data-pipeline blocks (stage outputs / shuffle "
+               "sub-blocks) rebuilt through lineage reconstruction "
+               "after node loss",
+               [(head_tags, head.sched_totals.get("data_reconstructs", 0))]),
         series("cluster_view_staleness_s", "gauge",
                "Age of the newest resource-view delta the head has from "
                "each node daemon", staleness or [(head_tags, 0.0)]),
@@ -268,6 +281,12 @@ def _scheduler_metrics_snapshot(head) -> list:
             "node_object_replicas", "gauge",
             "Pulled replicas each node daemon caches and advertises as "
             "extra pull sources", node_replicas))
+    if store_frac:
+        out.append(series(
+            "node_object_store_pressure", "gauge",
+            "Each node daemon's object-store used/capacity fraction "
+            "(the data plane's gossiped backpressure signal)",
+            store_frac))
     return out
 
 

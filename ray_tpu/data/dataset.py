@@ -313,7 +313,8 @@ class Dataset:
             return _join_blocks(lb, rb, on, how)
 
         if ray_tpu.is_initialized():
-            task = ray_tpu.remote(join_parts)
+            task = ray_tpu.remote(join_parts).options(
+                name="data_join", lineage=True, data_stage=True)
             refs = [task.remote(l, r) for l, r in
                     zip(left._partitions, right._partitions)]
             return Dataset(refs, [], self._parallelism)
@@ -363,8 +364,10 @@ class Dataset:
 
         P = len(sizes)
         if ray_tpu.is_initialized():
-            map_task = ray_tpu.remote(shf._map_partition).options(num_returns=P)
-            reducer = ray_tpu.remote(shf._reduce_concat)
+            map_task = ray_tpu.remote(shf._map_partition).options(
+                num_returns=P, name="data_reshard_map", data_stage=True)
+            reducer = ray_tpu.remote(shf._reduce_concat).options(
+                name="data_reshard_reduce", lineage=True, data_stage=True)
             map_out = []
             for src, off in zip(self._partitions, offsets):
                 refs = map_task.remote(src, self._ops, P, "offset",
@@ -466,6 +469,11 @@ class Dataset:
                 state["bytes"] += block_nbytes(block)
                 state["blocks"] += 1
                 results[idx] = block
+                # the partition's whole chain is consumed: retire its
+                # lineage entries so intermediate blocks evict now (a
+                # long pipeline's store footprint stays bounded by the
+                # window, not the lineage cap)
+                executor.release_partition(idx, final_ref=ref)
                 # emit in order (deterministic, like ordered execution)
                 while emitted in results:
                     block = results.pop(emitted)

@@ -9,11 +9,21 @@ queue, in-flight cap, and stats; the scheduling loop admits work to ANY
 stage with capacity, so a block can be in stage 3 while another is still
 in stage 1 — inter-operator concurrency, not a fused per-block chain.
 
-Differences from the reference are deliberate: stages run as cluster
-tasks/actor calls over ObjectRefs (blocks never pass through the driver),
-and the byte-budget backpressure from r4 governs INPUT admission (stage 0)
-— the equivalent of the reference's resource-budget policy with the
-budget measured from observed completed-block sizes.
+Fault tolerance & the data plane (ISSUE 15): inter-stage blocks are
+directory-announced objects pulled through node PullManagers — the
+executor ships dep metas with each dispatch (zero get_meta round trips
+warm) and prefetches a completed block into the consuming stage's node
+before its task dispatches. Every stage task registers its spec in the
+head's lineage ledger (`options(lineage=True, data_stage=True)`), so a
+block lost to node death is lazily rebuilt by re-running exactly its
+producing task; a consumer task that surfaces ObjectLostError (its INPUT
+died mid-flight) is retried by the executor instead of failing the
+pipeline. Backpressure is two-signal: a congested downstream queue sheds
+upstream admission, and gossiped store-pressure rows
+(`ClusterView.max_store_frac`) stop stage-0 input admission before the
+cluster store OOMs. Consumed intermediates release their lineage entries
+eagerly (per-partition chain release) so a long pipeline's footprint
+stays bounded by the in-flight window.
 """
 
 from __future__ import annotations
@@ -22,28 +32,46 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from ray_tpu.core import config as _config
 
 _GAUGES: Dict[str, Any] = {}
 
 
-def _op_gauges(stage: "Stage", in_flight: int, queued: int) -> None:
-    """Live per-operator gauges into the cluster metrics registry (the
+def _metrics() -> Dict[str, Any]:
+    """Live per-operator series in the cluster metrics registry (the
     reference streaming executor's Gauge set, streaming_executor.py:105)
-    — visible at /metrics as ray_tpu_data_op_{in_flight,queued}{op}.
-    ONE shared gauge per name (stages are tag values): per-stage Gauge
-    objects would overwrite each other in the registry."""
-    try:
-        from ray_tpu.util import metrics as _m
+    — visible at /metrics as ray_tpu_data_*. ONE shared object per name
+    (stages are tag values): per-stage objects would overwrite each
+    other in the registry."""
+    from ray_tpu.util import metrics as _m
 
-        if not _GAUGES:
-            _GAUGES["in_flight"] = _m.Gauge(
-                "data_op_in_flight", "Data operator in-flight block tasks",
-                tag_keys=("op",))
-            _GAUGES["queued"] = _m.Gauge(
-                "data_op_queued", "Data operator queued blocks",
-                tag_keys=("op",))
-        _GAUGES["in_flight"].set(in_flight, {"op": stage.name})
-        _GAUGES["queued"].set(queued, {"op": stage.name})
+    if not _GAUGES:
+        _GAUGES["in_flight"] = _m.Gauge(
+            "data_op_in_flight", "Data operator in-flight block tasks",
+            tag_keys=("op",))
+        _GAUGES["queued"] = _m.Gauge(
+            "data_op_queued", "Data operator queued blocks",
+            tag_keys=("op",))
+        _GAUGES["backpressure"] = _m.Counter(
+            "data_backpressure_total",
+            "Admission ticks shed by live-signal backpressure",
+            tag_keys=("op", "reason"))
+        _GAUGES["retries"] = _m.Counter(
+            "data_input_retries_total",
+            "Pipeline consumer tasks retried after their input block "
+            "went lost (rides lineage reconstruction)", tag_keys=("op",))
+        _GAUGES["prefetch"] = _m.Counter(
+            "data_prefetch_total",
+            "Blocks staged into the consuming stage's node ahead of "
+            "dispatch", tag_keys=("op",))
+    return _GAUGES
+
+
+def _op_gauges(stage: "Stage", in_flight: int, queued: int) -> None:
+    try:
+        m = _metrics()
+        m["in_flight"].set(in_flight, {"op": stage.name})
+        m["queued"].set(queued, {"op": stage.name})
     except Exception:
         pass   # metrics must never break execution
 
@@ -57,6 +85,9 @@ class OpStats:
         self.submitted = 0
         self.completed = 0
         self.bytes_out = 0
+        self.retried = 0        # resubmits after a lost-input failure
+        self.prefetches = 0     # blocks staged ahead of dispatch
+        self.throttled = 0      # admission ticks shed by backpressure
         self.first_submit_ts: Optional[float] = None
         self.last_complete_ts: Optional[float] = None
         # (submit_ts, complete_ts) per block — the overlap evidence
@@ -80,8 +111,18 @@ class OpStats:
 
     def summary(self) -> str:
         wall = ((self.last_complete_ts or 0) - (self.first_submit_ts or 0))
-        return (f"{self.name}: {self.completed} blocks, "
-                f"{self.bytes_out / 1e6:.2f} MB, {wall:.3f}s busy")
+        out = (f"{self.name}: {self.completed} blocks, "
+               f"{self.bytes_out / 1e6:.2f} MB, {wall:.3f}s busy")
+        extras = []
+        if self.retried:
+            extras.append(f"{self.retried} retried")
+        if self.prefetches:
+            extras.append(f"{self.prefetches} prefetched")
+        if self.throttled:
+            extras.append(f"{self.throttled} throttled")
+        if extras:
+            out += " (" + ", ".join(extras) + ")"
+        return out
 
 
 class Stage:
@@ -97,6 +138,11 @@ class Stage:
     def submit(self, ref: Any) -> Any:
         raise NotImplementedError
 
+    def prefetch_target(self):
+        """Data-server address of the node this stage's next task will
+        run on, or None (no prefetch)."""
+        return None
+
     def close(self) -> None:
         pass
 
@@ -104,7 +150,9 @@ class Stage:
 class TaskStage(Stage):
     """Fused chain of per-block task ops (reference TaskPoolMapOperator;
     adjacent map/filter/flat_map fuse into ONE task — the physical-plan
-    fusion rule)."""
+    fusion rule). Tasks carry `lineage=True` so the head can re-run them
+    when their output block is lost, and `data_stage=True` so those
+    reconstructions count into data_blocks_reconstructed_total."""
 
     def __init__(self, ops: List[Any], max_in_flight: int = 16):
         names = ",".join(o.kind for o in ops) or "read"
@@ -112,17 +160,42 @@ class TaskStage(Stage):
         import ray_tpu
         from ray_tpu.data.dataset import _exec_chain
 
-        self._task = ray_tpu.remote(_exec_chain)
+        self._task = ray_tpu.remote(_exec_chain).options(
+            name=f"data:{names or 'read'}", lineage=True, data_stage=True)
         self._ops = ops
+        self._pf_cache: Tuple[float, Any] = (0.0, None)
 
     def submit(self, ref: Any) -> Any:
         return self._task.remote(ref, self._ops)
+
+    def prefetch_target(self):
+        """The current lease's node for this task shape, resolved from
+        cache and memoized briefly (leases are sticky; re-resolving per
+        block would cost a lock + view scan each)."""
+        now = time.monotonic()
+        ts, addr = self._pf_cache
+        if now - ts < 2.0:
+            return addr
+        addr = None
+        try:
+            from ray_tpu.core.api import _build_resources, _global_client
+
+            client = _global_client()
+            fn_key = self._task._ensure_exported()
+            addr = client.lease_data_addr(
+                fn_key, {"resources": _build_resources(self._task._options)})
+        except Exception:
+            addr = None
+        self._pf_cache = (now, addr)
+        return addr
 
 
 class ActorStage(Stage):
     """Callable-class UDF over a shared actor pool (reference
     ActorPoolMapOperator). In-flight cap = pool size by default: one
-    outstanding call per actor keeps the pool busy without queue blowup."""
+    outstanding call per actor keeps the pool busy without queue blowup.
+    No prefetch target: calls round-robin the pool, so the consuming
+    node isn't known until submit."""
 
     def __init__(self, op: Any):
         super().__init__(f"ActorMap(x{op.concurrency})",
@@ -158,7 +231,9 @@ class StreamingExecutor:
     (downstream-first, so finished work drains before new work enters),
     then wait for ANY in-flight task across ALL stages and route its
     output to the next stage's queue. Input admission (stage 0) is
-    additionally governed by the adaptive byte budget."""
+    additionally governed by the adaptive byte budget AND the gossiped
+    store-pressure signal; inter-stage admission sheds when the
+    downstream queue is congested."""
 
     def __init__(self, stages: List[Stage], partitions: List[Any],
                  input_window: Callable[[], int]):
@@ -167,27 +242,182 @@ class StreamingExecutor:
         self.input_window = input_window
         # per-stage input queues of (partition_idx, ref)
         self.queues: List[deque] = [deque() for _ in stages]
-        self.in_flight: List[Dict[Any, int]] = [{} for _ in stages]
+        # in-flight output ref -> (partition_idx, input ref) — the input
+        # is kept so a lost-input failure can resubmit the same task
+        self.in_flight: List[Dict[Any, Tuple[int, Any]]] = [{} for _ in stages]
         self.results: Dict[int, Any] = {}
+        # lineage recovery + eager release bookkeeping
+        self._retries: Dict[Tuple[int, int], int] = {}
+        self.input_retries = 0
+        self.prefetches = 0
+        self._chain: Dict[int, List[Any]] = {}   # idx -> intermediate refs
+        self._released: List[Any] = []           # release batch buffer
+        self._prefetch_on = _config.get("data_prefetch")
+        self._eager_release = _config.get("data_eager_release")
+        self._retry_cap = int(_config.get("data_input_retries"))
+        self._highwater = float(_config.get("data_store_highwater"))
+
+    # ------------------------------------------------------- admission
+    def _store_hot(self) -> bool:
+        """Gossiped store-pressure signal, read entirely from the cached
+        cluster view (zero RPCs): True when ANY node's object store runs
+        above the highwater fraction."""
+        if self._highwater <= 0:
+            return False
+        try:
+            from ray_tpu.core.api import _global_client
+
+            return (_global_client().cluster_view.max_store_frac()
+                    >= self._highwater)
+        except Exception:
+            return False
 
     def _admit(self) -> None:
+        store_hot = self._store_hot()
         for si in range(len(self.stages) - 1, -1, -1):
             stage, q, fl = self.stages[si], self.queues[si], self.in_flight[si]
             cap = stage.max_in_flight
+            if si + 1 < len(self.stages):
+                # a slow/degraded downstream stage sheds UPSTREAM
+                # admission: feeding a stage whose input queue already
+                # holds 2x its concurrency only grows store footprint
+                nxt = self.stages[si + 1]
+                if len(self.queues[si + 1]) >= 2 * nxt.max_in_flight:
+                    cap = 0
             if si == 0:
                 cap = min(cap, self.input_window())
+                if store_hot:
+                    # the cluster store is at the highwater: stop
+                    # admitting NEW inputs (downstream stages keep
+                    # draining, so pressure falls instead of OOMing)
+                    cap = 0
+            if q and cap <= 0:
+                stage.stats.throttled += 1
+                try:
+                    _metrics()["backpressure"].inc(tags={
+                        "op": stage.name,
+                        "reason": "store" if (si == 0 and store_hot)
+                        else "queue"})
+                except Exception:
+                    pass
             while q and len(fl) < cap:
                 idx, ref = q.popleft()
                 out = stage.submit(ref)
                 stage.stats.on_submit(out)
-                fl[out] = idx
+                fl[out] = (idx, ref)
             _op_gauges(stage, len(fl), len(q))
 
+    # -------------------------------------------------------- recovery
+    def _lost_input(self, client, ref: Any) -> bool:
+        """True when a completed stage task's result is an
+        ObjectLostError — its INPUT died mid-flight (node loss), which
+        is retryable once lineage rebuilds the input — as opposed to a
+        user-code failure, which is not."""
+        meta = client.local_metas.get(ref.id)
+        if meta is None:
+            # join the lease call's reply (populates local_metas); a
+            # head-scheduled task has no pending call and falls through
+            try:
+                if client._resolve_pending_call(ref.id, timeout=5):
+                    meta = client.local_metas.get(ref.id)
+            except Exception:
+                meta = client.local_metas.get(ref.id)
+        if meta is None:
+            # cold-path (head-scheduled) task: one bounded meta lookup —
+            # error results are inline and never enter the gossiped
+            # directory, so only the head can show the error bit. Lease
+            # results carry their meta in the reply, so the warm path
+            # never reaches here.
+            try:
+                meta = client.head_request(
+                    "get_meta", object_id=ref.id.binary(), timeout=10)
+            except Exception:
+                return False
+            if meta is not None:
+                client.local_metas[ref.id] = meta
+        if meta is None or not getattr(meta, "error", False):
+            return False
+        from ray_tpu.core.exceptions import ObjectLostError
+
+        try:
+            client.get([ref])
+        except ObjectLostError:
+            return True
+        except Exception:
+            return False
+        return False
+
+    def _retry(self, si: int, idx: int, src: Any) -> bool:
+        """Resubmit stage si's task for partition idx with the same
+        input. The retried task's dependency fetch triggers lineage
+        reconstruction of the lost block at the head (get_meta /
+        locate_object park until the producer re-runs)."""
+        key = (si, idx)
+        count = self._retries.get(key, 0)
+        if count >= self._retry_cap:
+            return False
+        self._retries[key] = count + 1
+        self.input_retries += 1
+        stage = self.stages[si]
+        stage.stats.retried += 1
+        try:
+            _metrics()["retries"].inc(tags={"op": stage.name})
+        except Exception:
+            pass
+        out = stage.submit(src)
+        stage.stats.on_submit(out)
+        self.in_flight[si][out] = (idx, src)
+        return True
+
+    # -------------------------------------------------------- prefetch
+    def _prefetch(self, si: int, ref: Any, client) -> None:
+        """Stage the block onto the consuming stage's node before its
+        task dispatches (ROADMAP item 1 push-side prefetch follow-on,
+        delivered on the data plane where it pays): the node PullManager
+        dedups with the dispatch-time fetch if they race."""
+        if not self._prefetch_on:
+            return
+        stage = self.stages[si]
+        addr = stage.prefetch_target()
+        if addr is None:
+            return
+        try:
+            if client.prefetch_object(ref, addr):
+                stage.stats.prefetches += 1
+                self.prefetches += 1
+                _metrics()["prefetch"].inc(tags={"op": stage.name})
+        except Exception:
+            pass
+
+    # ---------------------------------------------------- eager release
+    def release_partition(self, idx: int, final_ref: Any = None) -> None:
+        """Called by the consumer once partition idx's final block has
+        been fetched: the chain's intermediate (and final) blocks can
+        never be needed again, so their lineage entries retire NOW —
+        dropping the input dep pins that would otherwise hold every
+        intermediate block until cap eviction."""
+        refs = self._chain.pop(idx, [])
+        if final_ref is not None:
+            refs = refs + [final_ref]
+        if not refs or not self._eager_release:
+            return
+        try:
+            from ray_tpu.core.api import _global_client
+
+            _global_client().head_push(
+                "release_lineage",
+                return_ids=[r.id.binary() for r in refs])
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- run
     def run(self) -> Iterator[Tuple[int, Any]]:
         """Yields (partition_idx, final block ref) as they complete —
         UNORDERED; the caller handles ordered emission."""
         import ray_tpu
+        from ray_tpu.core.api import _global_client
 
+        client = _global_client()
         next_input = 0
         n = len(self.partitions)
         emitted = 0
@@ -204,29 +434,39 @@ class StreamingExecutor:
                 self._admit()
                 all_refs = [r for fl in self.in_flight for r in fl]
                 if not all_refs:
-                    if next_input >= n:
+                    if next_input >= n and not any(self.queues):
                         break
+                    # queued work shed by backpressure with nothing in
+                    # flight: yield the CPU until the signal clears
+                    time.sleep(0.02)
                     continue
                 ready, _ = ray_tpu.wait(all_refs, num_returns=1, timeout=300)
                 for ref in ready:
                     for si, fl in enumerate(self.in_flight):
-                        if ref in fl:
-                            idx = fl.pop(ref)
-                            # size probe rides the ref; fetching the block
-                            # is deferred to the consumer
-                            self.stages[si].stats.on_complete(ref, 0)
-                            if si + 1 < len(self.stages):
-                                self.queues[si + 1].append((idx, ref))
-                            else:
-                                emitted += 1
-                                yield idx, ref
+                        if ref not in fl:
+                            continue
+                        idx, src = fl.pop(ref)
+                        if (self._lost_input(client, ref)
+                                and self._retry(si, idx, src)):
                             break
+                        # size probe rides the ref; fetching the block
+                        # is deferred to the consumer
+                        self.stages[si].stats.on_complete(ref, 0)
+                        if si + 1 < len(self.stages):
+                            self._chain.setdefault(idx, []).append(ref)
+                            self.queues[si + 1].append((idx, ref))
+                            self._prefetch(si + 1, ref, client)
+                        else:
+                            emitted += 1
+                            yield idx, ref
+                        break
         finally:
             self.close()
 
     def close(self) -> None:
         for s in self.stages:
             s.close()
+        self._chain.clear()
 
     def per_op_stats(self) -> List[OpStats]:
         return [s.stats for s in self.stages]

@@ -127,11 +127,22 @@ def shuffle_refs(partitions: List[Any], ops: List[Any], P: int, mode: str,
                  boundaries=None,
                  reduce_fn: Optional[Callable] = None,
                  reduce_extra_args: tuple = ()) -> List[Any]:
-    """Run the two-stage shuffle; returns P ObjectRefs of reduced blocks."""
+    """Run the two-stage shuffle; returns P ObjectRefs of reduced blocks.
+
+    Fault tolerance: map tasks are multi-return and head-submitted, so
+    every sub-block has a lineage ledger entry; reduce tasks opt into
+    out-of-band lineage (`lineage=True`, they ride the lease path). A
+    node SIGKILLed mid-shuffle loses only its resident sub-blocks — the
+    reduce tasks' dependency fetches park at the head, which re-runs
+    exactly the map tasks whose outputs died (lazy reconstruction,
+    surfaced as data_blocks_reconstructed_total), and the shuffle
+    completes byte-identical."""
     import ray_tpu
 
-    map_task = ray_tpu.remote(_map_partition).options(num_returns=P)
-    reducer = ray_tpu.remote(reduce_fn or _reduce_concat)
+    map_task = ray_tpu.remote(_map_partition).options(
+        num_returns=P, name="data_shuffle_map", data_stage=True)
+    reducer = ray_tpu.remote(reduce_fn or _reduce_concat).options(
+        name="data_shuffle_reduce", lineage=True, data_stage=True)
     map_out = []
     for i, src in enumerate(partitions):
         # salt the seed per map task: identical seeds would send row t of

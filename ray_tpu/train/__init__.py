@@ -10,6 +10,7 @@ from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,
                                       read_sharded_manifest, save_sharded)
 from ray_tpu.train.config import (CheckpointConfig, ElasticConfig,
                                   FailureConfig, RunConfig, ScalingConfig)
+from ray_tpu.train.ingest import DatasetShard
 from ray_tpu.train.session import (get_context, get_dataset_shard, report)
 from ray_tpu.train.spmd import (
     CompiledTrain,
@@ -31,6 +32,7 @@ from ray_tpu.train.trainer import (DataParallelTrainer, JaxBackend, JaxTrainer,
 __all__ = [
     "Checkpoint", "CheckpointManager", "CheckpointConfig", "ElasticConfig",
     "FailureConfig",
+    "DatasetShard",
     "RunConfig", "ScalingConfig", "get_context", "get_dataset_shard",
     "report", "CompiledTrain", "TrainState", "compile_gpt2_train",
     "compile_train", "cross_worker_grad_sync", "default_optimizer",

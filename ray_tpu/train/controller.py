@@ -40,12 +40,16 @@ class TrainControllerLogic:
 
     def __init__(self, train_fn: Callable, train_config: Any,
                  scaling_config: ScalingConfig, run_config: RunConfig,
-                 backend=None, resume_from: Optional[str] = None):
+                 backend=None, resume_from: Optional[str] = None,
+                 datasets: Optional[dict] = None):
         self.train_fn = train_fn
         self.train_config = train_config
         self.scaling = scaling_config
         self.run_config = run_config
         self.backend = backend
+        # trainer datasets: re-sharded per generation (ingest.py), so an
+        # elastic resize re-splits the stream over the surviving gang
+        self.datasets = datasets or {}
         self.state = "INITIALIZING"
         self.failure_config = run_config.failure_config or FailureConfig()
         self.elastic: ElasticConfig = scaling_config.elastic_config()
@@ -274,7 +278,7 @@ class TrainControllerLogic:
             try:
                 group.start(self.train_fn, self.train_config,
                             resume_checkpoint=resume,
-                            backend=self.backend)
+                            backend=self.backend, datasets=self.datasets)
             except RayTpuError:
                 # a worker died mid-start (e.g. host failure racing the gang
                 # launch): retryable, same as a failure observed while polling
@@ -454,8 +458,9 @@ class TrainControllerActor:
     detached TrainController)."""
 
     def run(self, train_fn, train_config, scaling_config, run_config,
-            backend=None, resume_from=None):
+            backend=None, resume_from=None, datasets=None):
         logic = TrainControllerLogic(train_fn, train_config, scaling_config,
                                      run_config, backend=backend,
-                                     resume_from=resume_from)
+                                     resume_from=resume_from,
+                                     datasets=datasets)
         return logic.run()

@@ -113,7 +113,7 @@ class DataParallelTrainer:
             logic = TrainControllerLogic(
                 self.train_loop_per_worker, self.train_loop_config,
                 self.scaling_config, self.run_config, backend=self.backend,
-                resume_from=resume)
+                resume_from=resume, datasets=self.datasets)
             out = logic.run()
         else:
             controller = TrainControllerActor.options(
@@ -121,7 +121,8 @@ class DataParallelTrainer:
                      f"-{id(self) & 0xffff:x}").remote()
             out = ray_tpu.get(controller.run.remote(
                 self.train_loop_per_worker, self.train_loop_config,
-                self.scaling_config, self.run_config, self.backend, resume),
+                self.scaling_config, self.run_config, self.backend, resume,
+                self.datasets),
                 timeout=None)
             ray_tpu.kill(controller)
         result = Result(

@@ -31,7 +31,8 @@ class TrainWorker:
     def setup_and_start(self, train_fn, train_config, rank, world_size,
                         local_rank, node_rank, resume_checkpoint_path,
                         backend_env: Optional[Dict[str, str]] = None,
-                        generation: int = 0, run_name: Optional[str] = None):
+                        generation: int = 0, run_name: Optional[str] = None,
+                        dataset_shards: Optional[dict] = None):
         import os
 
         from ray_tpu.util import tracing
@@ -44,7 +45,8 @@ class TrainWorker:
         self._ctx = session_lib.TrainContext(
             rank=rank, world_size=world_size, local_rank=local_rank,
             node_rank=node_rank, resume_checkpoint=resume,
-            generation=generation, run_name=run_name)
+            generation=generation, run_name=run_name,
+            dataset_shards=dataset_shards)
         # this actor call's execute span carries the driver's trace when
         # the driver traces: capture it NOW (the train thread outlives the
         # call) so per-step spans join the run's trace
@@ -164,7 +166,7 @@ class WorkerGroup:
 
     def start(self, train_fn: Callable, train_config: Any,
               resume_checkpoint: Optional[Checkpoint] = None,
-              backend=None) -> None:
+              backend=None, datasets: Optional[dict] = None) -> None:
         n = self.scaling.num_workers
         res = self.scaling.worker_resources()
         opts: Dict[str, Any] = {"resources": res, "num_cpus": res.get("CPU", 0)}
@@ -178,12 +180,18 @@ class WorkerGroup:
         self.actor_ids = [w._actor_id.hex() for w in self.workers]
         backend_envs = (backend.worker_envs(self) if backend is not None
                         else [{} for _ in range(n)])
+        from ray_tpu.train.ingest import build_shards
+
         starts = []
         for rank, w in enumerate(self.workers):
             starts.append(w.setup_and_start.remote(
                 train_fn, train_config, rank, n, 0, rank,
                 resume_checkpoint.path if resume_checkpoint else None,
-                backend_envs[rank], self.generation, self.run_name))
+                backend_envs[rank], self.generation, self.run_name,
+                # per-generation shard map: rebuilt with the CURRENT
+                # (rank, world) so an elastic resize re-splits the
+                # stream without duplicating or dropping global batches
+                build_shards(datasets, rank, n)))
         ray_tpu.get(starts, timeout=120)
         # node placement, recorded for the controller's death watch
         # (a node_state DEAD event for any of these hosts fails the

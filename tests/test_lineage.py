@@ -76,6 +76,81 @@ def test_freed_objects_stay_freed(cluster):
         ray_tpu.get(ref, timeout=5)
 
 
+@ray_tpu.remote(resources={"pin": 1}, num_cpus=1)
+def split_halves(arr):
+    # multi-return producer: two shm-sized sub-blocks from one input
+    n = arr.shape[0] // 2
+    return arr[:n].copy(), arr[n:].copy()
+
+
+def test_multi_return_sibling_free_keeps_lineage_pin(cluster):
+    """Multi-return refcount x lineage interaction: dropping ONE
+    sub-block ref evicts that sub-block but must keep the shared lineage
+    entry's input pin alive for the sibling — after node loss, the
+    sibling reconstructs by re-running the producer against the
+    still-pinned input."""
+    import gc
+
+    arr = np.arange(128 * 1024, dtype=np.float32)  # 512 KB: shm halves
+    xref = ray_tpu.put(arr.copy())
+    ref_a, ref_b = split_halves.options(num_returns=2).remote(xref)
+    a = ray_tpu.get(ref_a, timeout=60)
+    b = ray_tpu.get(ref_b, timeout=60)
+    assert np.array_equal(np.concatenate([a, b]), arr)
+    # drop the driver's handles to sub-block A and the INPUT: the only
+    # thing keeping the input alive now is the sibling entry's dep pin
+    del a, ref_a, xref
+    gc.collect()
+    time.sleep(1.0)   # ref flush + evict loop
+
+    cluster.kill_node(0)
+    time.sleep(1.0)
+    cluster.add_node(num_cpus=2, resources={"pin": 2})
+    cluster.wait_for_nodes(2)
+
+    again = ray_tpu.get(ref_b, timeout=120)
+    assert np.array_equal(again, b), "sibling reconstruction corrupted"
+
+
+def test_cap_evicted_lineage_entry_raises_not_hangs():
+    """A lost object whose lineage entry was cap-evicted before
+    reconstruction must surface ObjectLostError promptly — never park a
+    consumer forever."""
+    import os
+
+    from ray_tpu.core.exceptions import ObjectLostError
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    os.environ["RAY_TPU_LINEAGE_CAP"] = "6"
+    try:
+        c = Cluster(num_cpus=1)
+        c.add_node(num_cpus=2, resources={"pin": 2})
+        c.connect()
+        c.wait_for_nodes(2)
+        try:
+            ref = produce.remote(5)
+            ray_tpu.get(ref, timeout=60)
+
+            @ray_tpu.remote(num_cpus=1)
+            def tiny(i):
+                return i
+
+            # flood the bounded ledger: produce's entry FIFO-evicts
+            ray_tpu.get([tiny.remote(i) for i in range(10)], timeout=60)
+            c.kill_node(0)
+            time.sleep(1.0)
+            t0 = time.time()
+            with pytest.raises(ObjectLostError):
+                ray_tpu.get(ref, timeout=60)
+            assert time.time() - t0 < 30, "loss surfaced only at timeout"
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_LINEAGE_CAP", None)
+
+
 def test_lost_put_object_raises_not_hangs(cluster):
     """ray.put objects have no lineage; losing their node must raise
     ObjectLostError for parked waiters, never hang (regression)."""

@@ -29,6 +29,8 @@ def test_soak_single_command(tmp_path):
     assert report["head_paused"]["peer_grants"] >= 1
     assert report["large_object"]["mb_moved"] >= 4 * 12
     assert report["large_object"]["mb_per_s"] > 0
+    assert report["shuffle_kill"]["sub_blocks_reconstructed"] > 0
+    assert report["shuffle_kill"]["recovery_s"] > 0
     assert report["serve"]["failed"] == 0
     assert report["serve"]["served"] > 0
     assert report["compiled_chain"]["failed"] == 0
