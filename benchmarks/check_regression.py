@@ -31,7 +31,13 @@ Suites:
                        quant_allreduce_mb_s — two-level + int8 inter hop
                        on the emulated 2-host x 2-device topology;
                        grad_sync_steps_per_s — device-path DDP sync;
-                       reshard_mb_s — cross-mesh window redistribution)
+                       fused_grad_sync_steps_per_s — whole train step
+                       with the in-program two-level int8-EF sync as ONE
+                       XLA program; fused_vs_staged_sync_x — fused vs
+                       staged-dispatch-chain speedup, >= 1.0 floor;
+                       reshard_mb_s — cross-mesh window redistribution;
+                       reshard_large_mb_s — streaming chunk-pipelined
+                       reshard under a bounded host-memory budget)
 
 Usage:
   python benchmarks/check_regression.py                # runs the bench

@@ -303,6 +303,125 @@ def collective_suite(out_path: str | None = None, payload_mb: int = 8,
     print(json.dumps({"row": "reshard_mb_s",
                       "value": round(results["reshard_mb_s"], 2)}))
 
+    # streaming reshard row: a 64 MB host leaf redistributed through an
+    # 8 MB chunk budget (peak host bytes <= in_flight * chunk, asserted
+    # by tests; here we gate the pipelined throughput)
+    from ray_tpu.util.collective import reshard_streaming
+
+    sbytes = 64 * (1 << 20)
+    big = np.arange(sbytes // 4, dtype=np.float32).reshape(-1, 1024)
+    s_chunk = 8 * (1 << 20)
+    jax.block_until_ready(reshard_streaming(
+        big, dst_sh, chunk_bytes=s_chunk, max_in_flight=2))  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = reshard_streaming(big, dst_sh, chunk_bytes=s_chunk,
+                                max_in_flight=2)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    results["reshard_large_mb_s"] = sbytes / dt / 1e6
+    print(json.dumps({"row": "reshard_large_mb_s",
+                      "value": round(results["reshard_large_mb_s"], 2)}))
+
+    # fused in-program grad sync: whole train step (fwd+bwd+two-level
+    # int8-EF sync+apply) as ONE compiled XLA program on the emulated
+    # 2x2 hierarchical mesh — no Python between collectives. A second
+    # row gates the acceptance claim head-on: the same fwd+bwd+EF-sync
+    # as one fused program vs as the staged dispatch chain (grad program,
+    # then sync program — PR-12 shape) at matched in-process topology.
+    import jax.numpy as jnp
+    import optax
+    from ray_tpu.parallel import mesh as mesh_lib
+    from ray_tpu.train import spmd
+    from ray_tpu.util.collective import QuantizedAllreduce
+    from ray_tpu.util.collective.hierarchy import (Topology,
+                                                   hier_allreduce_ef_program)
+    from ray_tpu.utils.jax_compat import shard_map
+
+    mesh = mesh_lib.build_hierarchical_mesh(
+        {"dp": 4}, devices=jax.devices()[:4],
+        topology=Topology(inter=2, intra=2))
+    gbytes = payload_mb * (1 << 20)
+    cols = 1024
+    rows_n = gbytes // 4 // cols
+    quant_ef2 = QuantizedAllreduce(dtype="int8", chunk=4096,
+                                   error_feedback=True)
+
+    def _loss(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    ct = spmd.compile_train(
+        _loss, lambda k: {"w": jnp.zeros((rows_n, cols), jnp.float32)},
+        {"w": P()}, mesh, optimizer=optax.sgd(1e-3),
+        grad_quantize=quant_ef2)
+    state = ct.init_fn(jax.random.key(0))
+    ef = ct.init_ef_fn()
+    batch = jax.device_put(
+        np.random.default_rng(11).standard_normal(
+            (4, rows_n), dtype=np.float32),
+        NamedSharding(mesh, P((*mesh_lib.DP_SUB_AXES, "fsdp"))))
+    state, m, ef = ct.step_fn(state, batch, ef)  # warm: compile
+    jax.block_until_ready(m["loss"])
+    best_dt = float("inf")  # best-of-trials: CPU-steal noise rejection
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m, ef = ct.step_fn(state, batch, ef)
+        jax.block_until_ready(m["loss"])
+        best_dt = min(best_dt, (time.perf_counter() - t0) / iters)
+    results["fused_grad_sync_steps_per_s"] = 1.0 / best_dt
+    print(json.dumps({"row": "fused_grad_sync_steps_per_s",
+                      "value": round(results["fused_grad_sync_steps_per_s"],
+                                     2), "dt_s": round(best_dt, 4)}))
+
+    # staged chain at matched topology: grad program -> EF sync program
+    topo = mesh_lib.hier_topology(mesh)
+    dp_spec = P(mesh_lib.DP_SUB_AXES)
+    n_el = rows_n * cols
+    w_rep = jax.device_put(jnp.zeros((rows_n, cols), jnp.float32),
+                           NamedSharding(mesh, P()))
+
+    def _local_grad(w, b):
+        l, g = jax.value_and_grad(_loss)({"w": w}, b)
+        return g["w"].reshape(1, -1), l[None]
+
+    grad_fn = jax.jit(shard_map(_local_grad, mesh=mesh,
+                                in_specs=(P(), dp_spec),
+                                out_specs=(dp_spec, dp_spec),
+                                check_vma=False))
+    stage_sync = jax.jit(shard_map(
+        hier_allreduce_ef_program(topo, quant_ef2), mesh=mesh,
+        in_specs=(dp_spec, dp_spec), out_specs=(dp_spec, dp_spec),
+        check_vma=False))
+    s_res = jax.device_put(jnp.zeros((4, n_el // 2), jnp.float32),
+                           NamedSharding(mesh, dp_spec))
+
+    def staged_once():
+        g, _l = grad_fn(w_rep, batch)
+        s, _r = stage_sync(g, s_res)
+        return s
+
+    jax.block_until_ready(staged_once())  # warm
+    st2 = ct.init_fn(jax.random.key(1))
+    jax.block_until_ready(ct.sync_fn(st2, batch)[0])  # warm fused sync
+    fused_dt = staged_dt = float("inf")
+    for _ in range(3):  # interleaved: both sides see the same CPU steal
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = ct.sync_fn(st2, batch)
+        jax.block_until_ready(out[0])
+        fused_dt = min(fused_dt, (time.perf_counter() - t0) / iters)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s = staged_once()
+        jax.block_until_ready(s)
+        staged_dt = min(staged_dt, (time.perf_counter() - t0) / iters)
+    results["fused_vs_staged_sync_x"] = staged_dt / fused_dt
+    print(json.dumps({"row": "fused_vs_staged_sync_x",
+                      "value": round(results["fused_vs_staged_sync_x"], 3),
+                      "fused_dt_s": round(fused_dt, 4),
+                      "staged_dt_s": round(staged_dt, 4)}))
+
     report = {
         "metrics": {k: round(v, 2) for k, v in results.items()},
         "unit": "*_mb_s: MB/s, *_per_s: steps/s (all higher is better)",
@@ -313,7 +432,23 @@ def collective_suite(out_path: str | None = None, payload_mb: int = 8,
                         "their virtual CPU devices the fast local fabric",
             "acceptance": "hier_allreduce_mb_s > allreduce_mb_s and "
                           "quant_allreduce_mb_s >= 1.5x allreduce_mb_s "
-                          "at matched payload",
+                          "at matched payload; fused_grad_sync_steps_per_s "
+                          ">= grad_sync_steps_per_s (the in-program "
+                          "schedule must not lose to the staged one)",
+            "fused_grad_sync_steps_per_s":
+                "train.spmd.compile_train fused step on the in-process "
+                "(dp_inter, dp_intra) hierarchical mesh: fwd+bwd, "
+                "RS(intra)/int8-EF-AR(inter)/AG(intra), optimizer apply "
+                "— one XLA program per step, zero host round trips",
+            "reshard_large_mb_s":
+                "collective.reshard_streaming of a 64 MB host leaf "
+                "through an 8 MB chunk budget (max_in_flight=2): the "
+                "bounded-host-memory restore path at full pipeline rate",
+            "fused_vs_staged_sync_x":
+                "dt(staged grad+EF-sync dispatch chain) / dt(fused "
+                "one-program grad+EF-sync), interleaved best-of-trials "
+                "at matched in-process topology — >= 1.0 is the "
+                "'fusion never loses to staging' acceptance gate",
         },
     }
     if out_path:

@@ -54,9 +54,11 @@ class NodeInfo:
         # None for the head's own node and for daemons predating the view
         self.sched_addr = None
         # gossiped node-daemon state (resource_view_delta): the daemon's
-        # own version counter and its warm lease-pool idle count
+        # own version counter, its warm lease-pool idle count, and the
+        # pool's per-shape composition (None until the daemon gossips one)
         self.view_version = 0
         self.pool_idle = 0
+        self.pool_shapes = None
         # flight recorder: when the last delta arrived (feeds the
         # cluster_view_staleness_s gauge), the daemon's lifetime scheduler
         # counters, and its reported gossip health (view_age_s etc.)
@@ -606,7 +608,8 @@ class Head:
         async def resource_view_delta(version, idle_workers, labels=None,
                                       events=None, stats=None, gossip=None,
                                       metrics=None, epoch=None,
-                                      leased_workers=None, objects=None):
+                                      leased_workers=None, objects=None,
+                                      pool_shapes=None):
             """Node-daemon gossip: its lease-pool state changed. Stale
             versions (a reconnect replaying an old delta) are ignored.
             The reply acks the highest flight-recorder event seq merged —
@@ -682,6 +685,11 @@ class Head:
             if version > node.view_version:
                 node.view_version = version
                 node.pool_idle = idle_workers
+                if pool_shapes is not None:
+                    # per-shape pool composition: broadcast in the view so
+                    # peer-spillback referrals name peers actually holding
+                    # a matching warm worker (cuts dead-referral hops)
+                    node.pool_shapes = pool_shapes
                 if labels:
                     node.labels.update(labels)
                 self._view_changed()
@@ -2779,7 +2787,8 @@ class Head:
                 total=n.resources, labels=n.labels,
                 idle_workers=n.pool_idle, sched_addr=n.sched_addr,
                 data_addr=n.data_addr, is_head=n.is_head,
-                store_frac=round(frac, 4) if frac is not None else None))
+                store_frac=round(frac, 4) if frac is not None else None,
+                pool_shapes=n.pool_shapes))
         return {"version": self._view_seq, "nodes": nodes,
                 "epoch": self.cluster_epoch}
 
@@ -2848,7 +2857,8 @@ class Head:
                     {"node_id": e["node_id"],
                      "sched_addr": tuple(e["sched_addr"]),
                      "idle_workers": e.get("idle_workers", 0),
-                     "labels": e.get("labels") or {}}
+                     "labels": e.get("labels") or {},
+                     "pool_shapes": e.get("pool_shapes")}
                     for e in cands[:k]]}
 
     def _dir_record_scope(self, rec: dict, nshards: int):

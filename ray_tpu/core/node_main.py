@@ -692,6 +692,18 @@ class NodeDaemon:
             drained_spans = _tracing.drain_push_spans()
             metrics_snap = _metrics.push_payload(drained_spans)
         self._last_gossip_ts = now
+        # per-shape composition of the warm pool (the exact sorted
+        # (resource, amount) tuples _pool_take matches on): broadcast via
+        # the view so peer-spillback referrals can skip peers that
+        # provably hold no matching warm worker. Always sent (possibly
+        # empty) — an empty list is a real signal ("warm but wrong-shaped
+        # pools elsewhere won't help you"), None would mean "unknown".
+        shape_counts: Dict[tuple, int] = {}
+        for ent in self.pool_idle:
+            sh = tuple(tuple(p) for p in (ent.get("shape") or ()))
+            shape_counts[sh] = shape_counts.get(sh, 0) + 1
+        pool_shapes = [[[list(p) for p in sh], c]
+                       for sh, c in sorted(shape_counts.items())]
         try:
             fut = self.conn.request_future(
                 "resource_view_delta", version=self._gossip_version,
@@ -699,7 +711,8 @@ class NodeDaemon:
                 leased_workers=len(self.pool_leases),
                 events=events, stats=stats,
                 gossip=gossip, metrics=metrics_snap,
-                epoch=self.head_epoch, objects=dir_out or None)
+                epoch=self.head_epoch, objects=dir_out or None,
+                pool_shapes=pool_shapes)
         except Exception:
             self._dir_out = dir_out + self._dir_out
             if drained_spans:

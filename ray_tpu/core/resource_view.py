@@ -56,18 +56,48 @@ def make_entry(node_id_hex: str, *, version: int, free: Dict[str, float],
                total: Dict[str, float], labels: Dict[str, str],
                idle_workers: int = 0, sched_addr=None,
                data_addr=None, is_head: bool = False,
-               store_frac=None) -> dict:
+               store_frac=None, pool_shapes=None) -> dict:
     # data_addr: the node's object data server — consumers of the gossiped
     # object directory resolve pull sources from the cached view instead
     # of asking the head (host None = "the head's host", substituted by
     # each consumer from its own route to the head).
     # store_frac: that store's used/capacity fraction (None = unknown) —
     # the data plane's live memory-pressure signal.
+    # pool_shapes: per-shape composition of the node's warm lease pool,
+    # [[shape-pairs, count], ...] (shape = sorted (resource, amount)
+    # pairs, the daemon's exact _pool_take key). None = the daemon
+    # gossips no composition (legacy) — referral quality unknown.
     return {"node_id": node_id_hex, "version": version, "free": dict(free),
             "total": dict(total), "labels": dict(labels),
             "idle_workers": idle_workers, "sched_addr": sched_addr,
             "data_addr": data_addr, "is_head": is_head,
-            "store_frac": store_frac}
+            "store_frac": store_frac, "pool_shapes": pool_shapes}
+
+
+def pool_shape_key(resources: Dict[str, float]) -> tuple:
+    """Canonical pool-shape key for a resource ask — the same sorted
+    (name, amount) pairs the daemon keys its warm pool by, normalized so
+    int/float spellings of the same ask compare equal across the wire."""
+    return tuple(sorted((str(k), float(v)) for k, v in resources.items()))
+
+
+def has_matching_shape(pool_shapes, resources: Dict[str, float]):
+    """Whether a gossiped pool composition holds a warm worker of EXACTLY
+    the asked shape (pool-take matches exact shapes, so anything else is
+    a dead referral). None = composition unknown (the peer gossips no
+    shapes) — callers treat that as 'maybe'."""
+    if pool_shapes is None:
+        return None
+    ask = pool_shape_key(dict(resources))
+    for row in pool_shapes:
+        try:
+            shape, count = row[0], row[1]
+        except (TypeError, IndexError, KeyError):
+            continue
+        if count and tuple(
+                (str(k), float(v)) for k, v in shape) == ask:
+            return True
+    return False
 
 
 class ClusterView:
@@ -249,32 +279,41 @@ class ClusterView:
         gossiped pools show warm idle workers, warmest first. Full view
         entries are checked against totals; digest candidate rows (nodes
         outside this consumer's interest shards) carry no totals, so only
-        labels gate them — the peer's own pool-take decides the rest."""
+        labels gate them — the peer's own pool-take decides the rest.
+
+        Referral quality: peers that gossip pool composition
+        (`pool_shapes`) and provably hold NO warm worker of the asked
+        shape are dropped — pool-take matches exact shapes, so referring
+        to them is a guaranteed cold refusal hop. Peers whose composition
+        shows a match rank above peers that don't gossip shapes."""
         if limit <= 0:
             return []
         # full entries are authoritative where we hold them: a digest row
         # must never resurrect a node the entry disqualified
         seen = set(self.entries)
         rows = []
-        for e in self.entries.values():
+
+        def _consider(e, check_total: bool):
             if (not e.get("sched_addr") or not e.get("idle_workers")
                     or e["node_id"] == exclude):
-                continue
+                return
             if not matches_labels(e.get("labels") or {}, label_selector):
-                continue
-            if not fits(e.get("total") or {}, resources):
-                continue
+                return
+            if check_total and not fits(e.get("total") or {}, resources):
+                return
+            match = has_matching_shape(e.get("pool_shapes"), resources)
+            if match is False:
+                return  # dead referral: warm pool holds no such shape
             rows.append({"node_id": e["node_id"],
                          "sched_addr": tuple(e["sched_addr"]),
-                         "idle_workers": e.get("idle_workers", 0)})
+                         "idle_workers": e.get("idle_workers", 0),
+                         "shape_match": match})
+
+        for e in self.entries.values():
+            _consider(e, check_total=True)
         for d in (self.digest or {}).get("candidates") or ():
-            if (d["node_id"] in seen or d["node_id"] == exclude
-                    or not d.get("sched_addr") or not d.get("idle_workers")):
-                continue
-            if not matches_labels(d.get("labels") or {}, label_selector):
-                continue
-            rows.append({"node_id": d["node_id"],
-                         "sched_addr": tuple(d["sched_addr"]),
-                         "idle_workers": d.get("idle_workers", 0)})
-        rows.sort(key=lambda r: r["idle_workers"], reverse=True)
+            if d["node_id"] not in seen:
+                _consider(d, check_total=False)
+        rows.sort(key=lambda r: (bool(r["shape_match"]),
+                                 r["idle_workers"]), reverse=True)
         return rows[:limit]

@@ -194,8 +194,10 @@ class ActorStage(Stage):
     """Callable-class UDF over a shared actor pool (reference
     ActorPoolMapOperator). In-flight cap = pool size by default: one
     outstanding call per actor keeps the pool busy without queue blowup.
-    No prefetch target: calls round-robin the pool, so the consuming
-    node isn't known until submit."""
+    Round-robin is deterministic, so the prefetch target PEEKS the next
+    assignment (`_rr` increments only at submit): input blocks start
+    pulling toward the very node whose actor will consume them, like
+    lease-path stages."""
 
     def __init__(self, op: Any):
         super().__init__(f"ActorMap(x{op.concurrency})",
@@ -206,11 +208,40 @@ class ActorStage(Stage):
         self.pool = [_BlockActor.remote(op.fn)
                      for _ in range(max(op.concurrency, 1))]
         self._rr = 0
+        self._addr_cache: Dict[int, Any] = {}  # pool idx -> data addr
 
     def submit(self, ref: Any) -> Any:
         actor = self.pool[self._rr % len(self.pool)]
         self._rr += 1
         return actor.apply.remote(ref, self._op.batch_format)
+
+    def prefetch_target(self):
+        """Data-server address of the NEXT round-robin actor's node.
+        Actors are pinned to their node for life, so resolution (one
+        head RPC + a view lookup) is memoized per pool slot."""
+        if not self.pool:
+            return None
+        i = self._rr % len(self.pool)
+        if i in self._addr_cache:
+            return self._addr_cache[i]
+        addr = None
+        try:
+            from ray_tpu.core.api import _global_client
+            from ray_tpu.core.ids import NodeID
+
+            client = _global_client()
+            reply = client.head_request(
+                "get_actor_address",
+                actor_id=self.pool[i]._actor_id.binary())
+            node_id = reply.get("node_id")
+            if reply.get("state") != "DEAD" and node_id:
+                addr = client.cluster_view.data_addr_of(
+                    NodeID(node_id).hex())
+        except Exception:
+            addr = None
+        if addr is not None:  # don't cache failures: actor may be pending
+            self._addr_cache[i] = addr
+        return addr
 
     def close(self) -> None:
         import ray_tpu

@@ -28,6 +28,14 @@ P = PartitionSpec
 # Canonical mesh axis names, outermost (DCN-tolerant) to innermost (ICI-only).
 MESH_AXES = ("pp", "dp", "fsdp", "sp", "ep", "tp")
 
+# Hierarchical data-parallel sub-axes: the dp axis expressed as
+# (slow-fabric hosts) x (fast-fabric local devices), so a compiled train
+# step can emit reduce-scatter/all-gather over `dp_intra` (ICI) and keep
+# the `dp_inter` (DCN) hop shard-sized — the two-level schedule INSIDE
+# the program instead of staged in Python (util/collective/hierarchy.py).
+DP_SUB_AXES = ("dp_inter", "dp_intra")
+HIER_MESH_AXES = ("pp", "dp_inter", "dp_intra", "fsdp", "sp", "ep", "tp")
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
@@ -146,6 +154,116 @@ def build_mesh(
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, MESH_AXES)
+
+
+def build_hierarchical_mesh(
+    config: Union[MeshConfig, Mapping[str, int], None] = None,
+    devices: Optional[Sequence[Any]] = None,
+    topology: Optional[Any] = None,
+) -> Mesh:
+    """`build_mesh` variant whose dp axis is split into the
+    `(dp_inter, dp_intra)` sub-axes of a hosts x local-devices
+    `collective.Topology`.
+
+    Flat-dp callers are untouched: `build_mesh` still produces the
+    canonical single-`dp` mesh, and every spec written against it keeps
+    working. This factory is opt-in for the fused hierarchical gradient
+    sync (`train/spmd.py`): the dp degree must equal
+    `topology.inter * topology.intra`, and the dp slot of the device
+    array is laid out row-major hosts x local — the same layout
+    `Topology.mesh` uses — so `dp_inter` groups cross the slow fabric
+    and `dp_intra` groups stay on the fast one.
+
+    `topology` defaults to the physical layout of the dp devices
+    (`topology_from_devices` shape: processes x min local chips); on a
+    single-process CI backend that degenerates to inter=1, so tests pass
+    an explicit `Topology(2, 2)` to emulate 2 hosts x 2 devices.
+    """
+    from ray_tpu.util.collective.hierarchy import Topology
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if config is None:
+        config = MeshConfig(dp=len(devices))
+    if isinstance(config, Mapping):
+        config = MeshConfig(**dict(config))
+    config = config.resolved(len(devices))
+    if topology is None:
+        phys = topology_from_devices(devices)
+        if config.dp % max(phys.intra, 1) == 0 and phys.intra > 1:
+            topology = Topology(inter=config.dp // phys.intra,
+                                intra=phys.intra)
+        else:
+            topology = Topology(inter=config.dp, intra=1)
+    if topology.inter * topology.intra != config.dp:
+        raise ValueError(
+            f"dp={config.dp} devices cannot form a "
+            f"{topology.inter}x{topology.intra} (inter x intra) topology")
+    d = config.degrees()
+    shape = (d["pp"], topology.inter, topology.intra, d["fsdp"], d["sp"],
+             d["ep"], d["tp"])
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, HIER_MESH_AXES)
+
+
+def dp_axis_names(mesh: Mesh) -> tuple:
+    """The mesh axes carrying pure data parallelism: the
+    `(dp_inter, dp_intra)` sub-axes on a hierarchical mesh, the single
+    `dp` axis otherwise. Empty when the mesh has neither."""
+    names = tuple(getattr(mesh, "axis_names", ()) or ())
+    if all(a in names for a in DP_SUB_AXES):
+        return DP_SUB_AXES
+    if "dp" in names:
+        return ("dp",)
+    return ()
+
+
+def is_hierarchical_mesh(mesh: Mesh) -> bool:
+    return dp_axis_names(mesh) == DP_SUB_AXES
+
+
+def hier_topology(mesh: Mesh):
+    """The `collective.Topology` a hierarchical mesh's dp sub-axes
+    express, with axis names bound to the MESH axis names (so program
+    builders written against `Topology.inter_axis`/`intra_axis` lower
+    over this mesh directly)."""
+    if not is_hierarchical_mesh(mesh):
+        raise ValueError(
+            f"mesh axes {tuple(mesh.axis_names)} carry no "
+            f"(dp_inter, dp_intra) sub-axes; build_hierarchical_mesh makes "
+            f"one")
+    from ray_tpu.util.collective.hierarchy import Topology
+
+    return Topology(inter=int(mesh.shape[DP_SUB_AXES[0]]),
+                    intra=int(mesh.shape[DP_SUB_AXES[1]]),
+                    inter_axis=DP_SUB_AXES[0], intra_axis=DP_SUB_AXES[1])
+
+
+def rules_for_mesh(mesh: Mesh,
+                   rules: Optional["LogicalRules"] = None) -> dict:
+    """DEFAULT_RULES (plus overrides) rewritten for `mesh`'s dp spelling:
+    on a hierarchical mesh every rule naming `dp` names the
+    `(dp_inter, dp_intra)` pair instead, so logical specs like "batch"
+    shard over both sub-axes without model code changing."""
+    merged = {**DEFAULT_RULES, **(rules or {})}
+    if not is_hierarchical_mesh(mesh):
+        return merged
+    out = {}
+    for k, v in merged.items():
+        axes = (v,) if isinstance(v, str) else v
+        if axes and "dp" in axes:
+            axes = tuple(a for ax in axes
+                         for a in (DP_SUB_AXES if ax == "dp" else (ax,)))
+            out[k] = axes
+        else:
+            out[k] = v
+    return out
 
 
 # ---------------------------------------------------------------------------

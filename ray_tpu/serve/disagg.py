@@ -313,6 +313,13 @@ class DisaggLLMServer(LLMServer):
             # _ensure_prefix ran before it): the deadline must cover the
             # full fetch window PLUS the decode budget
             timeout=self.prefill_timeout_s + 120.0)
+        # Degraded-mode decode-local prefills (prefill pool down, store
+        # miss) are prefix-cache material like any other: publish them so
+        # the NEXT replica to see this prefix warm-starts from the store
+        # instead of re-prefilling. Content-addressed dedup in
+        # maybe_publish makes the warm-path case (prefix was imported,
+        # nothing newly computed) a no-op.
+        self._publish_prefix(self.engine, ids)
         return {
             "object": "text_completion",
             "choices": [{"text": out["text"], "index": 0,

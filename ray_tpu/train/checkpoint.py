@@ -232,6 +232,107 @@ def load_sharded(path: str) -> tuple:
     return out, manifest
 
 
+class _LazyNpz:
+    """Row-range reads from an UNCOMPRESSED npz (what `save_sharded`
+    writes: `np.savez` stores members, it does not deflate them): the
+    npy header of a member is parsed once, after which any leading-dim
+    row range seek-reads straight out of the zip — no blob ever
+    materializes whole. (A compressed member would still read correctly:
+    `ZipExtFile.seek` decompresses forward, trading speed, not memory.)
+    """
+
+    def __init__(self, path: str):
+        self._path = path
+        self._zf = None
+        self._meta: Dict[str, tuple] = {}  # member -> (shape, dtype, off)
+
+    def _zip(self):
+        import zipfile
+
+        if self._zf is None:
+            self._zf = zipfile.ZipFile(_fs.open(self._path, "rb"))
+        return self._zf
+
+    def _header(self, name: str) -> tuple:
+        import numpy as np
+
+        if name not in self._meta:
+            with self._zip().open(name + ".npy") as f:
+                version = np.lib.format.read_magic(f)
+                if version == (1, 0):
+                    shape, fortran, dtype = (
+                        np.lib.format.read_array_header_1_0(f))
+                else:
+                    shape, fortran, dtype = (
+                        np.lib.format.read_array_header_2_0(f))
+                if fortran:
+                    raise ValueError(
+                        f"{name}: fortran-order member has no row-major "
+                        f"row ranges; save_sharded never writes these")
+                self._meta[name] = (tuple(shape), dtype, f.tell())
+        return self._meta[name]
+
+    def read_rows(self, name: str, r0: int, r1: int):
+        """Rows [r0, r1) of member `name`'s leading dim (the full scalar
+        for 0-d members)."""
+        import numpy as np
+
+        shape, dtype, off = self._header(name)
+        if not shape:
+            with self._zip().open(name + ".npy") as f:
+                f.seek(off)
+                return np.frombuffer(f.read(dtype.itemsize),
+                                     dtype).reshape(())
+        row = int(np.prod(shape[1:], dtype=np.int64)) * dtype.itemsize
+        with self._zip().open(name + ".npy") as f:
+            f.seek(off + r0 * row)
+            buf = f.read((r1 - r0) * row)
+        return np.frombuffer(buf, dtype).reshape((r1 - r0,) + shape[1:])
+
+
+def open_sharded(path: str) -> tuple:
+    """Lazy view of a sharded checkpoint: ({leaf_key: WindowedReader},
+    merged manifest) with NO array data loaded. Each reader's
+    `.read(window)` seek-reads only the intersecting rows of the
+    intersecting chunk blobs, so the streaming restore path
+    (`collective.reshard_streaming`, `restore_state_sharded` with
+    `stream_chunk_bytes=`) holds chunk-scale host memory where
+    `load_sharded` gathers O(model size). Coverage is validated up
+    front, exactly like `load_sharded`."""
+    from ray_tpu.util.collective.reshard import WindowedReader
+
+    manifests = _read_process_manifests(path)
+    manifest = dict(manifests[0])
+    manifest["chunks"] = [c for m in manifests for c in m["chunks"]]
+    manifest["num_save_processes"] = len(manifests)
+    npzs = [_LazyNpz(_fs.join(path, f"shards_p{p:05d}.npz"))
+            for p in range(len(manifests))]
+
+    def _loader(key, r0, r1):
+        proc, blob = key
+        return npzs[proc].read_rows(blob, r0, r1)
+
+    per_leaf: Dict[str, list] = {}
+    windows: Dict[str, set] = {}
+    for p, pm in enumerate(manifests):
+        for chunk in pm["chunks"]:
+            win = tuple((int(a), int(b)) for a, b in chunk["index"])
+            per_leaf.setdefault(chunk["leaf"], []).append(
+                (win, (p, chunk["blob"])))
+            windows.setdefault(chunk["leaf"], set()).add(win)
+    readers: Dict[str, Any] = {}
+    for key, spec in manifest["params"].items():
+        shape = tuple(spec["shape"])
+        if key not in per_leaf or not _windows_cover(windows[key], shape):
+            raise ValueError(
+                f"sharded checkpoint {path} is missing data for {key!r} "
+                f"(windows {sorted(windows.get(key, ()))} do not cover "
+                f"shape {shape})")
+        readers[key] = WindowedReader(shape, _np_dtype(spec["dtype"]),
+                                      per_leaf[key], _loader)
+    return readers, manifest
+
+
 def _windows_cover(windows: set, shape: tuple) -> bool:
     """Whether axis-aligned index windows jointly cover `shape`, without
     materializing a per-element mask (restore-time memory matters: the

@@ -1,7 +1,8 @@
 from ray_tpu.util.collective.collective import (  # noqa: F401
-    Backend, QuantizedAllreduce, ReduceOp, Topology, XlaCollectiveGroup,
-    allgather, allreduce, barrier, broadcast, create_collective_group,
-    destroy_collective_group, get_collective_group_size, get_group,
-    get_rank, init_collective_group, is_group_initialized,
-    rebuild_collective_group, recv, reduce, reducescatter, reshard,
-    reshard_tree, send, synchronize)
+    Backend, QuantizedAllreduce, ReduceOp, Topology, WindowedReader,
+    XlaCollectiveGroup, allgather, allreduce, barrier, broadcast,
+    create_collective_group, destroy_collective_group,
+    get_collective_group_size, get_group, get_rank, init_collective_group,
+    is_group_initialized, rebuild_collective_group, recv, reduce,
+    reducescatter, reshard, reshard_streaming, reshard_tree, send,
+    synchronize)

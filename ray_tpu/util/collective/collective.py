@@ -22,7 +22,8 @@ import numpy as np
 from ray_tpu.util.collective.hierarchy import Topology
 from ray_tpu.util.collective.kv_group import KVCollectiveGroup
 from ray_tpu.util.collective.quantize import QuantizedAllreduce
-from ray_tpu.util.collective.reshard import reshard, reshard_tree
+from ray_tpu.util.collective.reshard import (WindowedReader, reshard,
+                                             reshard_streaming, reshard_tree)
 from ray_tpu.util.collective.types import Backend, ReduceOp
 from ray_tpu.util.collective.xla_group import XlaCollectiveGroup
 
@@ -259,5 +260,6 @@ __all__ = [
     "get_collective_group_size", "allreduce", "reduce", "broadcast",
     "allgather", "reducescatter", "barrier", "send", "recv", "synchronize",
     "ReduceOp", "Backend", "XlaCollectiveGroup",
-    "Topology", "QuantizedAllreduce", "reshard", "reshard_tree",
+    "Topology", "QuantizedAllreduce", "reshard", "reshard_streaming",
+    "reshard_tree", "WindowedReader",
 ]

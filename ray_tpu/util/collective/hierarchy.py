@@ -249,6 +249,50 @@ def hier_allreduce_ef_program(topo: Topology, quantize):
     return fn
 
 
+def hier_grad_sync_program(topo: Topology, quantize=None,
+                           error_feedback: bool = False):
+    """Two-level gradient-sync body for use INSIDE a larger manual region
+    (the fused train step): unlike `hier_allreduce_program` the input is
+    this device's flat f32 vector [n] without the leading block dim
+    (n % world == 0; quantized inter hop additionally needs
+    n/intra % chunk == 0 — pad with `quantize.padded_size`), and the EF
+    residual / stochastic-rounding key thread through as arguments so the
+    train step can carry them as step-fn state.
+
+    Returned fn:
+        fn(v, key=None)            -> summed v            (no EF)
+        fn(v, residual, key=None)  -> (summed, new_resid) (EF; residual
+                                      at shard granularity [n/intra])
+    The sum is NOT averaged; divide by `topo.world` at the call site.
+    """
+    from jax import lax
+
+    if error_feedback and quantize is None:
+        raise ValueError("error_feedback requires a quantize config")
+    intra, inter = topo.intra_axis, topo.inter_axis
+
+    def fn(v, residual=None, key=None):
+        s = (lax.psum_scatter(v, intra, scatter_dimension=0, tiled=True)
+             if topo.intra > 1 else v)
+        new_r = None
+        if topo.inter > 1:
+            if quantize is not None:
+                if error_feedback:
+                    s, new_r = quantize.inter_allreduce_ef(
+                        s, residual, inter, key=key)
+                else:
+                    s = quantize.inter_allreduce(s, inter, key=key)
+            else:
+                s = lax.psum(s, inter)
+        elif error_feedback:
+            new_r = residual * 0  # no inter hop => nothing was quantized
+        if topo.intra > 1:
+            s = lax.all_gather(s, intra, tiled=True)
+        return (s, new_r) if error_feedback else s
+
+    return fn
+
+
 def hier_reduce_scatter_program(topo: Topology, op: ReduceOp = ReduceOp.SUM):
     """Two-level reduce-scatter body: input [1, n] per device; output this
     device's fully-reduced shard [1, n/world]. The inter hop moves only
@@ -314,7 +358,8 @@ def device_rows_by_process(devices: Sequence[Any]) -> List[List[Any]]:
 
 __all__ = [
     "Topology", "infer_topology", "hier_allreduce_program",
-    "hier_allreduce_ef_program", "hier_reduce_scatter_program",
+    "hier_allreduce_ef_program", "hier_grad_sync_program",
+    "hier_reduce_scatter_program",
     "hier_all_gather_program", "gathered_reduce", "device_rows_by_process",
     "account_collective", "account_quant_saving", "ring_perm",
 ]

@@ -63,6 +63,15 @@ class QuantizedAllreduce:
     dtype: str = "int8"
     chunk: int = 4096
     error_feedback: bool = True
+    # Stochastic rounding of the wire quantization: round to the two
+    # nearest grid points with probability proportional to proximity, so
+    # E[dequant(quant(x))] == x per element — sub-quantum gradient
+    # components survive in expectation instead of rounding to zero
+    # every step. Engaged only when the caller supplies a PRNG `key`
+    # (the fused train step derives one from the step counter + member
+    # rank); without a key the deterministic round-to-nearest runs, so
+    # replay/chaos determinism contracts hold unchanged.
+    stochastic_rounding: bool = False
 
     def __post_init__(self):
         if self.dtype not in _WIRE:
@@ -71,6 +80,11 @@ class QuantizedAllreduce:
                 f"{sorted(_WIRE)}")
         if self.chunk <= 0:
             raise ValueError("chunk must be positive")
+        if self.stochastic_rounding and self.dtype != "int8":
+            raise ValueError(
+                "stochastic_rounding rounds on the uniform int8 grid; the "
+                "fp8 grid is non-uniform (per-exponent quantum) and has no "
+                "unbiased dither here — use dtype='int8' or disable it")
 
     # ------------------------------------------------------------ properties
     @property
@@ -82,7 +96,8 @@ class QuantizedAllreduce:
         return _WIRE[self.dtype][1]
 
     def key(self) -> tuple:
-        return (self.dtype, self.chunk, self.error_feedback)
+        return (self.dtype, self.chunk, self.error_feedback,
+                self.stochastic_rounding)
 
     def padded_size(self, n: int) -> int:
         """Smallest multiple of `chunk` holding n elements."""
@@ -95,14 +110,27 @@ class QuantizedAllreduce:
         return np_ * self.wire_dtype.itemsize + (np_ // self.chunk) * 4
 
     # ------------------------------------------------------- in-program math
-    def quantize(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """Flat f32 [n] (n % chunk == 0) -> (q [nc, chunk], scales [nc, 1])."""
+    def quantize(self, x, key=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Flat f32 [n] (n % chunk == 0) -> (q [nc, chunk], scales [nc, 1]).
+
+        With `stochastic_rounding` set AND a PRNG `key` given, int8
+        rounding is `floor(y + u)` for u ~ U[0,1) — unbiased per element
+        (P(ceil) equals the fractional part). Each member must fold its
+        own rank into the key: the dither must differ across members or
+        their errors correlate instead of averaging out.
+        """
         xc = x.reshape(-1, self.chunk)
         amax = jnp.max(jnp.abs(xc), axis=1, keepdims=True)
         scale = jnp.where(amax > 0, amax / self.qmax, 1.0)
         if self.dtype == "int8":
-            q = jnp.clip(jnp.round(xc / scale), -self.qmax,
-                         self.qmax).astype(jnp.int8)
+            y = xc / scale
+            if self.stochastic_rounding and key is not None:
+                import jax  # deferred: keep module import-light
+
+                y = jnp.floor(y + jax.random.uniform(key, y.shape))
+            else:
+                y = jnp.round(y)
+            q = jnp.clip(y, -self.qmax, self.qmax).astype(jnp.int8)
         else:
             # fp8 cast rounds; clip first so overflow saturates predictably
             q = jnp.clip(xc / scale, -self.qmax,
@@ -113,20 +141,20 @@ class QuantizedAllreduce:
         return (q.astype(jnp.float32) * scale).reshape(-1)
 
     # -------------------------------------------------- inter-hop allreduce
-    def inter_allreduce(self, x, axis_name: str):
+    def inter_allreduce(self, x, axis_name: str, key=None):
         """Quantized allreduce over `axis_name` via all-gather: the wire
         carries the quantized blocks (the HLO's all-gather operand dtype
         IS the wire dtype); dequant + f32 accumulation happen locally in
         source-rank order. Fused/TPU lowering — one shard_map program."""
-        q, scale = self.quantize(x)
+        q, scale = self.quantize(x, key=key)
         qg = lax.all_gather(q, axis_name)        # [world, nc, chunk] wire dtype
         sg = lax.all_gather(scale, axis_name)    # [world, nc, 1] f32 (tiny)
         return (qg.astype(jnp.float32) * sg).sum(axis=0).reshape(x.shape)
 
-    def inter_allreduce_ef(self, x, residual, axis_name: str):
+    def inter_allreduce_ef(self, x, residual, axis_name: str, key=None):
         """Error-feedback variant: returns (reduced, new_residual)."""
         xc = x + residual
-        q, scale = self.quantize(xc)
+        q, scale = self.quantize(xc, key=key)
         new_residual = xc - self.dequantize(q, scale).reshape(x.shape)
         qg = lax.all_gather(q, axis_name)
         sg = lax.all_gather(scale, axis_name)
@@ -134,7 +162,7 @@ class QuantizedAllreduce:
         return out, new_residual
 
     def ring_allreduce(self, x, axis_name: str, world: int,
-                       residual: Optional[jnp.ndarray] = None):
+                       residual: Optional[jnp.ndarray] = None, key=None):
         """Quantized allreduce over `axis_name` via a ppermute ring.
 
         Same wire bytes as the gather form, but lowered as world-1
@@ -159,7 +187,7 @@ class QuantizedAllreduce:
         from ray_tpu.util.collective.hierarchy import ring_perm
 
         xc = x if residual is None else x + residual
-        q, scale = self.quantize(xc)
+        q, scale = self.quantize(xc, key=key)
         if residual is not None:
             new_residual = xc - self.dequantize(q, scale).reshape(x.shape)
         nc, C = q.shape

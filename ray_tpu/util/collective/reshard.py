@@ -92,6 +92,182 @@ def reshard(arr: Any, dst_sharding, *, src_sharding=None):
         shape, dst_sharding, shards)
 
 
+class WindowedReader:
+    """Duck-typed host source for `reshard_streaming`: `.shape`/`.dtype`
+    plus `.read(window)` assembling the requested global index window
+    from lazily-loaded chunk blobs.
+
+    `chunks` is [(window, key)] in global coordinates
+    (window = ((start, stop), ...) per dim); `loader(key, r0, r1)` must
+    return rows [r0, r1) of that chunk's LEADING dim as an ndarray —
+    e.g. a seek-read of a checkpoint npz member
+    (`checkpoint.open_sharded`), or a `client.get` of an object-store
+    blob, which rides the node PullManager (admission + chunk-pipelined
+    transfer + in-flight dedup across concurrent readers).
+    """
+
+    def __init__(self, shape, dtype, chunks, loader):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self._chunks = [(tuple((int(a), int(b)) for a, b in win), key)
+                        for win, key in chunks]
+        self._loader = loader
+
+    def read(self, window) -> np.ndarray:
+        window = tuple((int(a), int(b)) for a, b in window)
+        out = np.zeros([b - a for a, b in window], self.dtype)
+        if not window:  # scalar leaf: any chunk IS the value
+            for _, key in self._chunks:
+                out[...] = np.asarray(self._loader(key, 0, 1)).reshape(())
+            return out
+        for cwin, key in self._chunks:
+            inter = tuple((max(a, ca), min(b, cb))
+                          for (a, b), (ca, cb) in zip(window, cwin))
+            if any(a >= b for a, b in inter):
+                continue
+            r0, r1 = inter[0][0] - cwin[0][0], inter[0][1] - cwin[0][0]
+            rows = np.asarray(self._loader(key, r0, r1))
+            sub = rows[(slice(None),) + tuple(
+                slice(a - ca, b - ca) for (a, b), (ca, _) in
+                zip(inter[1:], cwin[1:]))]
+            out[tuple(slice(a - wa, b - wa) for (a, b), (wa, _) in
+                      zip(inter, window))] = sub
+        return out
+
+
+# Instrumentation for the most recent reshard_streaming call: peak bytes
+# of live host chunk buffers (the budget the tests assert), chunk count,
+# distinct destination windows. Module-level on purpose — the caller that
+# needs it (tests, benches) runs reshards serially.
+last_stream_stats: dict = {}
+
+
+def reshard_streaming(src: Any, dst_sharding, *, chunk_bytes: int,
+                      max_in_flight: int = 2, out_dtype=None):
+    """`reshard` for leaves larger than host memory: per-destination-
+    window assembly proceeds CHUNK-AT-A-TIME instead of slicing a
+    materialized global array.
+
+    `src` is an ndarray or a duck-typed reader (`.shape`/`.dtype`/
+    `.read(window)` — see `WindowedReader`). Each deduplicated
+    destination window is split along its leading dim into row chunks of
+    at most `chunk_bytes`; a `max_in_flight`-deep prefetch pipeline
+    overlaps the next chunk's host read with the current chunk's
+    `device_put`, so peak host memory is ~`max_in_flight * chunk_bytes`
+    (down to single-row granularity) rather than the leaf size. Chunks
+    are concatenated ON DEVICE into the final shard: the result is
+    bitwise-equal to `reshard` of the same data. `out_dtype` converts
+    per chunk (host cost stays chunk-scale).
+    """
+    import threading
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+
+    if chunk_bytes <= 0:
+        raise ValueError("chunk_bytes must be positive")
+    max_in_flight = max(1, int(max_in_flight))
+    reader = src if hasattr(src, "read") else _HostReader(np.asarray(src))
+    shape = tuple(reader.shape)
+    dtype = np.dtype(out_dtype) if out_dtype is not None else np.dtype(
+        reader.dtype)
+    if not shape:
+        a = np.asarray(reader.read(()), np.dtype(reader.dtype)).astype(
+            dtype, copy=False)
+        last_stream_stats.update(
+            peak_host_bytes=a.nbytes, chunks=1, windows=1)
+        return jax.device_put(a.reshape(()), dst_sharding)
+
+    imap = dst_sharding.addressable_devices_indices_map(shape)
+    windows: dict = {}  # window key -> [devices]
+    for dev, idx in imap.items():
+        idx = idx if idx is not None else tuple(slice(None) for _ in shape)
+        key = tuple((0 if s.start is None else int(s.start),
+                     dim if s.stop is None else int(s.stop))
+                    for s, dim in zip(idx, shape))
+        windows.setdefault(key, []).append(dev)
+
+    tasks = []  # (devices, window-key, sub-window)
+    for key, devs in windows.items():
+        (w0, w1), trailing = key[0], key[1:]
+        row_bytes = dtype.itemsize
+        for a, b in trailing:
+            row_bytes *= (b - a)
+        rows_per = max(1, chunk_bytes // max(1, row_bytes))
+        for r0 in range(w0, w1, rows_per):
+            tasks.append((devs, key, ((r0, min(r0 + rows_per, w1)),)
+                          + trailing))
+        if w0 >= w1:  # degenerate empty window: one empty chunk
+            tasks.append((devs, key, key))
+
+    stats = {"peak_host_bytes": 0, "chunks": 0, "windows": len(windows)}
+    live = {"bytes": 0}
+    lock = threading.Lock()
+
+    def _read(sub):
+        a = np.ascontiguousarray(reader.read(sub))
+        if a.dtype != dtype:
+            a = a.astype(dtype)
+        with lock:
+            live["bytes"] += a.nbytes
+            stats["peak_host_bytes"] = max(stats["peak_host_bytes"],
+                                           live["bytes"])
+        return a
+
+    parts: dict = {}  # window key -> [device -> [chunk arrays]]
+    with ThreadPoolExecutor(max_workers=max_in_flight) as pool:
+        q: deque = deque()
+        ti = 0
+
+        def _fill():
+            nonlocal ti
+            while len(q) < max_in_flight and ti < len(tasks):
+                devs, key, sub = tasks[ti]
+                ti += 1
+                q.append((devs, key, pool.submit(_read, sub)))
+
+        _fill()
+        while q:
+            devs, key, fut = q.popleft()
+            a = fut.result()
+            puts = [jax.device_put(a, d) for d in devs]
+            jax.block_until_ready(puts)  # host buffer free AFTER transfer
+            for d, p in zip(devs, puts):
+                parts.setdefault(key, {}).setdefault(d, []).append(p)
+            with lock:
+                live["bytes"] -= a.nbytes
+            del a
+            stats["chunks"] += 1
+            _fill()
+
+    shards = []
+    for dev, idx in imap.items():
+        idx = idx if idx is not None else tuple(slice(None) for _ in shape)
+        key = tuple((0 if s.start is None else int(s.start),
+                     dim if s.stop is None else int(s.stop))
+                    for s, dim in zip(idx, shape))
+        ps = parts[key][dev]
+        shards.append(ps[0] if len(ps) == 1 else jnp.concatenate(ps, axis=0))
+    last_stream_stats.clear()
+    last_stream_stats.update(stats)
+    return jax.make_array_from_single_device_arrays(
+        shape, dst_sharding, shards)
+
+
+class _HostReader:
+    """`WindowedReader` facade over an in-memory ndarray."""
+
+    def __init__(self, arr: np.ndarray):
+        self._arr = arr
+        self.shape = arr.shape
+        self.dtype = arr.dtype
+
+    def read(self, window) -> np.ndarray:
+        return self._arr[tuple(slice(a, b) for a, b in window)]
+
+
 def reshard_tree(tree: Any, dst_shardings: Any, *,
                  src_shardings: Optional[Any] = None):
     """`reshard` over a pytree; `dst_shardings` must match `tree`'s
@@ -110,4 +286,5 @@ def reshard_tree(tree: Any, dst_shardings: Any, *,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-__all__ = ["reshard", "reshard_tree"]
+__all__ = ["reshard", "reshard_streaming", "reshard_tree",
+           "WindowedReader", "last_stream_stats"]

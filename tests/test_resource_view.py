@@ -484,3 +484,53 @@ def test_2000_virtual_node_sharded_gossip_convergence():
         assert report["max_push"] < 2000 / 4
     finally:
         resource.setrlimit(resource.RLIMIT_NOFILE, (soft, hard))
+
+
+def test_spill_candidates_pool_shape_gating():
+    """Referral quality: a peer whose gossiped pool composition provably
+    holds no warm worker of the asked shape is a dead referral and is
+    dropped; shape-proven peers outrank shape-unknown ones; unknown
+    (no gossip, legacy daemons) stays in as 'maybe'."""
+    from ray_tpu.core.resource_view import has_matching_shape, pool_shape_key
+
+    cpu1 = [[[["CPU", 1.0]], 2]]          # two warm CPU:1 workers
+    cpu4 = [[[["CPU", 4.0]], 1]]          # only a CPU:4 worker
+    view = ClusterView()
+    proven = make_entry("aa", version=1, free={"CPU": 4}, total={"CPU": 4},
+                        labels={}, idle_workers=1,
+                        sched_addr=("127.0.0.1", 1), pool_shapes=cpu1)
+    unknown = make_entry("bb", version=1, free={"CPU": 4}, total={"CPU": 4},
+                         labels={}, idle_workers=5,
+                         sched_addr=("127.0.0.1", 2))   # no shapes gossiped
+    dead = make_entry("cc", version=1, free={"CPU": 4}, total={"CPU": 4},
+                      labels={}, idle_workers=9,
+                      sched_addr=("127.0.0.1", 3), pool_shapes=cpu4)
+    empty = make_entry("dd", version=1, free={"CPU": 4}, total={"CPU": 4},
+                       labels={}, idle_workers=7,
+                       sched_addr=("127.0.0.1", 4), pool_shapes=[])
+    for e in (proven, unknown, dead, empty):
+        view.update(e)
+
+    cands = view.spill_candidates({"CPU": 1}, limit=4)
+    ids = [c["node_id"] for c in cands]
+    # shape-proven first despite fewer idle workers; provably-empty and
+    # wrong-shape pools dropped outright
+    assert ids == ["aa", "bb"]
+    assert cands[0]["shape_match"] is True
+    assert cands[1]["shape_match"] is None
+
+    # digest rows carry the signal too
+    view.digest = {"candidates": [
+        {"node_id": "ee", "sched_addr": ("127.0.0.1", 5),
+         "idle_workers": 3, "labels": {}, "pool_shapes": cpu4},
+        {"node_id": "ff", "sched_addr": ("127.0.0.1", 6),
+         "idle_workers": 1, "labels": {}, "pool_shapes": cpu1},
+    ]}
+    ids = [c["node_id"] for c in view.spill_candidates({"CPU": 1}, limit=4)]
+    assert "ee" not in ids and ids[:2] == ["aa", "ff"]
+
+    # normalization: int/float spellings of the same ask compare equal
+    assert pool_shape_key({"CPU": 1}) == pool_shape_key({"CPU": 1.0})
+    assert has_matching_shape(cpu1, {"CPU": 1}) is True
+    assert has_matching_shape(cpu1, {"CPU": 2}) is False
+    assert has_matching_shape(None, {"CPU": 1}) is None
