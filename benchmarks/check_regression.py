@@ -38,6 +38,13 @@ Suites:
                        reshard_mb_s — cross-mesh window redistribution;
                        reshard_large_mb_s — streaming chunk-pipelined
                        reshard under a bounded host-memory budget)
+  dag               — benchmarks/dag_microbench.json
+                      (dag_step_per_s vs dynamic/lock-step baselines,
+                       compiled_pipeline_steps_per_s 1F1B rows,
+                       serve_compiled_p99_s vs serve_dynamic_p99_s, and
+                       serve_compiled_traced_p99_s — the compiled window
+                       with tracing + ring telemetry ON; its 10% gate is
+                       the hot-path observability-overhead budget)
 
 Usage:
   python benchmarks/check_regression.py                # runs the bench

@@ -776,6 +776,13 @@ def dag_plane(out_path: str | None = None) -> dict:
       measured in a MATCHED window against serve_dynamic_p99_s (the
       DeploymentHandle path, same bodies/concurrency/replica).
       Acceptance: compiled < dynamic.
+
+      serve_compiled_traced_p99_s — the compiled window re-run with the
+      hot-path observatory ON (tracing at sample 1-in-1 + ring
+      telemetry): every request carries the W3C envelope through the
+      rings and each chain publishes its ring stats. The 10% gate on
+      this row is the observability-overhead budget — tracing the
+      compiled plane must not un-compile it.
     """
     import threading
 
@@ -941,6 +948,26 @@ def dag_plane(out_path: str | None = None) -> dict:
     assert (results["serve_compiled_p99_s"]
             < results["serve_dynamic_p99_s"]), \
         "compiled chain must beat the dynamic handle path on p99"
+
+    phase("serve_compiled_traced_p99_s (observatory on, matched window)")
+    from ray_tpu.core import config as _rcfg
+    from ray_tpu.util import tracing as _tracing
+
+    _tracing.enable_tracing()
+    _rcfg.GLOBAL.set("tracing_compiled_sample_n", 1)   # trace EVERY request
+    try:
+        traced = [float(np.percentile(
+            drive(lambda b: chain.call(b, timeout=120)), 99))
+            for _ in range(2)]
+    finally:
+        _rcfg.GLOBAL.set("tracing_compiled_sample_n", 0)
+    results["serve_compiled_traced_p99_s"] = float(np.median(traced))
+    assert chain.stats["fenced"] == 0 and \
+        chain.stats["dynamic_fallback"] == 0, chain.stats
+    print(f"[microbenchmark] serve p99 traced "
+          f"{results['serve_compiled_traced_p99_s']:.3f}s vs untraced "
+          f"{results['serve_compiled_p99_s']:.3f}s", file=sys.stderr,
+          flush=True)
     chain.shutdown()
     serve.delete("bench-chain-llm")
     serve.shutdown()
@@ -968,7 +995,12 @@ def dag_plane(out_path: str | None = None) -> dict:
                       "gpt2-tiny at concurrency 8 through the compiled "
                       "serve chain (4 lanes, adaptive batching); matched "
                       "window vs serve_dynamic_p99_s (DeploymentHandle), "
-                      "acceptance compiled < dynamic"}}
+                      "acceptance compiled < dynamic",
+                  "serve_compiled_traced_p99_s":
+                      "same compiled window with tracing at 1-in-1 "
+                      "sampling + ring telemetry on (trace envelopes "
+                      "ride every ring entry); the 10% gate bounds the "
+                      "observability overhead"}}
     print(json.dumps(report, indent=2))
     if out_path:
         with open(out_path, "w") as f:

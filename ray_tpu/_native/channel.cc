@@ -28,7 +28,9 @@
 
 namespace {
 
-constexpr uint64_t kChanMagic = 0x52545055'4348414eull;  // "RTPUCHAN"
+// v2: telemetry counters appended to the header shift the slot array, so
+// a v1 segment must fail attach (not be misread) — hence a new magic.
+constexpr uint64_t kChanMagic = 0x52545055'4348414full;  // "RTPUCHAO"
 
 struct ChanHeader {
   uint64_t magic;
@@ -41,6 +43,11 @@ struct ChanHeader {
   uint32_t closed;
   uint32_t num_slots;
   uint32_t _pad;
+  // -- telemetry (mutated under the mutex; snapshot reads are lock-free) --
+  uint64_t writer_stall_ns;  // writers blocked: ring full across cursors
+  uint64_t reader_stall_ns;  // readers blocked: next value not written yet
+  uint64_t writes;           // completed writes
+  uint64_t reads;            // completed reads (summed over all readers)
 };
 
 // per-slot metadata, laid out as an array right after the header
@@ -97,6 +104,12 @@ int chan_wait(ChanHandle* h, int64_t timeout_ms) {
     ts.tv_nsec -= 1000000000L;
   }
   return pthread_cond_timedwait(&chdr(h)->cond, &chdr(h)->mutex, &ts);
+}
+
+inline uint64_t mono_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
 }
 
 }  // namespace
@@ -213,6 +226,7 @@ int rtpu_chan_write(void* hp, const uint8_t* data, uint64_t len,
   if (chan_lock(h) != 0) return -1;
   SlotMeta* S = slots(h);
   uint32_t slot;
+  uint64_t stall0 = 0;   // set on first block: attributes ring-full stalls
   for (;;) {
     if (H->closed) {
       pthread_mutex_unlock(&H->mutex);
@@ -220,15 +234,19 @@ int rtpu_chan_write(void* hp, const uint8_t* data, uint64_t len,
     }
     slot = slot_of(H, H->seq + 1);
     if (S[slot].seq == 0 || S[slot].acks >= H->num_readers) break;
+    if (stall0 == 0) stall0 = mono_ns();
     if (chan_wait(h, timeout_ms) == ETIMEDOUT) {
+      H->writer_stall_ns += mono_ns() - stall0;
       pthread_mutex_unlock(&H->mutex);
       return -3;
     }
   }
+  if (stall0 != 0) H->writer_stall_ns += mono_ns() - stall0;
   memcpy(payload(h, slot), data, len);
   S[slot].len = len;
   S[slot].acks = 0;
   S[slot].seq = ++H->seq;
+  H->writes++;
   pthread_cond_broadcast(&H->cond);
   pthread_mutex_unlock(&H->mutex);
   return 0;
@@ -249,6 +267,7 @@ int rtpu_chan_read(void* hp, uint64_t last_seq, uint8_t* out,
   if (chan_lock(h) != 0) return -1;
   SlotMeta* S = slots(h);
   uint64_t wanted;
+  uint64_t stall0 = 0;   // set on first block: attributes starved-reader time
   for (;;) {
     // oldest value still resident: seq - num_slots + 1 (ring wrapped)
     wanted = last_seq + 1;
@@ -256,14 +275,18 @@ int rtpu_chan_read(void* hp, uint64_t last_seq, uint8_t* out,
       wanted = H->seq - H->num_slots + 1;
     if (wanted <= H->seq) break;   // written and still in the ring
     if (H->closed) {               // closed with nothing newer
+      if (stall0 != 0) H->reader_stall_ns += mono_ns() - stall0;
       pthread_mutex_unlock(&H->mutex);
       return -2;
     }
+    if (stall0 == 0) stall0 = mono_ns();
     if (chan_wait(h, timeout_ms) == ETIMEDOUT) {
+      H->reader_stall_ns += mono_ns() - stall0;
       pthread_mutex_unlock(&H->mutex);
       return -3;
     }
   }
+  if (stall0 != 0) H->reader_stall_ns += mono_ns() - stall0;
   uint32_t slot = slot_of(H, wanted);
   if (S[slot].len > out_cap) {
     pthread_mutex_unlock(&H->mutex);
@@ -273,6 +296,7 @@ int rtpu_chan_read(void* hp, uint64_t last_seq, uint8_t* out,
   *seq_out = wanted;
   *len_out = S[slot].len;
   S[slot].acks++;
+  H->reads++;
   if (S[slot].acks >= H->num_readers) pthread_cond_broadcast(&H->cond);
   pthread_mutex_unlock(&H->mutex);
   return 0;
@@ -291,6 +315,34 @@ uint32_t rtpu_chan_num_readers(void* hp) {
 
 uint32_t rtpu_chan_num_slots(void* hp) {
   return chdr(reinterpret_cast<ChanHandle*>(hp))->num_slots;
+}
+
+// Telemetry snapshot WITHOUT taking the channel mutex: a monitoring
+// thread must never contend with (or be blocked behind) a stalled hot
+// path. All fields are 64-bit counters mutated under the mutex; reading
+// them unlocked can observe a value mid-update across fields (e.g. seq
+// bumped before writes), which is fine for monitoring — each field is
+// individually torn-free on 64-bit loads. Occupancy is derived by
+// scanning the slot array: a slot holds a live value when it was ever
+// written and not every reader has acked it yet.
+// out[8]: seq, occupancy, num_slots, writer_stall_ns, reader_stall_ns,
+//         writes, reads, closed
+void rtpu_chan_stats(void* hp, uint64_t* out) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  ChanHeader* H = chdr(h);
+  SlotMeta* S = slots(h);
+  uint64_t occ = 0;
+  for (uint32_t i = 0; i < H->num_slots; ++i) {
+    if (S[i].seq != 0 && S[i].acks < H->num_readers) ++occ;
+  }
+  out[0] = H->seq;
+  out[1] = occ;
+  out[2] = H->num_slots;
+  out[3] = H->writer_stall_ns;
+  out[4] = H->reader_stall_ns;
+  out[5] = H->writes;
+  out[6] = H->reads;
+  out[7] = H->closed;
 }
 
 }  // extern "C"

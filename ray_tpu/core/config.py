@@ -246,6 +246,20 @@ FLAGS: List[Flag] = [
          float, 5.0, "Gossiped replica-load rows older than this are "
          "ignored by live-signal routing and admission control (local "
          "in-flight counts take over)."),
+    Flag("tracing_compiled_sample_n", "RAY_TPU_TRACING_COMPILED_SAMPLE_N",
+         int, 16, "Sample 1-in-N compiled-plane submissions for span "
+         "capture when tracing is on (carriers ride the ring entries; "
+         "0 disables compiled-path tracing entirely). Sampling keeps "
+         "the zero-RPC contract and compiled p99 intact."),
+    Flag("ring_telemetry_interval_s", "RAY_TPU_RING_TELEMETRY_INTERVAL_S",
+         float, 1.0, "Cadence of lock-free shm-ring header snapshots "
+         "(occupancy + writer/reader stall attribution) published per "
+         "compiled chain / pipeline lane (0 disables ring telemetry)."),
+    Flag("workload_hotpath_drift", "RAY_TPU_WORKLOAD_HOTPATH_DRIFT",
+         float, 1.5, "hotpath_regression threshold: a hot-path golden "
+         "signal (compiled p99, ring stall ratio, fused-step phase "
+         "time) exceeding this multiple of its rolling baseline is "
+         "flagged by the workload watchdog (0 disables)."),
     # --------------------------------------------------------------- TPU
     Flag("num_chips", "RAY_TPU_NUM_CHIPS", int, -1,
          "Override TPU chip autodetection (-1 = autodetect)."),

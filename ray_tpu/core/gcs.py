@@ -1386,6 +1386,20 @@ class Head:
                 "t0": t0, "t1": t1, **(detail or {})})
             return True
 
+        async def chain_event(chain, kind, detail=None):
+            """A compiled serve chain's failure-plane event (chain_fence /
+            chain_failover), mirrored from the chain's private event log
+            into the flight-recorder stream: `state.list_lease_events()`
+            and the timeline reconcile row show replica-death windows on
+            the compiled plane next to the scheduler's view. Never on
+            the warm path — fences already pay control-plane RPCs."""
+            if kind not in ("chain_fence", "chain_failover"):
+                return False
+            self.lease_events.append({
+                "ts": time.time(), "kind": kind, "chain": chain,
+                **(detail or {})})
+            return True
+
         async def get_config():
             """The head's full flag table (ray-tpu config CLI, dashboard)."""
             return _config.GLOBAL.dump()
@@ -3643,7 +3657,9 @@ class Head:
 
     async def _workload_watchdog_loop(self) -> None:
         """Flag slow pulls / train-step stragglers / p99-over-SLO routes /
-        sustained admission-control shedding from the merged telemetry — flight-recorder events plus
+        sustained admission-control shedding / hot-path drift (compiled
+        ring stall ratios, chain p99, fused-step phase stragglers) from
+        the merged telemetry — flight-recorder events plus
         `workload_anomalies_total{kind}` (see core/workload_watchdog)."""
         from ray_tpu.core import workload_watchdog
 
@@ -3660,6 +3676,8 @@ class Head:
                     straggler_factor=float(
                         _config.get("workload_straggler_factor")),
                     p99_slo_s=float(_config.get("serve_p99_slo_s")),
+                    hotpath_drift=float(
+                        _config.get("workload_hotpath_drift")),
                     state=self._watchdog_state)
             except Exception:
                 continue
@@ -3677,7 +3695,7 @@ class Head:
                     "workload_anomalies_total",
                     "Workload anomalies flagged by the head watchdog "
                     "(slow_pull | train_straggler | slo_route | "
-                    "serve_shedding)",
+                    "serve_shedding | hotpath_regression)",
                     tag_keys=("kind",))
             self._anomaly_counter.inc(tags={"kind": kind})
         except Exception:

@@ -14,7 +14,16 @@ plane and the metrics pusher already delivered) flags:
 - **serve_shedding** — a route whose admission control kept shedding
   (``serve_shed_total`` deltas positive across consecutive passes): one
   shedding pass is a burst absorber doing its job; sustained shedding is
-  capacity starvation the autoscaler/operator should see.
+  capacity starvation the autoscaler/operator should see;
+- **hotpath_regression** — drift on the compiled planes' golden signals
+  (``hotpath_drift`` > 0): a ring whose stall ratio (stall seconds per
+  wall second, writer+reader, delta-judged between passes) or a compiled
+  chain whose gossiped p99 lands ``hotpath_drift``x above its own
+  rolling EWMA baseline, plus a per-rank fused-step phase straggler
+  (one rank's timed ``train_phase`` step far above the gang median, the
+  slowest-vs-median phase named for attribution). Baselines freeze
+  while a key is regressed so a sustained regression cannot launder
+  itself into the baseline.
 
 Anomalies land in the flight-recorder event stream
 (``kind="workload_anomaly"``, visible in ``state.list_lease_events()``
@@ -109,7 +118,8 @@ def estimate_p99(series_list: List[dict]) -> Optional[float]:
 def scan(workload_rows: List[dict],
          families: Dict[str, List[Tuple[str, dict]]],
          now: float, *, slow_pull_s: float, straggler_factor: float,
-         p99_slo_s: float, state: Optional[dict] = None
+         p99_slo_s: float, hotpath_drift: float = 0.0,
+         state: Optional[dict] = None
          ) -> Tuple[List[dict], dict]:
     """One watchdog pass.
 
@@ -232,6 +242,101 @@ def scan(workload_rows: List[dict],
     state["shed_seen"] = shed_totals
     state["shed_streak"] = {k: v for k, v in streaks.items()
                             if k in shed_totals}
+
+    # ---- hot-path regression watch (compiled planes): each golden
+    # signal is judged against its OWN rolling EWMA baseline — absolute
+    # thresholds can't cover a 4-lane ring and a 2-stage LLM chain with
+    # one number. The baseline warms over 3 samples, then freezes while
+    # the key is regressed (updating it would absorb the regression and
+    # silence the very next pass).
+    if hotpath_drift > 0:
+        base: Dict = dict(state.get("hotpath_base") or {})
+        fresh_keys = set()
+
+        def drift_check(bkey, value, floor, detail):
+            fresh_keys.add(bkey)
+            b = base.get(bkey)
+            if b is None:
+                base[bkey] = {"ewma": value, "n": 1}
+                return
+            if b["n"] >= 3 and value > max(floor, hotpath_drift * b["ewma"]):
+                flag(("hotpath", bkey), {
+                    "anomaly": "hotpath_regression",
+                    "value": round(value, 6),
+                    "baseline": round(b["ewma"], 6),
+                    "drift": hotpath_drift, **detail})
+                return
+            b["ewma"] = 0.8 * b["ewma"] + 0.2 * value
+            b["n"] += 1
+
+        # ring stall ratio: stall seconds accrued per wall second since
+        # the previous pass (cumulative counters delta'd per ring key);
+        # the 0.05 floor keeps an all-idle ring's noise unflaggable
+        prev_stall: Dict = dict(state.get("hotpath_stall") or {})
+        new_stall: Dict = {}
+        for row in workload_rows:
+            if now - row.get("ts", 0) > FRESH_S:
+                continue
+            stats = row.get("stats") or {}
+            key = str(row.get("key", "?"))
+            if row.get("kind") == "hotpath":
+                cum = ((stats.get("writer_stall_s") or 0.0)
+                       + (stats.get("reader_stall_s") or 0.0))
+                prev = prev_stall.get(key)
+                new_stall[key] = (cum, row.get("ts", now))
+                if prev is None:
+                    continue
+                dt = row.get("ts", now) - prev[1]
+                if dt <= 0:
+                    continue
+                drift_check(("ring", key), max(cum - prev[0], 0.0) / dt,
+                            0.05, {"metric": "ring_stall_ratio",
+                                   "plane": stats.get("plane"),
+                                   "key": key})
+            elif row.get("kind") == "serve_chain":
+                p99 = stats.get("p99_s")
+                if p99:
+                    drift_check(("chain_p99", key), float(p99), 0.0,
+                                {"metric": "chain_p99_s", "chain": key})
+        state["hotpath_stall"] = new_stall
+
+        # fused-step phase stragglers: timed-step rows gossiped per rank
+        # (key "run:rank"); one rank far above the gang's low median is
+        # flagged with its slowest-vs-median phase named, so "rank 3 is
+        # slow" arrives as "rank 3's inter-host allreduce is slow"
+        runs: Dict[str, List[dict]] = {}
+        for row in workload_rows:
+            if row.get("kind") != "train_phase":
+                continue
+            if now - row.get("ts", 0) > FRESH_S:
+                continue
+            run = str(row.get("key", "?")).rsplit(":", 1)[0]
+            runs.setdefault(run, []).append(row.get("stats") or {})
+        for run, members in runs.items():
+            steps = [m.get("step_s") for m in members if m.get("step_s")]
+            if len(steps) < 2:
+                continue
+            med = statistics.median_low(steps)
+            if med <= 0:
+                continue
+            phase_names = sorted({k for m in members for k in m
+                                  if k.endswith("_s") and k != "step_s"})
+            med_phase = {p: statistics.median_low(
+                [m.get(p) or 0.0 for m in members]) for p in phase_names}
+            for m in members:
+                step = m.get("step_s") or 0.0
+                if step > straggler_factor * med:
+                    worst = max(phase_names, default=None,
+                                key=lambda p: (m.get(p) or 0.0)
+                                - med_phase[p])
+                    flag(("phase_straggler", run, m.get("rank")), {
+                        "anomaly": "hotpath_regression",
+                        "metric": "train_phase_step_s", "run": run,
+                        "rank": m.get("rank"), "step_s": round(step, 4),
+                        "gang_median_s": round(med, 4),
+                        "phase": worst[:-2] if worst else None})
+        state["hotpath_base"] = {k: v for k, v in base.items()
+                                 if k in fresh_keys}
 
     # prune the carry so a long-lived head doesn't accumulate state for
     # every process/run/route that ever existed: slow-pull high-waters

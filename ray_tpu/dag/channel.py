@@ -55,6 +55,8 @@ def _lib():
         lib.rtpu_chan_num_readers.argtypes = [ctypes.c_void_p]
         lib.rtpu_chan_num_slots.restype = ctypes.c_uint32
         lib.rtpu_chan_num_slots.argtypes = [ctypes.c_void_p]
+        lib.rtpu_chan_stats.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint64)]
         lib.rtpu_chan_create._configured = True
     return lib
 
@@ -62,14 +64,20 @@ def _lib():
 # ------------------------------------------------------------------ metrics
 # dag_channel_wait_seconds: time spent BLOCKED on channel handoffs (writer
 # waiting for a free ring slot / reader waiting for the next value) — the
-# compiled hot path's analogue of rpc_latency_seconds. Lazily created so
-# plain channel users outside a runtime never touch the metrics registry.
+# compiled hot path's analogue of rpc_latency_seconds. Paired with
+# dag_channel_ops_total so wait-RATIO math has an unbiased denominator:
+# the histogram's _count alone undercounts because read_raw (the remote-
+# reader serving path) historically skipped it, and any wait-ratio
+# computed against a biased op count overstates stall share. Lazily
+# created so plain channel users outside a runtime never touch the
+# metrics registry.
 _wait_hist = None
+_ops_counter = None
 _wait_enabled = None
 
 
 def _observe_wait(op: str, dt: float) -> None:
-    global _wait_hist, _wait_enabled
+    global _wait_hist, _ops_counter, _wait_enabled
     if _wait_enabled is None:
         try:
             from ray_tpu.core import config as _config
@@ -89,10 +97,17 @@ def _observe_wait(op: str, dt: float) -> None:
                 boundaries=[1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01,
                             0.05, 0.1, 0.5, 1.0, 5.0],
                 tag_keys=("op",))
+            _ops_counter = metrics.Counter(
+                "dag_channel_ops_total",
+                "Completed channel ops (every op, including the zero-wait "
+                "fast path) — the denominator for wait-ratio math over "
+                "dag_channel_wait_seconds",
+                tag_keys=("op",))
         except Exception:
             _wait_enabled = False
             return
     _wait_hist.observe(dt, tags={"op": op})
+    _ops_counter.inc(1.0, tags={"op": op})
 
 
 class Channel:
@@ -211,6 +226,7 @@ class Channel:
         `core_worker/experimental_mutable_object_provider.cc`)."""
         seq = ctypes.c_uint64()
         ln = ctypes.c_uint64()
+        t0 = time.perf_counter()
         with self._oplock:
             if not self._h:
                 raise ChannelClosedError(self.name)
@@ -220,6 +236,7 @@ class Channel:
                 ctypes.byref(ln),
                 -1 if timeout is None else int(timeout * 1000))
             data = (ctypes.string_at(buf, ln.value) if rc == 0 else b"")
+        _observe_wait("raw_read", time.perf_counter() - t0)
         if rc == -2:
             raise ChannelClosedError(self.name)
         if rc == -3:
@@ -227,6 +244,31 @@ class Channel:
         if rc != 0:
             raise ChannelError(f"read failed rc={rc}")
         return seq.value, data
+
+    def snapshot(self) -> dict:
+        """Lock-free telemetry snapshot of the shm ring header: the native
+        side reads the counters WITHOUT the channel mutex, so a monitoring
+        thread can sample a channel whose writer or reader is currently
+        stalled inside it. Stall attribution: `writer_stall_s` accrues
+        while the writer blocks on a full ring (slow READER is the
+        bottleneck); `reader_stall_s` accrues while a reader blocks on an
+        empty ring (slow WRITER / upstream is the bottleneck)."""
+        arr = (ctypes.c_uint64 * 8)()
+        with self._close_lock:
+            if not self._h:
+                raise ChannelClosedError(self.name)
+            self._lib_ref.rtpu_chan_stats(self._h, arr)
+        return {
+            "name": self.name,
+            "seq": int(arr[0]),
+            "occupancy": int(arr[1]),
+            "num_slots": int(arr[2]),
+            "writer_stall_s": arr[3] / 1e9,
+            "reader_stall_s": arr[4] / 1e9,
+            "writes": int(arr[5]),
+            "reads": int(arr[6]),
+            "closed": bool(arr[7]),
+        }
 
     def shutdown(self) -> None:
         """Set the closed flag and wake blocked peers WITHOUT unmapping
@@ -304,3 +346,65 @@ class RemoteChannelReader:
 
     def close(self, unlink: bool = False) -> None:
         pass   # the hosting process owns the channel's lifetime
+
+
+# ----------------------------------------------------------- ring telemetry
+# Per-lane ring series, published on the EXISTING per-process metrics push
+# (gauges -> /metrics, one workload row per plane -> the head's hotpath
+# aggregation). Zero new RPC channels: this is host-side sampling of the
+# shm header the hot path already maintains.
+_ring_gauges = None
+
+
+def publish_ring_stats(plane: str, key: str, snaps: dict) -> None:
+    """Publish ring telemetry for one compiled plane (a serve chain or a
+    pipeline stage set). `snaps` maps lane label -> Channel.snapshot()
+    dict. Gauges carry per-lane series; the aggregated workload row
+    (kind "hotpath") carries the plane totals the watchdog and
+    /api/hotpath consume. Best-effort: telemetry must never take down
+    the plane it watches."""
+    global _ring_gauges
+    try:
+        from ray_tpu.util import metrics
+
+        if _ring_gauges is None:
+            tags = ("plane", "key", "lane")
+            _ring_gauges = {
+                "occ": metrics.Gauge(
+                    "dag_ring_occupancy",
+                    "Live values in the shm ring (written, not yet acked "
+                    "by every reader), per lane", tag_keys=tags),
+                "stall": metrics.Gauge(
+                    "dag_ring_stall_seconds",
+                    "Cumulative blocked time on the ring by side: "
+                    "side=writer means the ring was full (slow reader), "
+                    "side=reader means it was empty (slow writer)",
+                    tag_keys=tags + ("side",)),
+            }
+        occ = wstall = rstall = writes = reads = 0.0
+        depth = 0
+        for lane, s in snaps.items():
+            t = {"plane": plane, "key": key, "lane": str(lane)}
+            _ring_gauges["occ"].set(float(s["occupancy"]), tags=t)
+            _ring_gauges["stall"].set(
+                s["writer_stall_s"], tags={**t, "side": "writer"})
+            _ring_gauges["stall"].set(
+                s["reader_stall_s"], tags={**t, "side": "reader"})
+            occ += s["occupancy"]
+            wstall += s["writer_stall_s"]
+            rstall += s["reader_stall_s"]
+            writes += s["writes"]
+            reads += s["reads"]
+            depth = max(depth, s["num_slots"])
+        metrics.publish_workload("hotpath", f"{plane}:{key}", {
+            "plane": plane,
+            "lanes": len(snaps),
+            "depth": depth,
+            "occupancy": occ,
+            "writer_stall_s": round(wstall, 6),
+            "reader_stall_s": round(rstall, 6),
+            "writes": writes,
+            "reads": reads,
+        })
+    except Exception:
+        pass

@@ -326,6 +326,29 @@ class CompiledDAG:
                 if span is not None:
                     span.start_ts = time.time() - dt
 
+    def ring_snapshots(self) -> Dict[str, dict]:
+        """Lock-free telemetry snapshots of every ring this driver holds a
+        LOCAL handle to (input rings + same-node output rings), keyed by
+        channel name. Remote-reader edges are skipped — their header
+        lives in the writer's process and is sampled there. Feeds
+        `publish_ring_stats` / the hot-path observatory; never blocks on
+        a stalled ring."""
+        out: Dict[str, dict] = {}
+        if self._torn_down:
+            return out
+        for ch in list(self.input_channels.values()):
+            try:
+                out[ch.name] = ch.snapshot()
+            except Exception:
+                pass
+        for reader in self.leaf_readers:
+            if isinstance(reader, Channel):
+                try:
+                    out[reader.name] = reader.snapshot()
+                except Exception:
+                    pass
+        return out
+
     def teardown(self, kill_actors: bool = False) -> None:
         # atomic check-then-set: the chain's shutdown and its recompile
         # thread may race here; a double native close is a use-after-free

@@ -73,8 +73,8 @@ function table(title, rows, cols){
   return h + '</table>';
 }
 async function refresh(){
-  const [sched, wl] = await Promise.all(
-    ['/api/scheduler', '/api/workloads'].map(
+  const [sched, wl, hp] = await Promise.all(
+    ['/api/scheduler', '/api/workloads', '/api/hotpath'].map(
       u => fetch(u).then(r => r.json())));
   let h = table('scheduler (per-node two-level stats)',
     sched.stats.map(s => ({node: String(s.node_id).slice(0,12),
@@ -97,6 +97,16 @@ async function refresh(){
       age_s: ((Date.now()/1000) - r.ts).toFixed(1)})),
     ['worker','run','rank','world_size','step','last_step_s',
      'ewma_step_s','steps_per_s','age_s']);
+  h += table('compiled hot path (ring telemetry, stall-attributed)',
+    hp.rings.map(r => ({ring: r.key, ...r.stats,
+      age_s: ((Date.now()/1000) - r.ts).toFixed(1)})),
+    ['ring','plane','lanes','depth','occupancy','writer_stall_s',
+     'reader_stall_s','writes','reads','age_s']);
+  h += table('compiled serve chains',
+    hp.chains.map(r => ({chain: r.key, ...r.stats,
+      age_s: ((Date.now()/1000) - r.ts).toFixed(1)})),
+    ['chain','generation','compiled','dynamic_fallback','fenced',
+     'entries','p99_s','age_s']);
   h += '<h3 class="anom">anomalies (watchdog)</h3>';
   h += table('', wl.anomalies.slice(-25).reverse().map(a => ({
       ts: new Date(a.ts*1000).toISOString().slice(11,23),
@@ -356,6 +366,29 @@ def build_app(head) -> web.Application:
                           if e.get("kind") == "workload_anomaly"][-100:],
             "trace_spans_buffered": len(head.trace_spans)})
 
+    async def hotpath(_req):
+        """Hot-path observatory: the compiled zero-RPC planes' golden
+        signals — per-chain/pipeline ring telemetry (occupancy plus
+        writer/reader stall attribution), compiled-chain health
+        (generation, fallback/fence counts, gossiped p99), timed
+        fused-step phase rows — with the watchdog's recent
+        `hotpath_regression` flags and the chains' fence/failover
+        flight-recorder events. One poll serves the `ray-tpu top` CLI
+        and the dashboard panel."""
+        rows = head._workload_rows()
+        by = lambda k: [r for r in rows if r.get("kind") == k]  # noqa: E731
+        return _json({
+            "rings": by("hotpath"),
+            "chains": by("serve_chain"),
+            "train_phases": by("train_phase"),
+            "anomalies": [e for e in head.lease_events
+                          if e.get("kind") == "workload_anomaly"
+                          and e.get("anomaly") == "hotpath_regression"
+                          ][-50:],
+            "fence_events": [e for e in head.lease_events
+                             if e.get("kind") in ("chain_fence",
+                                                  "chain_failover")][-50:]})
+
     async def workloads_page(_req):
         return web.Response(text=_WORKLOADS_HTML, content_type="text/html")
 
@@ -364,6 +397,7 @@ def build_app(head) -> web.Application:
     app.router.add_get("/api/cluster", cluster)
     app.router.add_get("/api/scheduler", scheduler)
     app.router.add_get("/api/workloads", workloads)
+    app.router.add_get("/api/hotpath", hotpath)
     for kind in ("nodes", "actors", "workers", "tasks", "task_events",
                  "lease_events", "scheduler_stats", "trace_spans",
                  "workload_stats", "serve_stats",

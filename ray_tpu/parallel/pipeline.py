@@ -23,6 +23,7 @@ Design (partial-manual shard_map):
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Callable, Optional
 
@@ -361,6 +362,46 @@ class ChannelPipelineStage:
         ops.extend(("B", k) for k in range(M - W, M))
         return ops
 
+    def _stage_span(self, carrier, t0: float):
+        """Record this stage's forward span for a sampled microbatch
+        (backdated over the compute it just ran) and return the child
+        carrier the NEXT stage parents to — the per-hop link in the
+        compiled 1F1B submit→stage→…→stage span chain. None when the
+        microbatch is untraced."""
+        if carrier is None:
+            return None
+        try:
+            from ray_tpu.util import tracing
+
+            dur = time.perf_counter() - t0
+            with tracing.start_span(
+                    f"pp.stage{self.position}.fwd", carrier=carrier,
+                    attributes={"ray_tpu.op": "pp_stage",
+                                "position": self.position}) as sp:
+                if sp is None:
+                    return None
+                sp.start_ts = time.time() - dur
+                return {"traceparent": sp.traceparent()}
+        except Exception:
+            return None
+
+    def _publish_ring_telemetry(self, key: str, *endpoints) -> None:
+        """Snapshot this stage's LOCAL ring handles (remote-reader edges
+        are sampled by their hosting process) into the hot-path
+        observatory, labelled by edge role."""
+        from ray_tpu.dag.channel import Channel, publish_ring_stats
+
+        snaps = {}
+        for label, ep in zip(("in", "out", "gin", "gout"), endpoints):
+            if isinstance(ep, Channel):
+                try:
+                    snaps[label] = ep.snapshot()
+                except Exception:
+                    pass
+        if snaps:
+            publish_ring_stats("pipeline", f"{key}/stage{self.position}",
+                               snaps)
+
     def pp_stage_loop(self, cfg: dict) -> dict:
         """Attach this stage's pre-negotiated channel edges and run 1F1B
         steps until the upstream channel closes (driver teardown)."""
@@ -384,15 +425,29 @@ class ChannelPipelineStage:
         M = int(cfg["M"])
         ring = int(cfg.get("ring", 1))
         transport = cfg.get("transport")
+        key = str(cfg.get("key", "pp"))
         ops = self._schedule(M)
         steps = 0
+        last_telem = 0.0
+        try:
+            from ray_tpu.core import config as _cfg
+
+            telem_interval = float(_cfg.get("ring_telemetry_interval_s"))
+        except Exception:
+            telem_interval = 0.0
         try:
             while True:
                 losses = []
                 for op, k in ops:
                     if op == "F":
-                        x, y = in_r.read()
+                        # a sampled microbatch carries a W3C carrier as a
+                        # third tuple element (CompiledPipeline.step /
+                        # the upstream stage's _stage_span)
+                        item = in_r.read()
+                        carrier = item[2] if len(item) > 2 else None
+                        x, y = item[0], item[1]
                         x = jnp.asarray(materialize_channel_value(x))
+                        t0 = time.perf_counter()
                         if self.is_last:
                             loss, (dp, dx) = self._lossgrad(
                                 self.params, x, jnp.asarray(y))
@@ -400,10 +455,15 @@ class ChannelPipelineStage:
                             losses.append(float(loss))
                             if gout_w is not None:
                                 gout_w.write(self._wrap(dx, transport, ring))
+                            self._stage_span(carrier, t0)
                         else:
                             act = self._fwd(self.params, x)
                             self._stash[k] = x
-                            out_w.write((self._wrap(act, transport, ring), y))
+                            child = self._stage_span(carrier, t0)
+                            payload = (self._wrap(act, transport, ring), y)
+                            if child is not None:
+                                payload = payload + (child,)
+                            out_w.write(payload)
                     elif not self.is_last:
                         g = jnp.asarray(materialize_channel_value(
                             gin_r.read()))
@@ -415,6 +475,11 @@ class ChannelPipelineStage:
                 if loss_w is not None:
                     loss_w.write(float(sum(losses) / max(1, len(losses))))
                 steps += 1
+                if telem_interval > 0 \
+                        and time.monotonic() - last_telem > telem_interval:
+                    last_telem = time.monotonic()
+                    self._publish_ring_telemetry(key, in_r, out_w,
+                                                 gin_r, gout_w)
         except ChannelClosedError:
             pass
         finally:
@@ -455,6 +520,9 @@ class CompiledPipeline:
         self._closed = False
         self._loop_refs = []
         self._remote_created = []
+        self.key = "pp"               # replaced by the start() tag
+        self._trace_seq = 0
+        self._last_telem = 0.0
 
     @staticmethod
     def build_stages(stage_fns, params_list, *, lr: float = 0.05,
@@ -501,6 +569,7 @@ class CompiledPipeline:
             addr.append(tuple(reply["address"]))
 
         tag = _os.urandom(4).hex()
+        self.key = f"pp_{tag}"
         names = {"in": f"rtpu_pp_{tag}_in",
                  "loss": f"rtpu_pp_{tag}_loss"}
         for i in range(F - 1):
@@ -534,7 +603,7 @@ class CompiledPipeline:
 
         for i, s in enumerate(self.stages):
             cfg = {"M": self.M, "ring": self.max_inflight,
-                   "transport": self.transport,
+                   "transport": self.transport, "key": self.key,
                    "in": (ref_for(names["in"], None, node[i]) if i == 0
                           else ref_for(names[f"act{i - 1}"], i - 1,
                                        node[i])),
@@ -555,6 +624,61 @@ class CompiledPipeline:
         self._started = True
 
     # ------------------------------------------------------------- control
+    def _maybe_trace_step(self):
+        """1-in-N sampled step tracing (`tracing_compiled_sample_n`, the
+        same knob as the serve chain): the returned W3C carrier rides
+        microbatch 0's ring tuple, so a sampled step yields the full
+        submit→stage→…→stage span chain in the chrome timeline with
+        zero extra RPCs. None for unsampled/untraced steps."""
+        try:
+            from ray_tpu.core import config as _cfg
+            from ray_tpu.util import tracing
+
+            n = int(_cfg.get("tracing_compiled_sample_n"))
+            if n <= 0 or not tracing.is_recording():
+                return None
+            seq = self._trace_seq
+            self._trace_seq = seq + 1
+            if seq % n:
+                return None
+            with tracing.start_span(
+                    "pp.step.submit",
+                    attributes={"ray_tpu.op": "pp_submit",
+                                "pipeline": self.key,
+                                "microbatches": self.M}) as sp:
+                if sp is None:
+                    return None
+                return {"traceparent": sp.traceparent()}
+        except Exception:
+            return None
+
+    def _telemetry_tick(self) -> None:
+        """Time-gated driver-side ring snapshots (input + loss rings,
+        when local) into the hot-path observatory."""
+        try:
+            from ray_tpu.core import config as _cfg
+
+            interval = float(_cfg.get("ring_telemetry_interval_s"))
+        except Exception:
+            return
+        if interval <= 0 or time.monotonic() - self._last_telem < interval:
+            return
+        self._last_telem = time.monotonic()
+        from ray_tpu.dag.channel import Channel, publish_ring_stats
+
+        snaps = {}
+        try:
+            snaps["in"] = self._input.snapshot()
+        except Exception:
+            pass
+        if isinstance(getattr(self, "_loss_r", None), Channel):
+            try:
+                snaps["loss"] = self._loss_r.snapshot()
+            except Exception:
+                pass
+        if snaps:
+            publish_ring_stats("pipeline", self.key, snaps)
+
     def step(self, x, y) -> float:
         """Stream one batch through the pipeline as M microbatches;
         returns the step's mean loss. Microbatch writes backpressure on
@@ -571,11 +695,15 @@ class CompiledPipeline:
         if B % self.M:
             raise ValueError(f"batch {B} not divisible by M={self.M}")
         mb = B // self.M
+        carrier = self._maybe_trace_step()
         for k in range(self.M):
-            self._input.write((x[k * mb:(k + 1) * mb],
-                               y[k * mb:(k + 1) * mb]),
-                              timeout=self.step_timeout)
-        return float(self._loss_r.read(timeout=self.step_timeout))
+            payload = (x[k * mb:(k + 1) * mb], y[k * mb:(k + 1) * mb])
+            if k == 0 and carrier is not None:
+                payload = payload + (carrier,)
+            self._input.write(payload, timeout=self.step_timeout)
+        loss = float(self._loss_r.read(timeout=self.step_timeout))
+        self._telemetry_tick()
+        return loss
 
     def get_params(self, timeout: float = 60.0) -> list:
         import ray_tpu

@@ -336,6 +336,102 @@ def cmd_logs(args) -> None:
         print(line)
 
 
+def _fetch_hotpath(client) -> dict:
+    from urllib.request import urlopen
+
+    port = client.head_request("cluster_info").get("dashboard_port")
+    if not port:
+        sys.exit("the head has no dashboard (hotpath API unavailable)")
+    with urlopen(f"http://127.0.0.1:{port}/api/hotpath", timeout=5) as r:
+        return json.load(r)
+
+
+def _render_hotpath(hp: dict, now: float) -> str:
+    """One frame of the `ray-tpu top` screen from an /api/hotpath poll:
+    per-plane golden signals — ring occupancy with writer/reader stall
+    attribution (a writer stall means the READER is the bottleneck and
+    vice versa), compiled-chain health, timed fused-step phases — and
+    the watchdog's recent hotpath_regression flags. Pure so tests can
+    render a canned payload."""
+    out = []
+
+    def sect(title, rows, fmt):
+        out.append(title)
+        if not rows:
+            out.append("  (none)")
+            return
+        for r in rows:
+            out.append("  " + fmt(r))
+
+    def age(r):
+        return f"{max(now - r.get('ts', now), 0.0):4.1f}s"
+
+    sect("rings (occupancy + stall attribution)",
+         sorted(hp.get("rings") or [], key=lambda r: str(r.get("key"))),
+         lambda r: (lambda s: (
+             f"{r.get('key', '?'):<40} {s.get('plane', '?'):<12} "
+             f"occ {s.get('occupancy', 0)}/{s.get('depth', 0)} "
+             f"x{s.get('lanes', 1)}  "
+             f"wstall {s.get('writer_stall_s', 0.0):8.3f}s  "
+             f"rstall {s.get('reader_stall_s', 0.0):8.3f}s  "
+             f"w/r {s.get('writes', 0)}/{s.get('reads', 0)}  "
+             + ("reader-bound" if s.get("writer_stall_s", 0.0)
+                > s.get("reader_stall_s", 0.0) else "writer-bound")
+             + f"  [{age(r)}]"))(r.get("stats") or {}))
+    sect("compiled chains",
+         sorted(hp.get("chains") or [], key=lambda r: str(r.get("key"))),
+         lambda r: (lambda s: (
+             f"{r.get('key', '?'):<40} gen {s.get('generation', 0)} "
+             f"compiled {s.get('compiled', 0)} "
+             f"fallback {s.get('dynamic_fallback', 0)} "
+             f"fenced {s.get('fenced', 0)} "
+             f"p99 {s.get('p99_s') if s.get('p99_s') is not None else '-'}s"
+             f"  [{age(r)}]"))(r.get("stats") or {}))
+    sect("train phases (timed step, per rank)",
+         sorted(hp.get("train_phases") or [],
+                key=lambda r: str(r.get("key"))),
+         lambda r: (lambda s: (
+             f"{r.get('key', '?'):<40} step {s.get('step_s', 0.0):7.4f}s  "
+             + "  ".join(f"{k[:-2]} {v:7.4f}s"
+                         for k, v in sorted(s.items())
+                         if k.endswith("_s") and k != "step_s")
+             + f"  [{age(r)}]"))(r.get("stats") or {}))
+    sect("hotpath regressions (watchdog)",
+         (hp.get("anomalies") or [])[-10:],
+         lambda a: (f"{a.get('metric', '?'):<22} "
+                    + " ".join(f"{k}={v}" for k, v in sorted(a.items())
+                               if k not in ("ts", "kind", "anomaly",
+                                            "metric") and v is not None)))
+    sect("fence/failover events",
+         (hp.get("fence_events") or [])[-10:],
+         lambda e: (f"{e.get('kind', '?'):<16} "
+                    f"chain {e.get('chain', '?')} "
+                    + " ".join(f"{k}={v}" for k, v in sorted(e.items())
+                               if k not in ("ts", "kind", "chain")
+                               and v is not None)))
+    return "\n".join(out)
+
+
+def cmd_top(args) -> None:
+    """`ray-tpu top`: live per-plane golden signals of the compiled hot
+    paths from `GET /api/hotpath` — refreshed in place like `top`, or a
+    single frame with --once (scripts/tests)."""
+    client = _connect(args)
+    while True:
+        frame = _render_hotpath(_fetch_hotpath(client), time.time())
+        if args.once:
+            print(frame)
+            return
+        sys.stdout.write("\x1b[2J\x1b[H"
+                         + time.strftime("ray-tpu top  %H:%M:%S\n\n")
+                         + frame + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return
+
+
 def cmd_serve(args) -> None:
     _connect(args)
     from ray_tpu import serve as serve_api
@@ -392,6 +488,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "spans; default is the legacy bare array")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("top", help="live compiled-plane golden signals "
+                                    "(/api/hotpath)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("stack", help="dump live thread stacks of workers")
     sp.add_argument("--worker", default=None, help="worker id hex prefix")

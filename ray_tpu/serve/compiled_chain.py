@@ -60,6 +60,32 @@ def is_chain_error(value) -> bool:
     return isinstance(value, dict) and CHAIN_ERR in value
 
 
+class TracedValue:
+    """Envelope for a 1-in-N sampled request: the W3C carrier rides the
+    ring entry next to the value, so each stage can parent its span to
+    the submitter's (and re-wrap its output with its OWN context for the
+    next stage) — the compiled path's submit→stage→stage span chain with
+    zero extra RPCs. Stages that don't know about tracing would see the
+    envelope, so `ReplicaActor.handle_chain` unwraps before the callable
+    and re-wraps after."""
+
+    __slots__ = ("carrier", "value")
+
+    def __init__(self, carrier, value):
+        self.carrier = carrier
+        self.value = value
+
+    def __reduce__(self):
+        return (TracedValue, (self.carrier, self.value))
+
+
+def unwrap_traced(value):
+    """(carrier, inner_value) — carrier is None for plain values."""
+    if isinstance(value, TracedValue):
+        return value.carrier, value.value
+    return None, value
+
+
 class ChainResponse:
     """Future for one request submitted to the chain."""
 
@@ -135,12 +161,33 @@ class CompiledServeChain:
         # bounded event log (fences, recompile attempts, failovers):
         # the chain's own flight recorder for drills and debugging
         self.events: List[tuple] = []
+        # hot-path observatory state: sampled-tracing counter, a small
+        # completed-latency window (p99 for the hotpath row), and the
+        # ring-telemetry thread started by start()
+        self._trace_seq = 0
+        self._lat_window: List[float] = []
+        self.chain_key = "+".join(self.deployments)
 
     def _log(self, kind: str, **detail) -> None:
         with self._lock:
             self.events.append((round(time.time(), 3), kind, detail))
             if len(self.events) > 200:
                 del self.events[:100]
+
+    def _emit_chain_event(self, kind: str, **detail) -> None:
+        """Mirror a chain lifecycle event into the head's flight-recorder
+        lease-event log (state.list_lease_events / timeline reconcile
+        row), so replica-death windows on the compiled plane show up
+        next to the scheduler's view. Best-effort, and NEVER on the warm
+        path — fences/failovers already pay control-plane RPCs."""
+        try:
+            from ray_tpu.core.api import _global_client
+
+            _global_client().head_request(
+                "chain_event", chain=self.chain_key, kind=kind,
+                detail=detail)
+        except Exception:
+            pass
 
     # ----------------------------------------------------------- bring-up
     def _ctrl(self):
@@ -261,15 +308,57 @@ class CompiledServeChain:
                                  daemon=True, name=f"chain-drainer-{lane}")
             t.start()
             self._threads.append(t)
+        try:
+            from ray_tpu.core import config as _cfg
+
+            interval = float(_cfg.get("ring_telemetry_interval_s"))
+        except Exception:
+            interval = 0.0
+        if interval > 0:
+            t = threading.Thread(target=self._telemetry_loop,
+                                 args=(interval,), daemon=True,
+                                 name="chain-telemetry")
+            t.start()
+            self._threads.append(t)
         return self
 
     # ------------------------------------------------------------ request
+    def _maybe_trace(self, value):
+        """Sample 1-in-`tracing_compiled_sample_n` submissions for span
+        capture when this request is traced (cluster tracing on, or the
+        caller holds a span — e.g. an adopted client traceparent): opens
+        the chain.submit span and wraps the value with its carrier so
+        every stage span parents into the same trace. Unsampled requests
+        pay one int check — the zero-RPC warm path is untouched."""
+        try:
+            from ray_tpu.core import config as _cfg
+            from ray_tpu.util import tracing
+
+            n = int(_cfg.get("tracing_compiled_sample_n"))
+            if n <= 0 or not tracing.is_recording():
+                return value
+            seq = self._trace_seq
+            self._trace_seq = seq + 1
+            if seq % n:
+                return value
+            with tracing.start_span(
+                    "chain.submit",
+                    attributes={"ray_tpu.op": "chain_submit",
+                                "chain": self.chain_key}) as sp:
+                if sp is None:
+                    return value
+                carrier = {"traceparent": sp.traceparent()}
+            return TracedValue(carrier, value)
+        except Exception:
+            return value
+
     def submit(self, value) -> ChainResponse:
         """Enqueue one request; never raises for infra reasons — a broken
         chain window routes to the dynamic handle path."""
         if self._shutdown:
             raise RuntimeError("chain was shut down")
-        resp = ChainResponse(value)
+        resp = ChainResponse(self._maybe_trace(value))
+        resp._t0 = time.monotonic()
         with self._lock:
             broken = self._broken
         if broken:
@@ -397,6 +486,48 @@ class CompiledServeChain:
             if gen == self.generation and lane < len(self._lane_outstanding):
                 self._lane_outstanding[lane] -= 1
 
+    def _telemetry_loop(self, interval: float) -> None:
+        """Hot-path observatory sampler: lock-free shm-ring header
+        snapshots per lane (occupancy + writer/reader stall attribution
+        -> dag_ring_* gauges) plus one aggregated chain row (compiled
+        p99 over the recent window, lifetime counters) — all riding the
+        existing per-process metrics push. Zero new RPC channels, and
+        the native snapshot never takes the channel mutex, so sampling a
+        stalled ring cannot slow the stall down further."""
+        from ray_tpu.dag.channel import publish_ring_stats
+        from ray_tpu.util import metrics
+
+        next_t = time.monotonic() + interval
+        while not self._shutdown:
+            time.sleep(0.1)
+            if time.monotonic() < next_t:
+                continue
+            next_t = time.monotonic() + interval
+            with self._lock:
+                cdags = list(self._cdags)
+                window = sorted(self._lat_window)
+            snaps = {}
+            for lane, cd in enumerate(cdags):
+                try:
+                    for name, s in cd.ring_snapshots().items():
+                        snaps[f"{lane}/{name}"] = s
+                except Exception:
+                    pass
+            if snaps:
+                publish_ring_stats("serve_chain", self.chain_key, snaps)
+            try:
+                row = {"generation": self.generation,
+                       "compiled": self.stats["compiled"],
+                       "dynamic_fallback": self.stats["dynamic_fallback"],
+                       "fenced": self.stats["fenced"],
+                       "entries": self.stats["entries"]}
+                if window:
+                    row["p99_s"] = round(
+                        window[max(0, int(len(window) * 0.99) - 1)], 6)
+                metrics.publish_workload("serve_chain", self.chain_key, row)
+            except Exception:
+                pass
+
     def _deliver(self, entries, results, gen) -> None:
         ok = isinstance(results, list) and len(results) == len(entries)
         if not ok:
@@ -405,6 +536,12 @@ class CompiledServeChain:
             return
         infra_hit = False
         for e, r in zip(entries, results):
+            # sampled requests come back in their trace envelope (the
+            # last stage re-wrapped with its own context): unwrap before
+            # the error check, deliver the inner value, and close the
+            # trace with an end-to-end chain.deliver span backdated to
+            # the submit time
+            carrier, r = unwrap_traced(r)
             if is_chain_error(r):
                 if r.get("infra"):
                     infra_hit = True
@@ -414,6 +551,24 @@ class CompiledServeChain:
             else:
                 e._set(r)
                 self.stats["compiled"] += 1
+                dt = time.monotonic() - getattr(e, "_t0", time.monotonic())
+                with self._lock:
+                    self._lat_window.append(dt)
+                    if len(self._lat_window) > 512:
+                        del self._lat_window[:256]
+                if carrier is not None:
+                    try:
+                        from ray_tpu.util import tracing
+
+                        with tracing.start_span(
+                                "chain.deliver", carrier=carrier,
+                                attributes={"ray_tpu.op": "chain_deliver",
+                                            "chain": self.chain_key,
+                                            "duration_s": dt}) as sp:
+                            if sp is not None:
+                                sp.start_ts = time.time() - dt
+                    except Exception:
+                        pass
         if infra_hit:
             self._maybe_fence(gen, "infra_marker")
 
@@ -442,9 +597,11 @@ class CompiledServeChain:
             self._pendqs = []
             self._lane_outstanding = []
             gen = self.generation
+        self._emit_chain_event("chain_fence", reason=reason, gen=gen)
         # drain-first: entries that already passed the dead stage may
         # still complete from the output ring; everything else fails
         # over. Bounded short — callers are waiting.
+        failed_over = 0
         pending = []
         for pq in pendqs:
             while True:
@@ -459,7 +616,9 @@ class CompiledServeChain:
                 self._deliver(entries, results, pgen)
                 self.stats["drained_on_fence"] += len(entries)
             except Exception:
-                self._dynamic_submit([e for e in entries if not e.done()])
+                undone = [e for e in entries if not e.done()]
+                failed_over += len(undone)
+                self._dynamic_submit(undone)
         # submissions queued but not yet written also fail over
         backlog = []
         while True:
@@ -468,7 +627,11 @@ class CompiledServeChain:
             except queue.Empty:
                 break
         if backlog:
+            failed_over += len(backlog)
             self._dynamic_submit(backlog)
+        if failed_over:
+            self._emit_chain_event("chain_failover", reason=reason,
+                                   gen=gen, entries=failed_over)
 
         del gen   # fenced generation: superseded by the recompile below
 
@@ -550,7 +713,9 @@ class CompiledServeChain:
             deadline = time.monotonic() + self.entry_timeout_s
             while True:
                 try:
-                    value = e.request
+                    # a sampled request failing over sheds its trace
+                    # envelope: the dynamic path opens its own spans
+                    _carrier, value = unwrap_traced(e.request)
                     for dep in self.deployments:
                         h = self._dyn_handle(dep)
                         value = h.remote(value).result(

@@ -162,11 +162,24 @@ class ReplicaActor:
         (which would wedge the chain until the driver's read times out).
         A callable exposing `batch_call` (LLMEngine servers) gets the
         whole entry at once so continuous batching applies across it."""
-        from ray_tpu.serve.compiled_chain import CHAIN_ERR, infra_error
+        from ray_tpu.serve.compiled_chain import (CHAIN_ERR, infra_error,
+                                                  unwrap_traced)
 
         if self._draining:
             return [infra_error(f"replica {self.replica_tag} is draining")
                     for _ in batch]
+        # sampled requests arrive in their trace envelope: peel the W3C
+        # carrier per item so the callable only ever sees plain values;
+        # outputs re-wrap below with THIS stage's span context so the
+        # next stage (and the final chain.deliver) parent into the same
+        # trace — the compiled path's submit→stage→stage chain
+        carriers = []
+        peeled = []
+        for v in batch:
+            c, inner = unwrap_traced(v)
+            carriers.append(c)
+            peeled.append(inner)
+        batch = peeled
         n = len(batch)
         with self._ongoing_lock:
             self._ongoing += n
@@ -210,6 +223,32 @@ class ReplicaActor:
                         out[i] = self.callable(v)
                     except Exception as e:  # user error: this item only
                         out[i] = {CHAIN_ERR: repr(e), "infra": False}
+            if any(c is not None for c in carriers):
+                try:
+                    from ray_tpu.serve.compiled_chain import TracedValue
+                    from ray_tpu.util import tracing
+
+                    stage_dur = time.perf_counter() - t0
+                    wall_end = time.time()
+                    for i, c in enumerate(carriers):
+                        # error markers pass through UNwrapped: the chain
+                        # client's failover check must see them directly
+                        if c is None or is_chain_error(out[i]):
+                            continue
+                        with tracing.start_span(
+                                f"chain.stage.{self.deployment_name}",
+                                carrier=c,
+                                attributes={"ray_tpu.op": "chain_stage",
+                                            "replica": self.replica_tag,
+                                            "batch": n}) as sp:
+                            if sp is not None:
+                                # backdate to cover the whole stage exec
+                                sp.start_ts = wall_end - stage_dur
+                                out[i] = TracedValue(
+                                    {"traceparent": sp.traceparent()},
+                                    out[i])
+                except Exception:
+                    pass
             return out
         finally:
             dur = time.perf_counter() - t0

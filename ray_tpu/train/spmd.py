@@ -108,6 +108,13 @@ class CompiledTrain:
     sync_fn: Optional[Callable[[TrainState, Any], tuple]] = None
     ef_sharding: Optional[Any] = None
     init_ef_fn: Optional[Callable[[], jax.Array]] = None
+    # diagnostics window (compile_train(phase_timing=True)): the step split
+    # into separately-timed phase programs — (state, batch) ->
+    # (state, metrics) where metrics["phases"] maps
+    # compute/rs/ar/ag/apply -> seconds. Trades the fused step's
+    # single-program schedule for per-fabric attribution; not for
+    # steady-state training.
+    timed_step_fn: Optional[Callable[[TrainState, Any], tuple]] = None
 
 
 def _expand_dp_spec(spec: PartitionSpec) -> PartitionSpec:
@@ -215,6 +222,149 @@ def _fused_hier_sync(loss_fn, mesh: Mesh, topo, params_spec, batch_spec,
     return _sync_call
 
 
+_phase_hist = None
+
+
+def _publish_phase_stats(run: str, rank: int, phases: dict) -> None:
+    """Per-phase step-time telemetry from the timed diagnostics step:
+    a `train_step_phase_seconds{phase}` histogram for /metrics plus a
+    per-rank `train_phase` workload row the head merges and the
+    workload watchdog scans for rank stragglers (one rank's step_s far
+    above the gang median). Rides the existing metrics push — no new
+    RPCs. Best-effort: a process without metrics wiring times fine."""
+    global _phase_hist
+    try:
+        from ray_tpu.util import metrics as m
+
+        if _phase_hist is None:
+            _phase_hist = m.Histogram(
+                "train_step_phase_seconds",
+                "Fused-step time attributed per phase by the timed "
+                "diagnostics step (compute=fwd+bwd, rs/ag=intra fabric, "
+                "ar=inter fabric, apply=optimizer)",
+                boundaries=[0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0],
+                tag_keys=("phase",))
+        for ph, dt in phases.items():
+            _phase_hist.observe(dt, tags={"phase": ph})
+        row = {"rank": rank, "step_s": round(sum(phases.values()), 6)}
+        row.update({f"{k}_s": round(v, 6) for k, v in phases.items()})
+        m.publish_workload("train_phase", f"{run}:{rank}", row)
+    except Exception:
+        pass
+
+
+def _timed_hier_step(loss_fn, mesh: Mesh, topo, params_spec, batch_spec,
+                     state_shape, state_sharding, batch_sharding,
+                     optimizer, rules, n_grads: int, n_pad: int, quantize):
+    """Build the diagnostics-window timed step for a hierarchical mesh:
+    the fused schedule re-expressed as FIVE separate programs — grad
+    (fwd+bwd, no dp reduction), RS(dp_intra), AR(dp_inter), AG(dp_intra),
+    optimizer apply — each timed host-side with block_until_ready, so a
+    step's wall time decomposes onto the fabric that spent it. The phase
+    bodies come from `hierarchy.hier_phase_programs`; the specs mirror
+    `_fused_hier_sync` so the lowering per phase is the same collective
+    the fused step would have emitted, just unfused."""
+    from jax.flatten_util import ravel_pytree
+
+    from ray_tpu.util.collective.hierarchy import hier_phase_programs
+    from ray_tpu.utils.jax_compat import shard_map
+
+    inter_ax, intra_ax = topo.inter_axis, topo.intra_axis
+    world = topo.world
+    bodies = hier_phase_programs(topo, quantize)
+    other = [a for a in mesh.axis_names if a not in (inter_ax, intra_ax)]
+    full_manual = all(int(mesh.shape[a]) == 1 for a in other)
+
+    def grad_body(p_l, b_l):
+        with mesh_lib.suppress_constraints():
+            loss, grads = jax.value_and_grad(loss_fn)(p_l, b_l)
+        flat, _ = ravel_pytree(grads)
+        vec = flat.astype(jnp.float32)
+        if n_pad > vec.shape[0]:
+            vec = jnp.pad(vec, (0, n_pad - vec.shape[0]))
+        return loss.astype(jnp.float32)[None, None], vec[None, None]
+
+    is_spec = lambda x: isinstance(x, PartitionSpec)
+    kw: dict = {"check_vma": False}
+    if full_manual:
+        p_in, b_in = params_spec, batch_spec
+    else:
+        kw["axis_names"] = {inter_ax, intra_ax}
+        p_in = jax.tree.map(lambda s: P(), params_spec, is_leaf=is_spec)
+        parts = []
+        for p in batch_spec:
+            names = p if isinstance(p, (tuple, list)) else (p,)
+            q = tuple(a for a in names if a in (inter_ax, intra_ax))
+            parts.append(q if q else None)
+        b_in = P(*parts)
+    r_spec = P(inter_ax, intra_ax)
+    grad_prog = jax.jit(shard_map(
+        grad_body, mesh=mesh, in_specs=(p_in, b_in),
+        out_specs=(r_spec, r_spec), **kw))
+    rs_prog = jax.jit(shard_map(
+        lambda v: bodies["rs"](v[0, 0])[None, None], mesh=mesh,
+        in_specs=(r_spec,), out_specs=r_spec, **kw))
+    ar_prog = jax.jit(shard_map(
+        lambda s: bodies["ar"](s[0, 0])[None, None], mesh=mesh,
+        in_specs=(r_spec,), out_specs=r_spec, **kw))
+    # after AR(inter)+AG(intra) every device holds the identical synced
+    # vector: out_spec P() hands it back replicated
+    ag_prog = jax.jit(shard_map(
+        lambda s: bodies["ag"](s[0, 0]), mesh=mesh,
+        in_specs=(r_spec,), out_specs=P(), **kw))
+
+    # unravel built from a concrete f32 zero tree (eval_shape leaves are
+    # abstract); the apply program casts back to each param's dtype
+    zeros = jax.tree.map(lambda l: jnp.zeros(l.shape, jnp.float32),
+                         jax.tree.leaves(state_shape.params))
+    treedef = jax.tree.structure(state_shape.params)
+    _, unravel = ravel_pytree(jax.tree.unflatten(treedef, zeros))
+
+    def _apply(state: TrainState, synced):
+        with mesh_lib.use_mesh(mesh, rules):
+            grads = jax.tree.map(
+                lambda t, g: g.astype(t.dtype), state.params,
+                unravel(synced[:n_grads] / world))
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return (TrainState(state.step + 1, params, opt_state),
+                    optax.global_norm(grads))
+
+    rep = NamedSharding(mesh, P())
+    apply_prog = jax.jit(
+        _apply, in_shardings=(state_sharding, rep),
+        out_shardings=(state_sharding, rep), donate_argnums=(0,))
+
+    def timed_step(state: TrainState, batch, *, rank: int = 0,
+                   run: str = "train", publish: bool = True):
+        import time as _time
+
+        phases = {}
+
+        def _timed(name, fn, *a):
+            t0 = _time.perf_counter()
+            out = fn(*a)
+            jax.block_until_ready(out)
+            phases[name] = _time.perf_counter() - t0
+            return out
+
+        with mesh_lib.use_mesh(mesh, rules):
+            loss, vec = _timed("compute", grad_prog, state.params, batch)
+            shard = _timed("rs", rs_prog, vec)
+            red = _timed("ar", ar_prog, shard)
+            synced = _timed("ag", ag_prog, red)
+            (state, grad_norm) = _timed("apply", apply_prog, state, synced)
+        if publish:
+            _publish_phase_stats(run, rank, phases)
+        metrics = {"loss": float(np.mean(jax.device_get(loss))),
+                   "grad_norm": grad_norm, "step": state.step,
+                   "phases": phases}
+        return state, metrics
+
+    return timed_step
+
+
 def compile_train(
     loss_fn: Callable[[Any, Any], jax.Array],
     init_params_fn: Callable[[jax.Array], Any],
@@ -224,6 +374,7 @@ def compile_train(
     batch_spec: Optional[PartitionSpec] = None,
     rules: Optional[dict] = None,
     grad_quantize: Optional[Any] = None,
+    phase_timing: bool = False,
 ) -> CompiledTrain:
     """Build sharded init + train-step functions for an arbitrary model.
 
@@ -237,6 +388,13 @@ def compile_train(
     error feedback the quantization residual is step-fn state:
     `step_fn(state, batch, ef) -> (state, metrics, ef)`, seeded by
     `init_ef_fn()`. `batch_spec=None` picks the mesh's dp spelling.
+
+    `phase_timing=True` (hierarchical mesh only) additionally builds
+    `timed_step_fn`: the same schedule split into separately-timed
+    programs (compute/RS/AR/AG/apply) publishing
+    `train_step_phase_seconds{phase}` and per-rank `train_phase`
+    workload rows — an opt-in diagnostics window, not a replacement for
+    the fused `step_fn`.
     """
     optimizer = optimizer or default_optimizer()
     hier = mesh_lib.is_hierarchical_mesh(mesh)
@@ -269,7 +427,16 @@ def compile_train(
     topo = mesh_lib.hier_topology(mesh) if hier else None
     ef = bool(hier and grad_quantize is not None
               and grad_quantize.error_feedback)
-    sync_fn = ef_sharding = init_ef_fn = None
+    sync_fn = ef_sharding = init_ef_fn = timed_step_fn = None
+    if phase_timing and not hier:
+        raise ValueError(
+            "phase_timing splits the two-level gradient sync into timed "
+            "phases; build a hierarchical mesh "
+            "(mesh.build_hierarchical_mesh) to use it")
+    if phase_timing and ef:
+        raise ValueError(
+            "phase_timing does not support error-feedback quantization "
+            "(the residual is fused-step state)")
 
     if hier:
         # Pad the fused grad vector so the intra scatter tiles evenly
@@ -332,6 +499,12 @@ def compile_train(
             _sync_only,
             in_shardings=(state_sharding, batch_sharding),
             out_shardings=(rep, state_sharding.params))
+
+        if phase_timing:
+            timed_step_fn = _timed_hier_step(
+                loss_fn, mesh, topo, params_spec, batch_spec,
+                state_shape, state_sharding, batch_sharding,
+                optimizer, rules, n_grads, n_pad, grad_quantize)
     else:
         def _step(state: TrainState, batch):
             with mesh_lib.use_mesh(mesh, rules):
@@ -381,7 +554,7 @@ def compile_train(
                          grad_fn=grad_fn, apply_fn=apply_fn,
                          topology=topo, grad_quantize=grad_quantize,
                          sync_fn=sync_fn, ef_sharding=ef_sharding,
-                         init_ef_fn=init_ef_fn)
+                         init_ef_fn=init_ef_fn, timed_step_fn=timed_step_fn)
 
 
 # ---------------------------------------------------------------------------

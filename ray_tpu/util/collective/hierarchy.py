@@ -293,6 +293,44 @@ def hier_grad_sync_program(topo: Topology, quantize=None,
     return fn
 
 
+def hier_phase_programs(topo: Topology, quantize=None) -> Dict[str, Any]:
+    """`hier_grad_sync_program` split at its phase boundaries: a dict of
+    per-device flat-vector bodies {"rs", "ar", "ag"} — reduce-scatter
+    over the intra (fast) axis, allreduce (optionally quantized) over
+    the inter (slow) axis on the scattered shard, all-gather back.
+
+    This is the diagnostics-window variant behind
+    `spmd.compile_train(phase_timing=True)`: each phase runs as its OWN
+    XLA program so host-side `block_until_ready` timing attributes step
+    time to the fabric that actually spent it (RS/AG = intra ICI,
+    AR = inter DCN). The single-program fusion the production step
+    relies on is deliberately traded for that visibility — never run
+    this as the steady-state step.
+
+    Identity phases (degenerate axes) stay callable so the timed step's
+    phase loop needs no special cases; they time at ~dispatch cost.
+    """
+    from jax import lax
+
+    intra, inter = topo.intra_axis, topo.inter_axis
+
+    def rs(v):
+        return (lax.psum_scatter(v, intra, scatter_dimension=0, tiled=True)
+                if topo.intra > 1 else v)
+
+    def ar(s):
+        if topo.inter > 1:
+            return (quantize.inter_allreduce(s, inter)
+                    if quantize is not None else lax.psum(s, inter))
+        return s
+
+    def ag(s):
+        return (lax.all_gather(s, intra, tiled=True)
+                if topo.intra > 1 else s)
+
+    return {"rs": rs, "ar": ar, "ag": ag}
+
+
 def hier_reduce_scatter_program(topo: Topology, op: ReduceOp = ReduceOp.SUM):
     """Two-level reduce-scatter body: input [1, n] per device; output this
     device's fully-reduced shard [1, n/world]. The inter hop moves only
@@ -359,7 +397,7 @@ def device_rows_by_process(devices: Sequence[Any]) -> List[List[Any]]:
 __all__ = [
     "Topology", "infer_topology", "hier_allreduce_program",
     "hier_allreduce_ef_program", "hier_grad_sync_program",
-    "hier_reduce_scatter_program",
+    "hier_phase_programs", "hier_reduce_scatter_program",
     "hier_all_gather_program", "gathered_reduce", "device_rows_by_process",
     "account_collective", "account_quant_saving", "ring_perm",
 ]

@@ -119,6 +119,18 @@ def _reconcile_phase_events(trace: List[dict]) -> None:
                 trace.append({**row, "name": kind, "ph": "i",
                               "ts": ev["ts"] * 1e6, "s": "t"})
             continue
+        if kind in ("chain_fence", "chain_failover"):
+            # compiled serve plane: a fence (replica death / ring failure)
+            # and any failover burst render as instants on the chain's
+            # own reconcile lane, next to the scheduler's node_dead
+            # windows they usually coincide with
+            trace.append({
+                "name": kind, "cat": "reconcile", "ph": "i",
+                "ts": ev["ts"] * 1e6, "s": "t", "pid": PID,
+                "tid": f"chain:{ev.get('chain', '?')}",
+                "args": {k: v for k, v in ev.items()
+                         if k != "ts" and v is not None}})
+            continue
         if kind == "stale_epoch":
             trace.append({
                 "name": "stale_epoch", "cat": "reconcile", "ph": "i",

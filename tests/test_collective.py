@@ -640,7 +640,8 @@ def test_eager_wire_byte_accounting_formulas(devices8, monkeypatch):
 
 
 # ------------------------------------------------ fused in-program sync
-def _fused_ct(devices8, grad_quantize=None, optimizer=None, loss="linear"):
+def _fused_ct(devices8, grad_quantize=None, optimizer=None, loss="linear",
+              **kw):
     """compile_train on an emulated 2 hosts x 2 devices hierarchical mesh.
 
     `linear` loss has grad == the local batch row, which makes the staged
@@ -673,7 +674,7 @@ def _fused_ct(devices8, grad_quantize=None, optimizer=None, loss="linear"):
     ct = spmd.compile_train(
         loss_fn, init_params, {"w": P()}, mesh,
         optimizer=optimizer or optax.sgd(0.1),
-        grad_quantize=grad_quantize)
+        grad_quantize=grad_quantize, **kw)
     return ct
 
 
@@ -760,6 +761,48 @@ def test_fused_sync_bitwise_matches_staged(devices8):
     assert np.asarray(grads["w"]).tobytes() == staged.tobytes()
     w0 = ((np.arange(8) % 5) - 2) / 4.0
     np.testing.assert_allclose(float(loss), float((x @ w0).mean()), rtol=1e-6)
+
+
+def test_timed_phase_step_matches_fused_and_attributes_time(devices8):
+    """phase_timing=True (the observatory's diagnostics window): the
+    timed variant re-expresses the fused schedule as separately-timed
+    programs — grad, RS(intra), AR(inter), AG(intra), apply — so step
+    time becomes attributable WITHOUT changing the math. One step from
+    the same seed matches the fused step's weights exactly and every
+    phase reports a timing."""
+    import jax
+
+    ct = _fused_ct(devices8, phase_timing=True)
+    assert ct.timed_step_fn is not None
+    x = (((np.arange(32, dtype=np.float32).reshape(4, 8) % 7) - 3) / 8.0)
+    batch = _fused_batch(ct, x)
+
+    fused_state, fused_metrics = ct.step_fn(ct.init_fn(jax.random.key(0)),
+                                            batch)
+    timed_state, m = ct.timed_step_fn(ct.init_fn(jax.random.key(0)), batch,
+                                      publish=False)
+    np.testing.assert_array_equal(np.asarray(timed_state.params["w"]),
+                                  np.asarray(fused_state.params["w"]))
+    np.testing.assert_allclose(m["loss"], float(fused_metrics["loss"]),
+                               rtol=1e-6)
+    assert set(m["phases"]) == {"compute", "rs", "ar", "ag", "apply"}
+    assert all(v >= 0.0 for v in m["phases"].values())
+    assert int(timed_state.step) == 1
+
+    # phase_timing needs the hierarchical schedule (there are no RS/AR/AG
+    # phases to time on a flat mesh) and excludes error feedback
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel import mesh as mesh_lib
+    from ray_tpu.train import spmd
+
+    flat = mesh_lib.build_mesh({"dp": 4}, devices=devices8[:4])
+    with pytest.raises(ValueError, match="hierarchical"):
+        spmd.compile_train(lambda p, b: jnp.mean(b @ p["w"]),
+                           lambda k: {"w": jnp.zeros(8, jnp.float32)},
+                           {"w": P()}, flat, optimizer=optax.sgd(0.1),
+                           phase_timing=True)
 
 
 def test_fused_ef_int8_trains_close_to_fp32(devices8):

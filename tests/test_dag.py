@@ -52,6 +52,64 @@ def test_channel_blocking_handoff():
     ch.close(unlink=True)
 
 
+def test_channel_stall_attribution_distinguishes_slow_sides():
+    """Ring-telemetry acceptance: the shm header's stall counters
+    attribute the bottleneck to the correct SIDE. A slow reader leaves
+    the writer blocked on a full ring (writer_stall_s accrues -> the
+    plane is reader-bound); a slow writer leaves the reader blocked on
+    an empty ring (reader_stall_s accrues -> writer-bound). Both read
+    lock-free via Channel.snapshot() off the live header."""
+    import threading
+
+    # --- slow READER: 2-slot ring fills, writer blocks
+    ch = Channel(capacity=1 << 16, num_readers=1, num_slots=2)
+    try:
+        r = Channel.attach(ch.name)
+
+        def slow_reader():
+            for _ in range(6):
+                time.sleep(0.05)
+                r.read(timeout=10)
+
+        t = threading.Thread(target=slow_reader)
+        t.start()
+        for i in range(6):
+            ch.write(i, timeout=10)
+        t.join(timeout=30)
+        s = ch.snapshot()
+        assert s["writes"] == 6 and s["reads"] == 6
+        assert s["num_slots"] == 2 and s["occupancy"] == 0
+        # writer waited on the full ring for ~4 sleeps' worth
+        assert s["writer_stall_s"] > 0.05, s
+        # the ring always had data when the reader arrived
+        assert s["reader_stall_s"] == 0.0, s
+    finally:
+        ch.close(unlink=True)
+
+    # --- slow WRITER: reader blocks on the empty ring
+    ch2 = Channel(capacity=1 << 16, num_readers=1, num_slots=2)
+    try:
+        r2 = Channel.attach(ch2.name)
+        got = []
+
+        def fast_reader():
+            for _ in range(6):
+                got.append(r2.read(timeout=10))
+
+        t2 = threading.Thread(target=fast_reader)
+        t2.start()
+        for i in range(6):
+            time.sleep(0.05)
+            ch2.write(i, timeout=10)
+        t2.join(timeout=30)
+        assert got == list(range(6))
+        s2 = ch2.snapshot()
+        assert s2["reader_stall_s"] > 0.05, s2
+        assert s2["writer_stall_s"] == 0.0, s2
+    finally:
+        ch2.close(unlink=True)
+
+
 def test_channel_close_unblocks_reader():
     import threading
 

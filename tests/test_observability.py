@@ -592,6 +592,100 @@ def test_workload_watchdog_scan_policies():
     assert not rec
 
 
+def test_workload_watchdog_hotpath_regression_policies():
+    """Pure-policy unit for the hot-path regression watch: compiled-chain
+    p99 and ring stall ratio judged against their own rolling EWMA
+    baselines (warm-up, floor, freeze-while-regressed), re-flag rate
+    limiting, and hotpath_drift=0 backward compatibility."""
+    from ray_tpu.core import workload_watchdog as wd
+
+    now = 2000.0
+    kw = dict(slow_pull_s=5.0, straggler_factor=2.0, p99_slo_s=0.0,
+              hotpath_drift=1.5)
+
+    def chain_row(p99, ts):
+        return {"kind": "serve_chain", "key": "pre+main", "ts": ts,
+                "stats": {"generation": 1, "p99_s": p99}}
+
+    def ring_row(cum_stall, ts):
+        return {"kind": "hotpath", "key": "serve_chain:pre+main", "ts": ts,
+                "stats": {"plane": "serve_chain", "occupancy": 1.0,
+                          "writer_stall_s": cum_stall,
+                          "reader_stall_s": 0.0}}
+
+    # warm the baselines: 4 healthy passes (chain p99 steady at 0.30s,
+    # the ring stalling 0.01 s per wall second — under the 0.05 floor)
+    state = None
+    for i in range(4):
+        t = now + i
+        anomalies, state = wd.scan(
+            [chain_row(0.30, t - 0.1), ring_row(0.01 * i, t - 0.1)],
+            {}, t, state=state, **kw)
+        assert not anomalies, anomalies
+
+    # regression pass: p99 trebles and the ring spends 90% of the wall
+    # window stalled -> both flagged against their OWN baselines
+    t = now + 4
+    anomalies, state = wd.scan(
+        [chain_row(0.95, t - 0.1), ring_row(0.03 + 0.9, t - 0.1)],
+        {}, t, state=state, **kw)
+    by_metric = {a["metric"]: a for a in anomalies}
+    assert set(by_metric) == {"chain_p99_s", "ring_stall_ratio"}
+    assert all(a["anomaly"] == "hotpath_regression"
+               for a in anomalies)
+    assert by_metric["chain_p99_s"]["chain"] == "pre+main"
+    assert by_metric["chain_p99_s"]["baseline"] == pytest.approx(0.30)
+    assert by_metric["ring_stall_ratio"]["value"] == pytest.approx(0.9)
+
+    # re-flag rate limit: the still-regressed next pass is silent...
+    again, state = wd.scan(
+        [chain_row(0.95, t + 0.9), ring_row(0.93 + 0.9, t + 0.9)],
+        {}, t + 1, state=state, **kw)
+    assert not again
+    # ...but after the interval the SAME sustained regression flags
+    # again — still judged against the FROZEN healthy baseline (updating
+    # it would absorb the regression and silence the next pass)
+    t2 = t + wd.REFLAG_INTERVAL_S + 2
+    later, state = wd.scan([chain_row(0.95, t2 - 0.1)], {}, t2,
+                           state=state, **kw)
+    assert [a["metric"] for a in later] == ["chain_p99_s"]
+    assert later[0]["baseline"] == pytest.approx(0.30)
+
+    # hotpath_drift left at its 0 default -> the watch is off entirely
+    off, _ = wd.scan([chain_row(9.9, now - 0.1)], {}, now,
+                     slow_pull_s=5.0, straggler_factor=2.0, p99_slo_s=0.0)
+    assert not off
+
+
+def test_workload_watchdog_flags_fused_phase_straggler():
+    """A synthetic fused-step phase straggler: rank 3's step time blows
+    past the gang median and the watchdog names the guilty PHASE (its
+    inter-host allreduce), not just the rank."""
+    from ray_tpu.core import workload_watchdog as wd
+
+    now = 3000.0
+
+    def phase_row(rank, step, compute, ar):
+        return {"kind": "train_phase", "key": f"run1:{rank}", "ts": now - 1,
+                "stats": {"rank": rank, "step_s": step,
+                          "compute_s": compute, "rs_s": 0.01,
+                          "ar_s": ar, "ag_s": 0.01, "apply_s": 0.01}}
+
+    rows = [phase_row(0, 0.10, 0.05, 0.02),
+            phase_row(1, 0.11, 0.05, 0.02),
+            phase_row(2, 0.10, 0.05, 0.02),
+            phase_row(3, 1.20, 0.20, 0.95)]
+    anomalies, _ = wd.scan(rows, {}, now, slow_pull_s=5.0,
+                           straggler_factor=2.0, p99_slo_s=0.0,
+                           hotpath_drift=1.5)
+    assert [a["anomaly"] for a in anomalies] == ["hotpath_regression"]
+    a = anomalies[0]
+    assert a["metric"] == "train_phase_step_s"
+    assert a["rank"] == 3 and a["run"] == "run1"
+    assert a["phase"] == "ar"       # slowest-vs-median phase named
+    assert a["gang_median_s"] == pytest.approx(0.10)
+
+
 def test_workload_rows_and_serve_stats_surface(cluster):
     """publish_workload rows reach state.list_workload_stats (and the
     serve-scoped list_serve_stats view) via the ordinary metrics push."""

@@ -19,6 +19,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu.core import protocol
+from ray_tpu.core.native_store import native_available as _native_available
 
 
 PUSH_INTERVAL_S = "0.5"
@@ -305,6 +306,284 @@ def test_workload_trace_e2e_serve_train_and_chrome_export(cluster, tmp_path):
     # at least one flow crosses processes on the serve trace
     assert any(cat == "span-flow" and sid in by_id
                for (cat, sid) in flows), "no cross-process serve flow"
+
+
+def _chrome_export(tmp_path, name: str) -> list:
+    """Export the merged timeline and return validity-checked events."""
+    out = str(tmp_path / name)
+    ray_tpu.timeline(out, format="chrome")
+    payload = json.load(open(out))
+    assert isinstance(payload, dict) and "traceEvents" in payload
+    evs = payload["traceEvents"]
+    for ev in evs:
+        assert "ph" in ev and "ts" in ev and "name" in ev, ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    return evs
+
+
+def _assert_flows_pair(evs: list) -> dict:
+    """Every flow arrow pairs (one start, one finish, ordered)."""
+    flows = {}
+    for e in evs:
+        if e["ph"] in ("s", "f"):
+            flows.setdefault((e.get("cat"), e["id"]), []).append(e)
+    for key, pair in flows.items():
+        phs = sorted(p["ph"] for p in pair)
+        assert phs == ["f", "s"], (key, phs)
+        s_ev = next(p for p in pair if p["ph"] == "s")
+        f_ev = next(p for p in pair if p["ph"] == "f")
+        assert f_ev["ts"] >= s_ev["ts"], key
+    return flows
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native toolchain unavailable")
+def test_compiled_chain_trace_chrome_export(cluster, tmp_path):
+    """Compiled-plane tracing acceptance: a warm compiled-chain request
+    (sampled 1-in-1) yields the same submit→stage→deliver span chain in
+    `timeline(format="chrome")` as a dynamic request — while the warm
+    path stays ZERO head round trips (interposer-audited; the W3C
+    carrier rides the ring entry, spans leave via the metrics push) —
+    and the ring telemetry lands in /api/hotpath where `ray-tpu top`
+    renders it with stall attribution."""
+    from ray_tpu import serve
+    from ray_tpu.core import config as rcfg
+    from ray_tpu.serve.compiled_chain import CompiledServeChain
+    from ray_tpu.util import state, tracing
+
+    class _Obs:
+        def __call__(self, v):
+            return v + 1
+
+    serve.run(serve.deployment(_Obs, name="obs-chain").bind(),
+              name="obs-chain")
+    tracing.enable_tracing()
+    rcfg.GLOBAL.set("tracing_compiled_sample_n", 1)   # trace EVERY request
+    rcfg.GLOBAL.set("ring_telemetry_interval_s", 0.2)
+    chain = CompiledServeChain(["obs-chain"], lanes=2, max_inflight=2,
+                               batch_max=4).start()
+    try:
+        for i in range(5):        # warm every lane
+            assert chain.call(i, timeout=60) == i + 1
+        time.sleep(0.3)           # registration stragglers flush
+
+        events = []
+
+        def hook(conn_name, kind, method):
+            if conn_name == "head":
+                events.append((kind, method))
+
+        client_trace = "33f7651916cd43dd8448eb211c80319c"
+        client_carrier = {"traceparent":
+                          f"00-{client_trace}-feedc0de12345678-01"}
+        protocol.add_rpc_interposer(hook)
+        try:
+            # the burst runs under a client-supplied W3C traceparent —
+            # chain.submit parents to it, so the client's trace id rides
+            # the ring through every stage
+            with tracing.start_span("client-root", carrier=client_carrier):
+                resps = [chain.submit(i) for i in range(8)]
+                assert [r.result(60) for r in resps] == \
+                    [i + 1 for i in range(8)]
+        finally:
+            protocol.remove_rpc_interposer(hook)
+        reqs = [m for k, m in events if k == "req"]
+        assert not reqs, f"warm TRACED chain made head round trips: {reqs}"
+        assert {m for k, m in events if k == "push"} <= \
+            {"ref_update", "metrics_push"}
+        assert chain.stats["fenced"] == 0
+        assert chain.stats["dynamic_fallback"] == 0
+
+        # stage spans record in the replica process and arrive at the
+        # head on its next metrics push — wait for the BURST's spans
+        # (the client trace id), not just any warm-up span
+        deadline = time.time() + 30
+        arrived = False
+        while time.time() < deadline:
+            arrived = any(s["name"] == "chain.stage.obs-chain"
+                          and s["trace_id"] == client_trace
+                          for s in state.list_trace_spans())
+            if arrived:
+                break
+            time.sleep(0.5)
+        assert arrived, "burst stage spans never reached the head"
+
+        evs = _chrome_export(tmp_path, "chain_trace.json")
+        span_evs = [e for e in evs if e.get("cat") == "span"]
+        by_id = {e["args"]["span_id"]: e for e in span_evs}
+        # at least one COMPLETE submit→stage→deliver parent chain on a
+        # single trace id — the compiled plane tells the same story the
+        # dynamic path does
+        complete = client_traced = 0
+        for d in (e for e in span_evs if e["name"] == "chain.deliver"):
+            tid = d["args"]["trace_id"]
+            in_trace = {e["args"]["span_id"]: e for e in span_evs
+                        if e["args"]["trace_id"] == tid}
+            stage = in_trace.get(d["args"]["parent_id"])
+            if stage is None or stage["name"] != "chain.stage.obs-chain":
+                continue
+            sub = in_trace.get(stage["args"]["parent_id"])
+            if sub is not None and sub["name"] == "chain.submit":
+                # submit (driver) and stage (replica) are different procs
+                assert sub["tid"] != stage["tid"]
+                complete += 1
+                client_traced += tid == client_trace
+        assert complete, "no complete submit→stage→deliver span chain"
+        # the client-supplied traceparent followed requests end to end
+        assert client_traced, "no chain carried the client's trace id"
+        flows = _assert_flows_pair(evs)
+        # span flow arrows reference spans present in the export
+        assert any(cat == "span-flow" and sid in by_id
+                   for (cat, sid) in flows), "no cross-process chain flow"
+
+        # ring + chain golden signals reach /api/hotpath…
+        dp = _dashboard_port()
+        deadline = time.time() + 30
+        hp = {}
+        while time.time() < deadline:
+            hp = _http_json(f"http://127.0.0.1:{dp}/api/hotpath")
+            if hp.get("rings") and hp.get("chains"):
+                break
+            time.sleep(0.5)
+        assert hp.get("rings") and hp.get("chains"), hp
+        ring = hp["rings"][0]["stats"]
+        for k in ("plane", "occupancy", "depth",
+                  "writer_stall_s", "reader_stall_s"):
+            assert k in ring, ring
+        # …and `ray-tpu top` renders one frame from the payload
+        from ray_tpu.scripts.cli import _render_hotpath
+
+        frame = _render_hotpath(hp, time.time())
+        assert "rings" in frame and "obs-chain" in frame
+        assert "-bound" in frame    # stall attribution is spelled out
+    finally:
+        chain.shutdown()
+        rcfg.GLOBAL.set("tracing_compiled_sample_n", 16)
+        serve.delete("obs-chain")
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native toolchain unavailable")
+def test_compiled_pipeline_trace_chrome_export(cluster, tmp_path):
+    """The compiled 1F1B pipeline joins the same observatory: a sampled
+    step's carrier rides microbatch 0 through the stage rings, so the
+    chrome export shows pp.step.submit → pp.stage0.fwd → pp.stage1.fwd
+    chained across actor processes with paired flow arrows."""
+    import numpy as np
+
+    from ray_tpu.core import config as rcfg
+    from ray_tpu.parallel.pipeline import (CompiledPipeline, init_mlp_stage,
+                                           mlp_stage_fn, mse_loss)
+    from ray_tpu.util import state, tracing
+
+    tracing.enable_tracing()
+    rcfg.GLOBAL.set("tracing_compiled_sample_n", 1)
+    D, M = 8, 2
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((4, D)).astype(np.float32)
+    Y = rng.standard_normal((4, D)).astype(np.float32)
+    params = [init_mlp_stage(i, D, D) for i in range(2)]
+    stages = CompiledPipeline.build_stages(mlp_stage_fn, params, lr=0.01,
+                                           loss_fn=mse_loss)
+    pipe = CompiledPipeline(stages, n_microbatches=M, max_inflight=2)
+    try:
+        for _ in range(4):
+            pipe.step(X, Y)
+        deadline = time.time() + 30
+        names = set()
+        while time.time() < deadline:
+            names = {s["name"] for s in state.list_trace_spans()}
+            if {"pp.stage0.fwd", "pp.stage1.fwd"} <= names:
+                break
+            time.sleep(0.5)
+        assert {"pp.step.submit", "pp.stage0.fwd",
+                "pp.stage1.fwd"} <= names, names
+
+        evs = _chrome_export(tmp_path, "pp_trace.json")
+        span_evs = [e for e in evs if e.get("cat") == "span"]
+        by_id = {e["args"]["span_id"]: e for e in span_evs}
+        # stage1 parents to stage0 parents to the driver's submit span
+        chained = 0
+        for s1 in (e for e in span_evs if e["name"] == "pp.stage1.fwd"):
+            s0 = by_id.get(s1["args"]["parent_id"])
+            if s0 is None or s0["name"] != "pp.stage0.fwd":
+                continue
+            sub = by_id.get(s0["args"]["parent_id"])
+            if sub is not None and sub["name"] == "pp.step.submit":
+                assert len({s1["args"]["trace_id"], s0["args"]["trace_id"],
+                            sub["args"]["trace_id"]}) == 1
+                chained += 1
+        assert chained, "no submit→stage0→stage1 span chain in the export"
+        _assert_flows_pair(evs)
+    finally:
+        pipe.close(kill_actors=True)
+        rcfg.GLOBAL.set("tracing_compiled_sample_n", 16)
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native toolchain unavailable")
+def test_chain_fence_events_reach_flight_recorder_and_timeline(cluster,
+                                                               tmp_path):
+    """Satellite: compiled-chain fence/failover events are mirrored off
+    the chain's private log into the head's flight recorder —
+    `state.list_lease_events()`, /api/hotpath, and timeline instants on
+    the chain's own track — and unknown kinds are rejected."""
+    from ray_tpu.util.state import list_lease_events
+
+    c = ray_tpu.core.api._global_client()
+    assert c.head_request("chain_event", chain="drill+main",
+                          kind="chain_fence",
+                          detail={"reason": "drill", "gen": 2})
+    assert c.head_request("chain_event", chain="drill+main",
+                          kind="chain_failover", detail={"entries": 3})
+    assert not c.head_request("chain_event", chain="drill+main",
+                              kind="bogus")
+    evs = [e for e in list_lease_events() if e.get("chain") == "drill+main"]
+    assert {e["kind"] for e in evs} >= {"chain_fence", "chain_failover"}
+
+    dp = _dashboard_port()
+    hp = _http_json(f"http://127.0.0.1:{dp}/api/hotpath")
+    fences = [e for e in hp.get("fence_events", [])
+              if e.get("chain") == "drill+main"]
+    assert {e["kind"] for e in fences} >= {"chain_fence", "chain_failover"}
+
+    trace = _chrome_export(tmp_path, "fence_trace.json")
+    inst = [e for e in trace
+            if e["name"] in ("chain_fence", "chain_failover")
+            and e.get("tid") == "chain:drill+main"]
+    assert len(inst) >= 2 and all(e["ph"] == "i" for e in inst)
+
+
+def test_watchdog_flags_synthetic_phase_straggler(cluster):
+    """Regression-watch acceptance: a synthetic fused-step phase
+    straggler (rank 3's AR phase blows up its step time) published as
+    train_phase telemetry is flagged by the head watchdog as a
+    hotpath_regression workload_anomaly naming the guilty phase."""
+    from ray_tpu.util import metrics as m
+
+    rows = {0: (0.10, 0.05, 0.05), 1: (0.10, 0.05, 0.05),
+            2: (0.10, 0.05, 0.05), 3: (1.20, 0.20, 1.00)}
+    for rank, (step, compute, ar) in rows.items():
+        m.publish_workload("train_phase", f"synth:{rank}",
+                           {"rank": rank, "step_s": step,
+                            "compute_s": compute, "ar_s": ar})
+    dp = _dashboard_port()
+    deadline = time.time() + 30
+    found = []
+    while time.time() < deadline:
+        hp = _http_json(f"http://127.0.0.1:{dp}/api/hotpath")
+        found = [a for a in hp.get("anomalies", [])
+                 if a.get("metric") == "train_phase_step_s"]
+        if found:
+            break
+        time.sleep(0.5)
+    assert found, "watchdog never flagged the synthetic phase straggler"
+    flag = found[0]
+    assert flag["anomaly"] == "hotpath_regression"
+    assert flag["kind"] == "workload_anomaly"
+    assert flag["rank"] == 3
+    assert flag["phase"] == "ar"    # the phase that ate the step time
 
 
 def test_workloads_dashboard_panel(cluster):
