@@ -23,9 +23,15 @@ Suites:
                        serve_p99_s, disagg_ttft_s,
                        disagg_shared_prefix_ttft_s — shared-system-prompt
                        TTFT with the cluster prefix store warm, must beat
-                       the point-to-point disagg_ttft_s — and
+                       the point-to-point disagg_ttft_s —
                        cluster_prefix_hit_ratio, the share of
-                       shared-prefix requests absorbed by the cache tier)
+                       shared-prefix requests absorbed by the cache tier,
+                       and the ISSUE-19 proxy-ingress rows:
+                       proxy_dynamic_rps vs proxy_compiled_rps — matched
+                       external-HTTP windows, per-request handle dispatch
+                       vs the proxy writing straight into the compiled
+                       chain rings — and proxy_compiled_p99_s, the
+                       compiled path's latency floor)
   collective        — benchmarks/collective_microbench.json
                       (allreduce_mb_s — flat path; hier_allreduce_mb_s /
                        quant_allreduce_mb_s — two-level + int8 inter hop
@@ -50,8 +56,13 @@ Usage:
   python benchmarks/check_regression.py                # runs the bench
   python benchmarks/check_regression.py --suite data
   python benchmarks/check_regression.py --suite serve
+  python benchmarks/check_regression.py --suite all    # every suite, in order
   python benchmarks/check_regression.py --current run.json
   python benchmarks/check_regression.py --tolerance 0.15
+
+`--suite all` runs EVERY committed suite (control, data, data-pipeline,
+serve, collective, dag) back to back against its own artifact and fails
+if ANY row in ANY suite regressed — the one-command CI gate.
 """
 
 from __future__ import annotations
@@ -116,10 +127,31 @@ def compare(baseline: dict, current: dict, tolerance: float) -> list[str]:
     return failures
 
 
+def run_suite(name: str, args) -> list[str]:
+    """Run (or load) one suite and return its failure lines, each
+    prefixed with the suite name so `--suite all` output is attributable."""
+    suite = SUITES[name]
+    baseline_path = args.baseline or os.path.join(HERE, suite["baseline"])
+    with open(baseline_path) as f:
+        baseline = json.load(f)["metrics"]
+    if args.current:
+        with open(args.current) as f:
+            current = json.load(f)["metrics"]
+    else:
+        import microbenchmark
+
+        current = getattr(microbenchmark, suite["runner"])(
+            args.out)["metrics"]
+    return [f"[{name}] {f_}"
+            for f_ in compare(baseline, current, args.tolerance)]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--suite", choices=sorted(SUITES), default="control",
-                    help="which gate suite to run (default: control)")
+    ap.add_argument("--suite", choices=sorted(SUITES) + ["all"],
+                    default="control",
+                    help="which gate suite to run (default: control); "
+                         "'all' runs every committed suite in sequence")
     ap.add_argument("--baseline", default=None,
                     help="committed artifact to compare against "
                          "(default: the suite's artifact)")
@@ -132,20 +164,16 @@ def main() -> int:
                     help="also write the fresh run's JSON here")
     args = ap.parse_args()
 
-    suite = SUITES[args.suite]
-    baseline_path = args.baseline or os.path.join(HERE, suite["baseline"])
-    with open(baseline_path) as f:
-        baseline = json.load(f)["metrics"]
-    if args.current:
-        with open(args.current) as f:
-            current = json.load(f)["metrics"]
+    if args.suite == "all":
+        if args.current or args.baseline or args.out:
+            ap.error("--suite all runs each suite against its own "
+                     "artifact; --current/--baseline/--out don't apply")
+        failures = []
+        for name in SUITES:           # dict order: control first, dag last
+            print(f"\n=== suite: {name} ===")
+            failures.extend(run_suite(name, args))
     else:
-        import microbenchmark
-
-        current = getattr(microbenchmark, suite["runner"])(
-            args.out)["metrics"]
-
-    failures = compare(baseline, current, args.tolerance)
+        failures = run_suite(args.suite, args)
     if failures:
         print("\nREGRESSION GATE FAILED:")
         for f_ in failures:

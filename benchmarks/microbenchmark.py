@@ -1038,7 +1038,16 @@ def serve_plane(out_path: str | None = None) -> dict:
 
       cluster_prefix_hit_ratio — fraction of shared-prefix requests the
       cluster cache tier absorbed (local pool hit or store fetch) vs
-      paying a prefill-pool round trip (higher is better).
+      paying a prefill-pool round trip (higher is better);
+
+      proxy_dynamic_rps / proxy_compiled_rps / proxy_compiled_p99_s —
+      ISSUE-19 rows: external HTTP through the proxy against a 2-replica
+      echo deployment in MATCHED windows (same clients, same request
+      count), first over the dynamic per-request handle path, then over
+      the compiled ingress (the proxy writes request batches straight
+      into the deployment's CompiledServeChain rings, lanes spread over
+      both replicas). Acceptance: compiled beats dynamic, and
+      proxy_compiled_p99_s holds the committed latency floor.
     """
     import ray_tpu
     from ray_tpu import serve
@@ -1166,6 +1175,104 @@ def serve_plane(out_path: str | None = None) -> dict:
           f"prefill RPC)", file=sys.stderr, flush=True)
     serve.delete("bench-px")
     serve.delete("bench-px-prefill")
+
+    phase("proxy compiled ingress (matched HTTP windows)")
+    # ISSUE 19 acceptance rows: external HTTP through the proxy, same
+    # echo deployment / client count / request count, dynamic vs
+    # compiled ingress. proxy_compiled_rps must beat proxy_dynamic_rps
+    # (warm proxy requests ride the chain rings with zero control-plane
+    # RPCs); proxy_compiled_p99_s is the latency floor the gate holds.
+    import threading
+    import urllib.request
+
+    @serve.deployment
+    class _ProxyEcho:
+        def __call__(self, request):
+            return {"ok": True}
+
+    def _drive_http(url, n=240, concurrency=8):
+        import queue as _q
+
+        q: "_q.Queue" = _q.Queue()
+        for i in range(n):
+            q.put(i)
+        lats, errors = [], []
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                try:
+                    q.get_nowait()
+                except _q.Empty:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    req = urllib.request.Request(
+                        url, data=b'{"x": 1}',
+                        headers={"Content-Type": "application/json"})
+                    urllib.request.urlopen(req, timeout=60).read()
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lats.append(dt)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        return time.perf_counter() - t0, lats, errors
+
+    port = serve.start()
+
+    # window 1 — dynamic ingress (per-request handle dispatch)
+    serve.run(_ProxyEcho.options(num_replicas=2,
+                                 max_ongoing_requests=16).bind(),
+              name="bench-proxy-dyn", route_prefix="/benchproxydyn")
+    url = f"http://127.0.0.1:{port}/benchproxydyn"
+    _drive_http(url, n=32)                      # warm routers/replicas
+    elapsed, lats, errors = _drive_http(url)
+    assert not errors, errors[:3]
+    results["proxy_dynamic_rps"] = len(lats) / elapsed
+    serve.delete("bench-proxy-dyn")
+
+    # window 2 — compiled ingress (proxy writes into the chain rings,
+    # lanes spread over both replicas)
+    serve.run(_ProxyEcho.options(num_replicas=2,
+                                 max_ongoing_requests=16).bind(),
+              name="bench-proxy-cc", route_prefix="/benchproxycc",
+              compiled=True)
+    url = f"http://127.0.0.1:{port}/benchproxycc"
+    _drive_http(url, n=4)                       # prime the router
+    proxy = ray_tpu.get_actor("serve-proxy")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        st = ray_tpu.get(proxy.chain_status.remote("bench-proxy-cc"),
+                         timeout=30)
+        if st.get("live"):
+            break
+        time.sleep(0.25)
+    assert st.get("live"), f"proxy chain never compiled: {st}"
+    _drive_http(url, n=32)                      # warm the ring path
+    elapsed, lats, errors = _drive_http(url)
+    assert not errors, errors[:3]
+    st = ray_tpu.get(proxy.chain_status.remote("bench-proxy-cc"),
+                     timeout=30)
+    assert (st.get("stats") or {}).get("compiled", 0) > 0, \
+        f"timed window never rode the compiled path: {st}"
+    results["proxy_compiled_rps"] = len(lats) / elapsed
+    results["proxy_compiled_p99_s"] = float(np.percentile(lats, 99))
+    print(f"[microbenchmark] proxy ingress: compiled "
+          f"{results['proxy_compiled_rps']:.1f} req/s vs dynamic "
+          f"{results['proxy_dynamic_rps']:.1f} req/s "
+          f"({results['proxy_compiled_rps'] / max(results['proxy_dynamic_rps'], 1e-9):.2f}x), "
+          f"compiled p99 {results['proxy_compiled_p99_s'] * 1e3:.1f} ms",
+          file=sys.stderr, flush=True)
+    serve.delete("bench-proxy-cc")
     serve.shutdown()
     ray_tpu.shutdown()
 
@@ -1188,7 +1295,17 @@ def serve_plane(out_path: str | None = None) -> dict:
                   "cluster_prefix_hit_ratio":
                       "shared-prefix requests absorbed by the cache "
                       "tier (decode-local pool or content-addressed "
-                      "store fetch) vs prefill-pool round trips"}}
+                      "store fetch) vs prefill-pool round trips",
+                  "proxy_compiled_rps":
+                      "external HTTP through the proxy's compiled "
+                      "ingress (request batches written into the "
+                      "deployment's CompiledServeChain rings, lanes "
+                      "spread over 2 replicas); matched window vs "
+                      "proxy_dynamic_rps, the per-request handle "
+                      "dispatch baseline it must beat",
+                  "proxy_compiled_p99_s":
+                      "p99 external-HTTP latency of the compiled "
+                      "ingress window (seconds, lower is better)"}}
     print(json.dumps(report, indent=2))
     if out_path:
         with open(out_path, "w") as f:
@@ -1681,8 +1798,9 @@ if __name__ == "__main__":
                         "(serve_sustained_rps, serve_fixed_batch_rps, "
                         "serve_p99_s, disagg_ttft_s, "
                         "disagg_shared_prefix_ttft_s, "
-                        "cluster_prefix_hit_ratio) and emit the "
-                        "regression artifact")
+                        "cluster_prefix_hit_ratio, proxy_dynamic_rps, "
+                        "proxy_compiled_rps, proxy_compiled_p99_s) and "
+                        "emit the regression artifact")
     args = p.parse_args()
     if args.dag:
         dag_plane(args.out)

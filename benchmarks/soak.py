@@ -43,6 +43,14 @@ FIXED seed, so a failure replays identically:
   chain must recompile over the replacement replica and serve compiled
   traffic again before the phase ends.
 
+  phase 3c — external HTTP over the compiled ingress: a `compiled=True`
+  two-replica deployment behind the HTTP proxy (the proxy writes request
+  batches straight into its CompiledServeChain rings, lanes spread over
+  both replicas); mid-load one replica chaos-self-kills. ZERO non-shed
+  HTTP failures may surface to the external clients, and the proxy's
+  chain must recompile its lanes over the replacement replica
+  (generation bump observed via `proxy.chain_status`).
+
   phase 4 — elastic-train drill: a 2-worker GPT-2-DDP run
   (microbenchmark._elastic_train_loop); once the gang makes progress, a
   `kill:*:n=1` plan is injected into one daemon over the chaos control
@@ -469,6 +477,143 @@ def compiled_chain_soak(seed: int, duration_s: float = 8.0,
             "chaos": f"seed={seed},kill:*:n=1 (replica self-kill)"}
 
 
+def proxy_compiled_soak(seed: int, duration_s: float = 10.0,
+                        clients: int = 6) -> dict:
+    """External-HTTP-over-compiled-path phase (ISSUE 19): a
+    `compiled=True` deployment with TWO replicas behind the HTTP proxy —
+    the proxy writes request batches straight into its per-deployment
+    CompiledServeChain rings (lanes spread across both replicas) —
+    while one replica chaos-self-kills mid-load
+    (`protocol.configure_chaos("kill:*:n=1")` armed inside the replica).
+    Acceptance: ZERO non-shed HTTP failures (the chain fences and fails
+    in-flight entries over to the dynamic handle path; no external
+    client ever sees a 500), the proxy chain recompiles its lanes over
+    the replacement replica (generation bump), and compiled traffic
+    resumes before the phase ends."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+
+    @serve.deployment
+    class ProxySoakTarget:
+        def __call__(self, request):
+            time.sleep(0.005)
+            return {"ok": True, "pid": os.getpid()}
+
+        def arm_chaos(self, spec: str) -> bool:
+            from ray_tpu.core import protocol
+
+            protocol.configure_chaos(spec)
+            return True
+
+    handle = serve.run(
+        ProxySoakTarget.options(num_replicas=2, max_ongoing_requests=16,
+                                chain_config={"lanes": 2, "max_inflight": 2,
+                                              "batch_max": 8,
+                                              "entry_timeout_s": 60,
+                                              "recompile_timeout_s": 120}
+                                ).bind(),
+        name="soak-proxy", route_prefix="/soakproxy", compiled=True)
+    port = serve.start()
+    url = f"http://127.0.0.1:{port}/soakproxy"
+    proxy = ray_tpu.get_actor("serve-proxy")
+
+    def chain_state():
+        return ray_tpu.get(proxy.chain_status.remote("soak-proxy"),
+                           timeout=30)
+
+    # one request primes the router; then wait for the chain to go live
+    urllib.request.urlopen(urllib.request.Request(
+        url, data=b'{"x": 0}',
+        headers={"Content-Type": "application/json"}), timeout=60).read()
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        st = chain_state()
+        if st.get("live"):
+            break
+        time.sleep(0.25)
+    else:
+        raise AssertionError(f"proxy chain never went live: {st}")
+    gen0 = st["generation"]
+
+    codes, lats, pids = [], [], []
+    lock = threading.Lock()
+    stop = time.monotonic() + duration_s
+
+    def client():
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            pid = None
+            try:
+                req = urllib.request.Request(
+                    url, data=b'{"x": 1}',
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    pid = _json.loads(r.read()).get("pid")
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except Exception:
+                code = -1
+            with lock:
+                codes.append(code)
+                if code == 200:
+                    lats.append(time.perf_counter() - t0)
+                    pids.append(pid)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s / 3)
+    # chaos-inject the replica kill mid-load (the dynamic handle routes
+    # the arm call to ONE of the two spread replicas; it SIGKILLs itself
+    # on its next outbound telemetry push)
+    assert handle.arm_chaos.remote(
+        f"seed={seed},kill:*:n=1").result(timeout=30) is True
+    for t in threads:
+        t.join(duration_s + 120)
+    elapsed = time.perf_counter() - t_start
+    # lanes must recompile over the replacement replica
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        st = chain_state()
+        if st.get("live") and st["generation"] > gen0:
+            break
+        time.sleep(0.5)
+    stats = dict(st.get("stats") or {})
+    served = sum(1 for c in codes if c == 200)
+    shed = sum(1 for c in codes if c == 429)
+    failed = len(codes) - served - shed
+    try:
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+    assert failed == 0, f"{failed} non-shed failures (codes={set(codes)})"
+    assert served > 0
+    assert st.get("live") and st["generation"] > gen0, \
+        f"proxy chain never recompiled after the kill: {st}"
+    assert stats.get("compiled", 0) > 0, \
+        f"no requests rode the compiled path: {stats}"
+    return {"duration_s": round(elapsed, 2), "served": served,
+            "shed": shed, "failed": failed,
+            "rps": round(served / elapsed, 1),
+            "p99_s": round(float(np.percentile(lats, 99)), 4),
+            "replicas_seen": len(set(pids)),
+            "generations": [gen0, st["generation"]],
+            "compiled": stats.get("compiled"),
+            "dynamic_fallback": stats.get("dynamic_fallback"),
+            "chaos": f"seed={seed},kill:*:n=1 (replica self-kill)"}
+
+
 def shuffle_kill_soak(seed: int, P: int = 4) -> dict:
     """Kill-a-shuffle-node phase (ISSUE 15): a distributed hash shuffle
     lands its map sub-blocks on one isolated node; that node is
@@ -519,6 +664,9 @@ def main(seed: int = 7, out: str | None = None, rounds: int = 6,
     print(f"[soak] compiled chain under replica chaos kill (seed={seed})",
           file=sys.stderr)
     report["compiled_chain"] = compiled_chain_soak(seed)
+    print(f"[soak] external HTTP over compiled ingress under replica "
+          f"chaos kill (seed={seed})", file=sys.stderr)
+    report["proxy_compiled"] = proxy_compiled_soak(seed)
     print(f"[soak] elastic train drill (seed={seed})", file=sys.stderr)
     report["elastic_train"] = elastic_train_drill(seed, steps=steps)
     print(json.dumps(report, indent=2))

@@ -302,6 +302,125 @@ int rtpu_chan_read(void* hp, uint64_t last_seq, uint8_t* out,
   return 0;
 }
 
+// ---------------------------------------------------------------- zero-copy
+// Split write: reserve hands the writer a pointer INTO the next ring slot
+// so it can serialize in place (no staging buffer + memcpy pair); commit
+// publishes it. Safe under the single-writer contract: between reserve and
+// commit the slot is invisible to readers — it is only reservable once
+// every reader acked its previous value (acks >= num_readers), so every
+// reader cursor is already past it, and seq is not bumped until commit.
+// Abandoning a reservation (serialize failed) needs no cleanup: the next
+// reserve returns the same slot.
+int rtpu_chan_reserve(void* hp, uint64_t len, int64_t timeout_ms,
+                      uint8_t** ptr_out) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  ChanHeader* H = chdr(h);
+  if (len > H->capacity) return -4;
+  if (chan_lock(h) != 0) return -1;
+  SlotMeta* S = slots(h);
+  uint32_t slot;
+  uint64_t stall0 = 0;
+  for (;;) {
+    if (H->closed) {
+      pthread_mutex_unlock(&H->mutex);
+      return -2;
+    }
+    slot = slot_of(H, H->seq + 1);
+    if (S[slot].seq == 0 || S[slot].acks >= H->num_readers) break;
+    if (stall0 == 0) stall0 = mono_ns();
+    if (chan_wait(h, timeout_ms) == ETIMEDOUT) {
+      H->writer_stall_ns += mono_ns() - stall0;
+      pthread_mutex_unlock(&H->mutex);
+      return -3;
+    }
+  }
+  if (stall0 != 0) H->writer_stall_ns += mono_ns() - stall0;
+  *ptr_out = payload(h, slot);
+  pthread_mutex_unlock(&H->mutex);
+  return 0;
+}
+
+int rtpu_chan_commit(void* hp, uint64_t len) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  ChanHeader* H = chdr(h);
+  if (len > H->capacity) return -4;
+  if (chan_lock(h) != 0) return -1;
+  if (H->closed) {
+    pthread_mutex_unlock(&H->mutex);
+    return -2;
+  }
+  // single writer: the reserved slot is still slot_of(seq + 1)
+  SlotMeta* S = slots(h);
+  uint32_t slot = slot_of(H, H->seq + 1);
+  S[slot].len = len;
+  S[slot].acks = 0;
+  S[slot].seq = ++H->seq;
+  H->writes++;
+  pthread_cond_broadcast(&H->cond);
+  pthread_mutex_unlock(&H->mutex);
+  return 0;
+}
+
+// Split read: same wait/fast-forward/drain-after-close discipline as
+// rtpu_chan_read, but hands back a pointer into the slot WITHOUT copying
+// and WITHOUT acking — the slot stays pinned (the writer cannot reclaim
+// it) until rtpu_chan_ack(seq). The caller must ack exactly once per
+// viewed value or the ring wedges when it wraps around to that slot.
+int rtpu_chan_read_view(void* hp, uint64_t last_seq, uint64_t* seq_out,
+                        uint64_t* len_out, uint8_t** ptr_out,
+                        int64_t timeout_ms) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  ChanHeader* H = chdr(h);
+  if (chan_lock(h) != 0) return -1;
+  SlotMeta* S = slots(h);
+  uint64_t wanted;
+  uint64_t stall0 = 0;
+  for (;;) {
+    wanted = last_seq + 1;
+    if (H->seq >= H->num_slots && wanted < H->seq - H->num_slots + 1)
+      wanted = H->seq - H->num_slots + 1;
+    if (wanted <= H->seq) break;
+    if (H->closed) {
+      if (stall0 != 0) H->reader_stall_ns += mono_ns() - stall0;
+      pthread_mutex_unlock(&H->mutex);
+      return -2;
+    }
+    if (stall0 == 0) stall0 = mono_ns();
+    if (chan_wait(h, timeout_ms) == ETIMEDOUT) {
+      H->reader_stall_ns += mono_ns() - stall0;
+      pthread_mutex_unlock(&H->mutex);
+      return -3;
+    }
+  }
+  if (stall0 != 0) H->reader_stall_ns += mono_ns() - stall0;
+  uint32_t slot = slot_of(H, wanted);
+  *seq_out = wanted;
+  *len_out = S[slot].len;
+  *ptr_out = payload(h, slot);
+  pthread_mutex_unlock(&H->mutex);
+  return 0;
+}
+
+// Release a viewed value: counts the reader's ack and wakes a writer
+// blocked on that slot. 0 ok; -5 if the slot no longer holds `seq`
+// (double-ack after the ring already wrapped — a caller bug).
+int rtpu_chan_ack(void* hp, uint64_t seq) {
+  ChanHandle* h = reinterpret_cast<ChanHandle*>(hp);
+  ChanHeader* H = chdr(h);
+  if (chan_lock(h) != 0) return -1;
+  SlotMeta* S = slots(h);
+  uint32_t slot = slot_of(H, seq);
+  if (S[slot].seq != seq) {
+    pthread_mutex_unlock(&H->mutex);
+    return -5;
+  }
+  S[slot].acks++;
+  H->reads++;
+  if (S[slot].acks >= H->num_readers) pthread_cond_broadcast(&H->cond);
+  pthread_mutex_unlock(&H->mutex);
+  return 0;
+}
+
 uint64_t rtpu_chan_capacity(void* hp) {
   return chdr(reinterpret_cast<ChanHandle*>(hp))->capacity;
 }

@@ -190,3 +190,21 @@ def dumps(value: Any) -> bytes:
 
 def loads(data: bytes) -> Any:
     return deserialize(SerializedObject.from_view(memoryview(data)))
+
+
+def loads_view(view: memoryview) -> Any:
+    """Deserialize from a BORROWED view without retaining it: the result
+    owns its memory, so the caller may release/reuse the backing storage
+    (a shm ring slot) immediately after. The common meta-only frame (no
+    out-of-band buffers — e.g. serve request dicts) costs zero buffer
+    copies; frames with out-of-band buffers (numpy) pay exactly one copy
+    per buffer — half the memcpy pair of the staging-buffer read path."""
+    obj = SerializedObject.from_view(view)
+    if obj.meta == _BYTES_META:
+        return bytes(obj.buffers[0])
+    if obj.meta == _BYTEARRAY_META:
+        return bytearray(obj.buffers[0])
+    if obj.buffers:
+        obj = SerializedObject(
+            obj.meta, [memoryview(bytes(b)) for b in obj.buffers])
+    return deserialize(obj)

@@ -49,6 +49,16 @@ def _lib():
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
+        lib.rtpu_chan_reserve.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.rtpu_chan_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rtpu_chan_read_view.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64]
+        lib.rtpu_chan_ack.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.rtpu_chan_capacity.restype = ctypes.c_uint64
         lib.rtpu_chan_capacity.argtypes = [ctypes.c_void_p]
         lib.rtpu_chan_num_readers.restype = ctypes.c_uint32
@@ -158,8 +168,23 @@ class Channel:
         ch.num_slots = lib.rtpu_chan_num_slots(ch._h)
         return ch
 
+    @staticmethod
+    def _wrap(ptr: int, n: int) -> memoryview:
+        # writable view over n bytes of the mapped slot (no copy)
+        return memoryview((ctypes.c_char * n).from_address(ptr)).cast("B")
+
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
-        data = serialization.dumps(value)
+        # zero-copy path: reserve the next ring slot and serialize INTO it
+        # (SerializedObject.write_into), instead of flattening to a bytes
+        # staging buffer and memcpy'ing that into the slot — one copy per
+        # hop instead of two
+        sobj = serialization.serialize(value)
+        n = sobj.frame_bytes
+        if n > self.capacity:
+            raise ChannelError(
+                f"value of {n} bytes exceeds channel capacity "
+                f"{self.capacity}")
+        ptr = ctypes.c_void_p()
         t0 = time.perf_counter()
         # _oplock serializes native ops on THIS handle so close() can
         # never munmap the segment under a thread still inside the
@@ -167,9 +192,16 @@ class Channel:
         with self._oplock:
             if not self._h:
                 raise ChannelClosedError(self.name)
-            rc = self._lib_ref.rtpu_chan_write(
-                self._h, data, len(data),
-                -1 if timeout is None else int(timeout * 1000))
+            rc = self._lib_ref.rtpu_chan_reserve(
+                self._h, n,
+                -1 if timeout is None else int(timeout * 1000),
+                ctypes.byref(ptr))
+            if rc == 0:
+                # single-writer contract: the reserved slot is invisible
+                # to readers until commit publishes the seq bump, so
+                # serializing in place here cannot race a reader
+                sobj.write_into(self._wrap(ptr.value, n))
+                rc = self._lib_ref.rtpu_chan_commit(self._h, n)
         _observe_wait("write", time.perf_counter() - t0)
         if rc == -2:
             raise ChannelClosedError(self.name)
@@ -177,44 +209,67 @@ class Channel:
             raise TimeoutError(f"write to {self.name} timed out")
         if rc == -4:
             raise ChannelError(
-                f"value of {len(data)} bytes exceeds channel capacity "
+                f"value of {n} bytes exceeds channel capacity "
                 f"{self.capacity}")
         if rc != 0:
             raise ChannelError(f"write failed rc={rc}")
 
-    def _buf(self):
-        # reuse one capacity-sized buffer: create_string_buffer zero-fills,
-        # which would dominate per-read cost for multi-MB channels
-        buf = getattr(self, "_read_buf", None)
-        if buf is None:
-            cap = self._lib_ref.rtpu_chan_capacity(self._h)
-            buf = self._read_buf = ctypes.create_string_buffer(cap)
-        return buf
-
-    def read(self, timeout: Optional[float] = None) -> Any:
+    def _read_view(self, last_seq: int, timeout: Optional[float], op: str):
+        """Shared view-read core: block for the value after `last_seq`,
+        return (seq, len, ptr) WITHOUT copying or acking. Caller must ack
+        via rtpu_chan_ack once done with the slot bytes."""
         seq = ctypes.c_uint64()
         ln = ctypes.c_uint64()
+        ptr = ctypes.c_void_p()
         t0 = time.perf_counter()
         with self._oplock:
             if not self._h:
                 raise ChannelClosedError(self.name)
-            buf = self._buf()
-            rc = self._lib_ref.rtpu_chan_read(
-                self._h, self._last_seq, buf, len(buf), ctypes.byref(seq),
-                ctypes.byref(ln),
+            rc = self._lib_ref.rtpu_chan_read_view(
+                self._h, last_seq, ctypes.byref(seq), ctypes.byref(ln),
+                ctypes.byref(ptr),
                 -1 if timeout is None else int(timeout * 1000))
-            data = (ctypes.string_at(buf, ln.value) if rc == 0 else b"")
-        _observe_wait("read", time.perf_counter() - t0)
+        _observe_wait(op, time.perf_counter() - t0)
         if rc == -2:
             raise ChannelClosedError(self.name)
         if rc == -3:
             raise TimeoutError(f"read from {self.name} timed out")
         if rc != 0:
             raise ChannelError(f"read failed rc={rc}")
-        self._last_seq = seq.value
-        # string_at copies exactly len bytes (buf.raw would copy the whole
-        # capacity-sized buffer first)
-        return serialization.loads(data)
+        return seq.value, ln.value, ptr.value
+
+    def _ack(self, seq: int) -> None:
+        # quick non-blocking native call; _close_lock (never held across a
+        # blocking op) keeps close() from munmapping under it
+        with self._close_lock:
+            if self._h:
+                self._lib_ref.rtpu_chan_ack(self._h, seq)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        """Consume the next value. Zero-copy slot path: deserialize
+        straight from a view over the ring slot, then ack — no staging
+        buffer memcpy, no string_at copy. Meta-only frames (dicts etc.)
+        deserialize with zero buffer copies; out-of-band buffers (numpy)
+        pay exactly one owning copy (the result must outlive the slot)."""
+        seq, ln, ptr = self._read_view(self._last_seq, timeout, "read")
+        try:
+            value = serialization.loads_view(self._wrap(ptr, ln))
+        finally:
+            self._ack(seq)
+        self._last_seq = seq
+        return value
+
+    def read_zc(self, timeout: Optional[float] = None) -> "SlotView":
+        """Consume the next value as a PINNED zero-copy view: the ring
+        slot is not acked (so the writer cannot reclaim it) until the
+        returned SlotView is released. `value()` deserializes fully
+        aliasing the slot — out-of-band numpy buffers point INTO shm with
+        no copy at all. The caller must release() (or use the context
+        manager) exactly once; a leaked view wedges the writer when the
+        ring wraps back to that slot."""
+        seq, ln, ptr = self._read_view(self._last_seq, timeout, "read")
+        self._last_seq = seq
+        return SlotView(self, seq, self._wrap(ptr, ln))
 
     def read_raw(self, last_seq: int, timeout: Optional[float] = None
                  ) -> tuple:
@@ -224,26 +279,14 @@ class Channel:
         of remote readers through the dag_chan_read RPC (reference
         remote-reader mutable objects,
         `core_worker/experimental_mutable_object_provider.cc`)."""
-        seq = ctypes.c_uint64()
-        ln = ctypes.c_uint64()
-        t0 = time.perf_counter()
-        with self._oplock:
-            if not self._h:
-                raise ChannelClosedError(self.name)
-            buf = self._buf()
-            rc = self._lib_ref.rtpu_chan_read(
-                self._h, last_seq, buf, len(buf), ctypes.byref(seq),
-                ctypes.byref(ln),
-                -1 if timeout is None else int(timeout * 1000))
-            data = (ctypes.string_at(buf, ln.value) if rc == 0 else b"")
-        _observe_wait("raw_read", time.perf_counter() - t0)
-        if rc == -2:
-            raise ChannelClosedError(self.name)
-        if rc == -3:
-            raise TimeoutError(f"read from {self.name} timed out")
-        if rc != 0:
-            raise ChannelError(f"read failed rc={rc}")
-        return seq.value, data
+        seq, ln, ptr = self._read_view(last_seq, timeout, "raw_read")
+        try:
+            # one owning copy (the bytes cross an RPC); the staging-buffer
+            # path paid two (slot->buf memcpy + string_at)
+            data = bytes(self._wrap(ptr, ln))
+        finally:
+            self._ack(seq)
+        return seq, data
 
     def snapshot(self) -> dict:
         """Lock-free telemetry snapshot of the shm ring header: the native
@@ -301,6 +344,48 @@ class Channel:
         # channels travel by name; receivers attach (and recover the true
         # num_readers / num_slots from the shm header)
         return (Channel.attach, (self.name,))
+
+
+class SlotView:
+    """One ring value viewed in place (``Channel.read_zc``): ``view()``
+    and ``value()`` alias the shm slot directly; the slot stays pinned —
+    the writer blocks before overwriting it — until ``release()``. The
+    PR-7 DLPack-adoption discipline applied to ring slots: consume the
+    bytes where they already are, give the slot back explicitly."""
+
+    __slots__ = ("_chan", "seq", "_mv", "_released")
+
+    def __init__(self, chan: "Channel", seq: int, mv: memoryview):
+        self._chan = chan
+        self.seq = seq
+        self._mv = mv
+        self._released = False
+
+    def view(self) -> memoryview:
+        if self._released:
+            raise ChannelError(f"slot view {self.seq} already released")
+        return self._mv
+
+    def value(self) -> Any:
+        """Deserialize fully aliasing the slot: out-of-band buffers
+        (numpy arrays) point INTO shm — zero copies. The result is only
+        valid until release(); consumers that outlive the slot must copy
+        what they keep (or use Channel.read, which owns its result)."""
+        return serialization.deserialize(
+            serialization.SerializedObject.from_view(self.view()))
+
+    def release(self) -> None:
+        """Ack the slot (idempotent), letting the writer reclaim it."""
+        if not self._released:
+            self._released = True
+            self._mv = None
+            self._chan._ack(self.seq)
+
+    def __enter__(self) -> "SlotView":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
 
 
 class RemoteChannelReader:

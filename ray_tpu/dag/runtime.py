@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Any, Dict, List
 
 from ray_tpu.dag.channel import (Channel, ChannelClosedError,
-                                 RemoteChannelReader)
+                                 RemoteChannelReader, SlotView)
 
 # device-edge descriptor: the channel carries this tiny dict; the tensor
 # stays in the producer's device store (reference
@@ -108,11 +108,20 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
             # one channel may feed several steps in an iteration: read once
             read_cache: Dict[tuple, Any] = {}
             futures = {}
+            # local channels are consumed ZERO-COPY (read_zc): step inputs
+            # alias the ring slot, which stays pinned — the writer cannot
+            # overwrite it — until the views are released below, AFTER
+            # every step of the iteration has run and written its output
+            # (the output write serializes into its own slot, so nothing
+            # aliasing an input survives the release). Remote edges keep
+            # read() — their bytes already crossed an RPC.
+            pending_views: List[SlotView] = []
             if pool is not None:
                 for key, kind, name, addr in prefetchable:
                     r = reader((kind, name if kind == "chan"
                                 else (name, addr)))
-                    futures[key] = pool.submit(r.read)
+                    futures[key] = pool.submit(
+                        r.read_zc if isinstance(r, Channel) else r.read)
 
             def fetch(ref) -> Any:
                 key = _ref_key(ref)
@@ -120,33 +129,48 @@ def exec_dag_loop(instance: Any, schedule: List[dict]) -> int:
                     if key in futures:
                         value = futures.pop(key).result()
                     else:
-                        value = reader(ref).read()
+                        r = reader(ref)
+                        value = (r.read_zc() if isinstance(r, Channel)
+                                 else r.read())
+                    if isinstance(value, SlotView):
+                        pending_views.append(value)
+                        value = value.value()
                     read_cache[key] = materialize_channel_value(value)
                 return read_cache[key]
 
-            for step in schedule:
-                args = [fetch((kind, v)) if kind in ("chan", "rchan") else v
-                        for kind, v in step["args"]]
-                kwargs = {k: (fetch((kind, v)) if kind in ("chan", "rchan")
-                              else v)
-                          for k, (kind, v) in step["kwargs"].items()}
-                result = getattr(instance, step["method"])(*args, **kwargs)
-                out = step["out_chan"]
-                if out:
-                    if step.get("transport") == "device":
-                        from ray_tpu.core.api import _global_client
+            try:
+                for step in schedule:
+                    args = [fetch((kind, v)) if kind in ("chan", "rchan")
+                            else v
+                            for kind, v in step["args"]]
+                    kwargs = {k: (fetch((kind, v))
+                                  if kind in ("chan", "rchan") else v)
+                              for k, (kind, v) in step["kwargs"].items()}
+                    result = getattr(instance, step["method"])(*args,
+                                                               **kwargs)
+                    out = step["out_chan"]
+                    if out:
+                        if step.get("transport") == "device":
+                            from ray_tpu.core.api import _global_client
 
-                        oref = _global_client().put_device(result)
-                        gens = dev_refs.setdefault(out, _deque())
-                        gens.append(oref)
-                        keep = writer(out).num_slots + 2
-                        while len(gens) > keep:
-                            gens.popleft()   # GC -> dec -> device free
-                        result = {DEVICE_DESC: oref.binary()}
-                    # same-actor downstream steps re-read the channel (their
-                    # ack is counted in num_readers); single-slot channels
-                    # support read-after-write in the same thread
-                    writer(out).write(result)
+                            oref = _global_client().put_device(result)
+                            gens = dev_refs.setdefault(out, _deque())
+                            gens.append(oref)
+                            keep = writer(out).num_slots + 2
+                            while len(gens) > keep:
+                                gens.popleft()   # GC -> dec -> device free
+                            result = {DEVICE_DESC: oref.binary()}
+                        # same-actor downstream steps re-read the channel
+                        # (their ack is counted in num_readers); single-slot
+                        # channels support read-after-write in the same
+                        # thread because the pinned input views released at
+                        # iteration END belong to OTHER channels (a step
+                        # reads its own output only after writing it this
+                        # iteration)
+                        writer(out).write(result)
+            finally:
+                for sv in pending_views:
+                    sv.release()
             iterations += 1
     except ChannelClosedError:
         dev_refs.clear()   # release held device outputs

@@ -380,6 +380,10 @@ def build_app(head) -> web.Application:
         return _json({
             "rings": by("hotpath"),
             "chains": by("serve_chain"),
+            # the proxies' ingress chains (serve.run(compiled=True)):
+            # same row shape as "chains", separate plane so stall
+            # attribution covers the external-client edge on its own
+            "proxy_chains": by("serve_proxy"),
             "train_phases": by("train_phase"),
             "anomalies": [e for e in head.lease_events
                           if e.get("kind") == "workload_anomaly"

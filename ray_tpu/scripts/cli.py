@@ -387,6 +387,15 @@ def _render_hotpath(hp: dict, now: float) -> str:
              f"fenced {s.get('fenced', 0)} "
              f"p99 {s.get('p99_s') if s.get('p99_s') is not None else '-'}s"
              f"  [{age(r)}]"))(r.get("stats") or {}))
+    sect("proxy ingress chains (compiled serving to the wire)",
+         sorted(hp.get("proxy_chains") or [], key=lambda r: str(r.get("key"))),
+         lambda r: (lambda s: (
+             f"{r.get('key', '?'):<40} gen {s.get('generation', 0)} "
+             f"compiled {s.get('compiled', 0)} "
+             f"fallback {s.get('dynamic_fallback', 0)} "
+             f"fenced {s.get('fenced', 0)} "
+             f"p99 {s.get('p99_s') if s.get('p99_s') is not None else '-'}s"
+             f"  [{age(r)}]"))(r.get("stats") or {}))
     sect("train phases (timed step, per rank)",
          sorted(hp.get("train_phases") or [],
                 key=lambda r: str(r.get("key"))),

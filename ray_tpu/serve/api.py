@@ -36,6 +36,13 @@ class Deployment:
     # EWMA-projected wait exceeds slo_s or every replica queue is at
     # max_queue
     slo_config: Optional[Any] = None
+    # compiled=True: the proxies serve this deployment over a standing
+    # CompiledServeChain (ring channels, lanes spread across replicas,
+    # zero control-plane RPCs per warm request) with the dynamic handle
+    # kept as the cold-start/failover path. chain_config tunes the chain
+    # (lanes, batch_max, coalesce_ms, max_inflight, channel_capacity).
+    compiled: bool = False
+    chain_config: Optional[Dict[str, Any]] = None
 
     def bind(self, *args, **kwargs) -> "Deployment":
         return dataclasses.replace(self, init_args=args, init_kwargs=kwargs)
@@ -62,6 +69,8 @@ class Deployment:
             "init_kwargs": self.init_kwargs,
             "visible_chips": self.visible_chips,
             "slo_config": slo.to_dict() if slo is not None else None,
+            "compiled": bool(self.compiled),
+            "chain_config": self.chain_config,
         }
 
 
@@ -71,7 +80,9 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
                max_ongoing_requests: int = 8,
                user_config: Any = None,
                autoscaling_config: Optional[Any] = None,
-               slo_config: Optional[Any] = None):
+               slo_config: Optional[Any] = None,
+               compiled: bool = False,
+               chain_config: Optional[dict] = None):
     def deco(obj):
         return Deployment(
             func_or_class=obj,
@@ -81,7 +92,9 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
             max_ongoing_requests=max_ongoing_requests,
             user_config=user_config,
             autoscaling_config=autoscaling_config,
-            slo_config=slo_config)
+            slo_config=slo_config,
+            compiled=compiled,
+            chain_config=chain_config)
 
     if _func_or_class is not None:
         return deco(_func_or_class)
@@ -127,13 +140,21 @@ def _resolve_composition(value, controller):
 
 def run(target: Deployment, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None,
+        compiled: Optional[bool] = None,
         _blocking: bool = True,
         _local_testing_mode: bool = False):
     """Deploy and return a handle (reference serve.run).
 
+    `compiled=True` marks the deployment for the proxies' compiled
+    ingress path (standing ring channels instead of per-request actor
+    calls; see serve/compiled_chain.py) — equivalent to
+    `@serve.deployment(compiled=True)`, overriding the decorator.
+
     `_local_testing_mode=True` runs the deployment IN-PROCESS with no
     cluster (reference local_testing_mode): unit-test deployment logic
     without actors/proxies."""
+    if compiled is not None:
+        target = dataclasses.replace(target, compiled=bool(compiled))
     if _local_testing_mode:
         return LocalDeploymentHandle(
             target if name is None else dataclasses.replace(target,

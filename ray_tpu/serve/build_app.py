@@ -17,7 +17,14 @@ applications:
       - name: Greeter
         num_replicas: 3
         max_ongoing_requests: 16
+        compiled: true                    # proxies serve this deployment
+        chain_config: {lanes: 4}          # over CompiledServeChain rings
 ```
+
+Overrides map straight onto `Deployment.options(**opts)`, so every
+dataclass field works — including `compiled`/`chain_config`, which flip
+the deployment onto the proxies' compiled ingress (ring channels, lanes
+spread across replicas; see serve/compiled_chain.py).
 """
 
 from __future__ import annotations

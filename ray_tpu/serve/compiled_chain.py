@@ -23,11 +23,17 @@ while earlier entries still execute.
 
 Lanes: a replica's exec loop processes ring entries one at a time, which
 would serialize an LLM engine across entries. `lanes=k` compiles k
-INDEPENDENT channel rings over the same replica chain; each lane's exec
-loop occupies one replica executor thread, so up to k entries execute
-concurrently inside the replica and the engine's per-step join/evict
-batches across them — the compiled analogue of the dynamic path's
-concurrent actor calls, still with zero per-request RPCs.
+INDEPENDENT channel rings, and lanes are SPREAD round-robin across the
+deployment's healthy replicas (lane i runs over replica i % m for each
+stage) — load balancing without per-request routing, decided once at
+compile time. Lanes that land on the same replica each occupy one
+replica executor thread, so entries still execute concurrently inside a
+replica and the engine's per-step join/evict batches across them — the
+compiled analogue of the dynamic path's concurrent actor calls, still
+with zero per-request RPCs. Replica membership changes (death,
+autoscale) reassign lanes through the same fence + recompile machinery;
+`maybe_rebalance` lets a routing-table watcher trigger that recompile
+when replicas were ADDED (no death to observe).
 
 Failure model ("compiled chain actor dies -> recompile"): the chain
 records the cluster epoch + a local generation at compile time. A chain
@@ -94,17 +100,43 @@ class ChainResponse:
         self._ev = threading.Event()
         self._value = None
         self._exc: Optional[BaseException] = None
+        self._cbs: List = []
+        self._cb_lock = threading.Lock()
+
+    def _finish(self) -> None:
+        with self._cb_lock:
+            self._ev.set()
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:
+                pass
 
     def _set(self, value) -> None:
         self._value = value
-        self._ev.set()
+        self._finish()
 
     def _set_exc(self, exc: BaseException) -> None:
         self._exc = exc
-        self._ev.set()
+        self._finish()
 
     def done(self) -> bool:
         return self._ev.is_set()
+
+    def add_done_callback(self, fn) -> None:
+        """Invoke `fn(self)` once the response completes — immediately if
+        it already has. Runs on the completing thread: an asyncio caller
+        (the proxy) bridges with loop.call_soon_threadsafe instead of
+        parking an executor thread per in-flight request."""
+        with self._cb_lock:
+            if not self._ev.is_set():
+                self._cbs.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:
+            pass
 
     def result(self, timeout: Optional[float] = None):
         if not self._ev.wait(timeout):
@@ -127,10 +159,15 @@ class CompiledServeChain:
                  channel_capacity: int = 1 << 20,
                  entry_timeout_s: float = 60.0,
                  recompile_timeout_s: float = 60.0,
-                 controller=None):
+                 controller=None, plane: str = "serve_chain"):
         if not deployments:
             raise ValueError("need at least one deployment")
         self.deployments = list(deployments)
+        # telemetry plane label: in-process chains publish as
+        # "serve_chain"; the proxies' ingress chains publish as
+        # "serve_proxy" so /api/hotpath and `ray-tpu top` attribute
+        # stalls on the proxy edge separately
+        self.plane = plane
         self.lanes = max(1, int(lanes))
         self.max_inflight = max(1, int(max_inflight))
         self.batch_max = max(1, int(batch_max))
@@ -140,7 +177,11 @@ class CompiledServeChain:
         self.recompile_timeout_s = recompile_timeout_s
         self._controller = controller
         self._cdags: List[Any] = []
-        self._targets: List[tuple] = []       # (deployment, tag, handle)
+        self._targets: List[tuple] = []       # lane 0: (dep, tag, handle)
+        self._lane_targets: List[List[tuple]] = []   # per lane
+        self._compiled_tagsets: Dict[str, tuple] = {}
+        self._last_rebalance = 0.0
+        self._lane_rr = 0                     # round-robin cursor
         self._actor_ids: set = set()
         self.generation = 0
         self.epoch = None
@@ -198,8 +239,9 @@ class CompiledServeChain:
         return self._controller
 
     def _resolve_targets(self, exclude: Optional[set] = None) -> List[tuple]:
-        """One healthy replica per deployment, from the controller's
-        routing table (compile-time only — never on the request path)."""
+        """ALL healthy replicas per deployment, from the controller's
+        routing table (compile-time only — never on the request path).
+        Returns [(deployment, {tag: handle}), ...] in chain order."""
         import ray_tpu
 
         targets = []
@@ -214,8 +256,7 @@ class CompiledServeChain:
                             if not exclude
                             or h._actor_id.binary() not in exclude}
                 if replicas:
-                    tag = sorted(replicas)[0]
-                    targets.append((dep, tag, replicas[tag]))
+                    targets.append((dep, replicas))
                     break
                 if time.monotonic() > deadline:
                     raise TimeoutError(
@@ -226,18 +267,29 @@ class CompiledServeChain:
 
     def _compile(self, exclude: Optional[set] = None) -> None:
         """(Re)build the compiled chain; only path that talks to the
-        control plane. Each lane is an independent channel ring over the
-        SAME replica chain: one replica executor thread per lane, so
-        entries on different lanes execute concurrently."""
+        control plane. Each lane is an independent channel ring, and lane
+        i's stage-j ring targets replica i % m_j of deployment j — k
+        lanes over m replicas spread the standing rings across the whole
+        deployment (per-lane replica assignment, zero per-request
+        routing). Lanes sharing a replica each occupy one of its executor
+        threads, so their entries still execute concurrently."""
         from ray_tpu.core.api import _global_client
         from ray_tpu.dag.nodes import InputNode
 
-        targets = self._resolve_targets(exclude=exclude)
+        by_dep = self._resolve_targets(exclude=exclude)
+        lane_targets = []
+        for lane in range(self.lanes):
+            picks = []
+            for dep, replicas in by_dep:
+                tags = sorted(replicas)
+                tag = tags[lane % len(tags)]
+                picks.append((dep, tag, replicas[tag]))
+            lane_targets.append(picks)
         cdags = []
-        for _lane in range(self.lanes):
+        for picks in lane_targets:
             with InputNode() as inp:
                 node = inp
-                for _dep, _tag, handle in targets:
+                for _dep, _tag, handle in picks:
                     node = handle.handle_chain.bind(node)
             cdags.append(node.experimental_compile(
                 channel_capacity=self.capacity,
@@ -261,17 +313,24 @@ class CompiledServeChain:
                     pass
             raise
         with self._lock:
-            self._targets = targets
-            self._actor_ids = {h._actor_id.binary() for _, _, h in targets}
+            self._targets = lane_targets[0]
+            self._lane_targets = lane_targets
+            self._compiled_tagsets = {
+                dep: tuple(sorted(replicas)) for dep, replicas in by_dep}
+            self._actor_ids = {h._actor_id.binary()
+                               for picks in lane_targets
+                               for _, _, h in picks}
             self._cdags = cdags
             self._pendqs = [queue.Queue() for _ in range(self.lanes)]
             self._lane_outstanding = [0] * self.lanes
+            self._lane_rr = 0
             self.epoch = getattr(_global_client(), "cluster_epoch", None)
             self.generation += 1
             self._broken = False
             self.stats["recompiles"] += 1
         self._log("compiled", generation=self.generation,
-                  targets=[(d, t) for d, t, _h in targets])
+                  targets=[[(d, t) for d, t, _h in picks]
+                           for picks in lane_targets])
 
     def start(self) -> "CompiledServeChain":
         from ray_tpu.core.api import _global_client
@@ -402,8 +461,14 @@ class CompiledServeChain:
                     free = [i for i in range(len(self._cdags))
                             if self._lane_outstanding[i] < self.max_inflight]
                     if free:
-                        lane = min(free,
-                                   key=lambda i: self._lane_outstanding[i])
+                        # least-outstanding first, round-robin among ties:
+                        # an idle chain would otherwise send EVERY entry
+                        # down lane 0, defeating the multi-replica lane
+                        # spread exactly when requests arrive sequentially
+                        n_lanes = len(self._cdags)
+                        rr = self._lane_rr
+                        lane = min(free, key=lambda i: (
+                            self._lane_outstanding[i], (i - rr) % n_lanes))
                         busy = any(o > 0 for o in self._lane_outstanding)
                         if (busy and len(entries) < self.batch_max
                                 and time.monotonic() < window_end):
@@ -412,6 +477,7 @@ class CompiledServeChain:
                             cdag = self._cdags[lane]
                             pendq = self._pendqs[lane]
                             self._lane_outstanding[lane] += 1
+                            self._lane_rr = (lane + 1) % n_lanes
             if broken:
                 self._dynamic_submit(entries)
                 entries = []
@@ -514,7 +580,7 @@ class CompiledServeChain:
                 except Exception:
                     pass
             if snaps:
-                publish_ring_stats("serve_chain", self.chain_key, snaps)
+                publish_ring_stats(self.plane, self.chain_key, snaps)
             try:
                 row = {"generation": self.generation,
                        "compiled": self.stats["compiled"],
@@ -524,7 +590,7 @@ class CompiledServeChain:
                 if window:
                     row["p99_s"] = round(
                         window[max(0, int(len(window) * 0.99) - 1)], 6)
-                metrics.publish_workload("serve_chain", self.chain_key, row)
+                metrics.publish_workload(self.plane, self.chain_key, row)
             except Exception:
                 pass
 
@@ -673,6 +739,34 @@ class CompiledServeChain:
         """Manual recompile (tests / membership change without a death)."""
         self._fence("manual")
 
+    def maybe_rebalance(self, replica_tags: Dict[str, set],
+                        min_interval_s: float = 5.0) -> bool:
+        """Recompile when the deployment's healthy replica set GREW or
+        otherwise drifted from what the lanes were compiled over (replica
+        deaths already fence via pubsub; autoscale-up has no death to
+        observe). Callers feed fresh routing-table tag sets — e.g. the
+        proxy's 1 s table refresh — so this costs zero extra RPCs.
+        Rate-limited: a fence drains in-flight entries to the dynamic
+        path, so rebalance storms would hurt more than a briefly
+        lopsided lane assignment. Returns True when a fence was issued."""
+        with self._lock:
+            if self._broken or self._shutdown or not self._compiled_tagsets:
+                return False
+            now = time.monotonic()
+            if now - self._last_rebalance < min_interval_s:
+                return False
+            drift = False
+            for dep, compiled in self._compiled_tagsets.items():
+                fresh = replica_tags.get(dep)
+                if fresh is not None and tuple(sorted(fresh)) != compiled:
+                    drift = True
+                    break
+            if not drift:
+                return False
+            self._last_rebalance = now
+        self._fence("rebalance")
+        return True
+
     # ------------------------------------------------------- dynamic path
     def _dyn_handle(self, dep: str):
         if dep not in self._dyn_handles:
@@ -742,8 +836,15 @@ class CompiledServeChain:
 
     # ------------------------------------------------------------ control
     def targets(self) -> List[tuple]:
+        """Lane 0's (deployment, tag) chain — kept for compatibility;
+        lane_targets() exposes the full per-lane spread."""
         with self._lock:
             return [(d, t) for d, t, _h in self._targets]
+
+    def lane_targets(self) -> List[List[tuple]]:
+        with self._lock:
+            return [[(d, t) for d, t, _h in picks]
+                    for picks in self._lane_targets]
 
     def is_compiled(self) -> bool:
         with self._lock:

@@ -104,7 +104,17 @@ class ServeController:
             return {"version": info.version,
                     "replicas": {tag: h for tag, h in info.replicas.items()},
                     "models": dict(self.multiplexed.get(name, {})),
-                    "slo": slo}
+                    "slo": slo,
+                    # compiled ingress: the proxies stand up a
+                    # CompiledServeChain for this deployment and route
+                    # warm requests over its rings (serve/compiled_chain)
+                    "compiled": bool(info.config.get("compiled")),
+                    "chain": info.config.get("chain_config"),
+                    # lets the proxy tell a DEGRADED chain (lanes
+                    # compiled over fewer replicas than intended, e.g.
+                    # mid-replacement) from a settled one and poll fast
+                    # until the lanes re-spread
+                    "target_replicas": info.target_replicas}
 
     # ------------------------------------------------------- routes / proxy
     def set_route(self, route_prefix: str, deployment_name: str):

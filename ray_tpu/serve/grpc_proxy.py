@@ -118,9 +118,15 @@ class _GrpcServer:
                                             "rpc.method":
                                                 handler_call_details.method,
                                             "rpc.app": dep}):
+                            # compiled ingress rides here too (the router
+                            # is shared with the HTTP proxy); streaming
+                            # bodies stay dynamic — replica-affine
+                            stream = bool(isinstance(body, dict)
+                                          and body.get("stream"))
                             result = await router.submit(
                                 "__call__", (req,), {}, model_id=model_id,
-                                prefix_key=prompt_prefix_key(body))
+                                prefix_key=prompt_prefix_key(body),
+                                allow_compiled=not stream)
                     except Exception as e:  # surface detail like HTTP's 500
                         code = "INTERNAL"
                         await context.abort(grpc.StatusCode.INTERNAL, repr(e))
@@ -147,6 +153,13 @@ class _GrpcServer:
         return bound
 
     async def stop(self):
+        loop = asyncio.get_running_loop()
+        for router in list(self._routers.values()):
+            try:
+                await asyncio.wait_for(
+                    loop.run_in_executor(None, router.shutdown_chain), 20)
+            except Exception:
+                pass
         if self._server is not None:
             await self._server.stop(grace=1.0)
 

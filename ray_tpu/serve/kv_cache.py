@@ -208,7 +208,14 @@ class PagedKVCache:
 def export_prefix(kv: "PagedKVCache", ids) -> Optional[dict]:
     """Serialize the pooled KV blocks covering `ids`' prefix into a
     host-memory blob: {"ids", "k", "v"} with k/v [n_blocks, L, H, Bs, Dh].
-    Returns None when nothing is pooled for this prompt."""
+    Returns None when nothing is pooled for this prompt.
+
+    NOTE: blobs that serialize below the object store's inline threshold
+    (core/store.py INLINE_THRESHOLD, 100 KiB) are NEVER published to the
+    cluster prefix store — inline objects ride actor replies, not the
+    sealed-object plane, so a directory binding could not serve a P2P
+    pull. Tiny models / very short prefixes fall below it; the skip is
+    counted as `prefix_store_inline_skipped_total` on /metrics."""
     import numpy as np
 
     n, blocks = kv.match_prefix(list(ids))

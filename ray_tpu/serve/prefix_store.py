@@ -63,6 +63,15 @@ def _get_metrics():
                 "prefix_store_bytes_total",
                 "KV bytes fetched from the cluster prefix store",
                 tag_keys=("tenant",)),
+            "inline_skipped": m.Counter(
+                "prefix_store_inline_skipped_total",
+                "Prefix blobs NOT published because they serialized "
+                "below the object store's inline threshold "
+                "(core/store.py INLINE_THRESHOLD, 100 KiB): inline "
+                "objects ride actor replies instead of the sealed-object "
+                "plane, so a directory binding could never serve a P2P "
+                "pull. Small models / short prefixes land here — a "
+                "nonzero count is WHY lookups miss, not a bug"),
         }
     return _metrics
 
@@ -127,6 +136,7 @@ class PrefixStoreClient:
         self.fetch_errors = 0
         self.bytes_fetched = 0
         self.published = 0
+        self.inline_skipped = 0
         self.reannounced = 0
         self.hits_by_tenant: Dict[str, int] = {}
         # head-restart resilience (the pool_reconcile pattern): the head
@@ -220,7 +230,17 @@ class PrefixStoreClient:
             from ray_tpu.core.object_directory import PULLABLE_KINDS
 
             if meta is None or meta.kind not in PULLABLE_KINDS:
-                return False     # inline: rides actor replies, not the plane
+                # inline (< core/store.py INLINE_THRESHOLD = 100 KiB
+                # serialized): rides actor replies, not the plane. Count
+                # it — silently dropping these made small-model tests
+                # chase phantom directory misses.
+                with self._lock:
+                    self.inline_skipped += 1
+                try:
+                    _get_metrics()["inline_skipped"].inc()
+                except Exception:
+                    pass
+                return False
             client.head_push(
                 "announce_prefix", model_key=self.model_key,
                 oid=ref.id.binary(), block_size=self.block_size,
@@ -397,6 +417,7 @@ class PrefixStoreClient:
                     "block_size": self.block_size,
                     "pinned": len(self._pins),
                     "published": self.published,
+                    "inline_skipped": self.inline_skipped,
                     "reannounced": self.reannounced,
                     "store_hits": self.hits,
                     "store_misses": self.misses,

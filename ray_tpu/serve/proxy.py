@@ -20,6 +20,7 @@ of surfacing a 500.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -27,7 +28,46 @@ import ray_tpu
 from ray_tpu.serve import live_signals
 
 ROUTE_REFRESH_S = 1.0
+# routing-table refresh cadence while the deployment's ingress chain is
+# LIVE: replica death is fenced by the chain's actor-death pubsub (no
+# table poll needed to notice it), so the poll only exists to catch
+# autoscale-up drift — stretched so a warm compiled request window makes
+# ZERO control-plane RPCs from the proxy process (the ISSUE-19 contract,
+# interposer-verified by tests/test_compiled_proxy.py)
+COMPILED_ROUTE_REFRESH_S = 30.0
 SUBMIT_ATTEMPTS = 3     # original try + failovers on replica death
+
+# compiled ingress (serve.run(compiled=True)): instead of a per-request
+# actor call, the proxy stands up ONE CompiledServeChain per compiled
+# deployment and writes request batches into its input rings / reads the
+# output rings — zero control-plane RPCs on the warm path, lanes spread
+# across the deployment's replicas, and the chain's own fence machinery
+# fails requests over to the dynamic handle path on replica death
+# (external clients never see a 500 for infra reasons). Streaming (SSE)
+# requests stay on the dynamic path: stream state is replica-affine and
+# needs the submit_on(tag) follow-up calls.
+
+
+async def _chain_result(resp, timeout: float):
+    """Await a ChainResponse on the event loop WITHOUT parking an
+    executor thread per in-flight request: the chain's drainer thread
+    completes the response, and the done-callback trampolines the value
+    back onto the loop."""
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    def _done(r):
+        def _set():
+            if fut.cancelled():
+                return
+            if r._exc is not None:
+                fut.set_exception(r._exc)
+            else:
+                fut.set_result(r._value)
+        loop.call_soon_threadsafe(_set)
+
+    resp.add_done_callback(_done)
+    return await asyncio.wait_for(fut, timeout)
 
 # ------------------------------------------------------- serve telemetry
 _serve_metrics = None
@@ -170,13 +210,34 @@ class _AsyncRouter:
         self._slo: Optional[dict] = None
         self._ts = 0.0
         self._inflight: Dict[str, int] = {}
+        # compiled ingress state (set from the routing table)
+        self._compiled = False
+        self._chain_config: Optional[dict] = None
+        self._chain = None
+        self._chain_starting = False
+        self._target_replicas = 0
         from collections import OrderedDict
 
         self._prefix_map: "OrderedDict[str, str]" = OrderedDict()
 
     async def _refresh(self, force: bool = False):
         now = time.monotonic()
-        if not force and now - self._ts < ROUTE_REFRESH_S:
+        interval = ROUTE_REFRESH_S
+        chain = self._chain
+        if chain is not None and chain.is_compiled():
+            # live chain: replica death fences via the actor-death
+            # pubsub, so only autoscale drift needs the poll — stretch
+            # it, UNLESS the chain is degraded (its lanes cover fewer
+            # distinct replicas than min(lanes, target), e.g. it
+            # recompiled over the survivor while the controller was
+            # still replacing a dead replica): then poll fast until the
+            # replacement lands and maybe_rebalance re-spreads the lanes
+            lanes = chain.lane_targets()
+            spread = {t for lane in lanes for _d, t in lane}
+            want = min(len(lanes), self._target_replicas or 1)
+            if len(spread) >= want:
+                interval = COMPILED_ROUTE_REFRESH_S
+        if not force and now - self._ts < interval:
             return
         ref = self._controller.get_routing_table.remote(self._deployment)
         table = await ref
@@ -184,6 +245,9 @@ class _AsyncRouter:
             self._table = table["replicas"]
             self._model_map = table.get("models", {})
             self._slo = table.get("slo")
+            self._compiled = bool(table.get("compiled"))
+            self._chain_config = table.get("chain")
+            self._target_replicas = int(table.get("target_replicas") or 0)
             self._inflight = {t: self._inflight.get(t, 0)
                               for t in self._table}
             # a dead replica's stale prefix mapping would eat a failed
@@ -192,7 +256,66 @@ class _AsyncRouter:
             for key in [k for k, tag in self._prefix_map.items()
                         if tag not in self._table]:
                 del self._prefix_map[key]
+            if self._compiled:
+                self._maybe_start_chain()
+                chain = self._chain
+                if chain is not None and chain.is_compiled():
+                    # replica set drifted (autoscale-up has no death event
+                    # to fence on): let the chain decide, rate-limited. In
+                    # an executor — a rebalance fence drains in-flight
+                    # entries, which must not block the event loop.
+                    tags = set(self._table)
+                    asyncio.get_running_loop().run_in_executor(
+                        None, lambda: chain.maybe_rebalance(
+                            {self._deployment: tags}))
         self._ts = now
+
+    def _maybe_start_chain(self) -> None:
+        """Stand up the deployment's ingress chain once, off the event
+        loop (compile + warm-up are blocking control-plane work). Until
+        it goes live — and again whenever it is fenced — requests flow
+        through the dynamic path below, which IS the cold-start/failover
+        contract."""
+        if self._chain is not None or self._chain_starting:
+            return
+        self._chain_starting = True
+        cfg = dict(self._chain_config or {})
+        # default lane count: one per replica, floor 2, so every replica
+        # gets a standing ring and a single replica still overlaps entries
+        cfg.setdefault("lanes", max(2, len(self._table)))
+        dep, controller = self._deployment, self._controller
+
+        def _start():
+            try:
+                from ray_tpu.serve.compiled_chain import CompiledServeChain
+
+                chain = CompiledServeChain(
+                    [dep], controller=controller, plane="serve_proxy",
+                    **cfg)
+                chain.start()
+                self._chain = chain
+            except Exception:
+                # retry on a later refresh (e.g. replicas still starting)
+                self._chain_starting = False
+
+        threading.Thread(target=_start, daemon=True,
+                         name=f"proxy-chain-{dep}").start()
+
+    def chain_status(self) -> dict:
+        chain = self._chain
+        if chain is None:
+            return {"compiled": self._compiled, "chain": False}
+        return {"compiled": self._compiled, "chain": True,
+                "live": chain.is_compiled(),
+                "generation": chain.generation,
+                "lane_targets": chain.lane_targets(),
+                "stats": dict(chain.stats)}
+
+    def shutdown_chain(self) -> None:
+        chain, self._chain = self._chain, None
+        self._chain_starting = False
+        if chain is not None:
+            chain.shutdown()
 
     def _live_cache(self):
         # lazy: unit tests build routers via __new__ with hand-set state
@@ -258,8 +381,22 @@ class _AsyncRouter:
     async def submit(self, method: str, args: tuple, kwargs: dict,
                      model_id: Optional[str] = None,
                      with_tag: bool = False,
-                     prefix_key: Optional[str] = None):
+                     prefix_key: Optional[str] = None,
+                     allow_compiled: bool = False,
+                     timeout_s: float = 60.0):
         await self._refresh()
+        # compiled fast path: one ring write + one ring read, no replica
+        # pick, no actor-call RPC. Only plain __call__ shapes ride it —
+        # multiplexed models and replica-affine calls (SSE) need the
+        # dynamic router's placement. A broken/cold chain falls through
+        # to the dynamic path below (the chain ALSO fails items over
+        # internally once they were submitted to it).
+        chain = self._chain if allow_compiled else None
+        if (chain is not None and chain.is_compiled()
+                and method == "__call__" and not model_id
+                and len(args) == 1 and not kwargs):
+            result = await _chain_result(chain.submit(args[0]), timeout_s)
+            return (result, None) if with_tag else result
         await self._live_cache().refresh_async()
         deadline = time.monotonic() + 30
         while not self._table:
@@ -444,13 +581,24 @@ class ProxyActor:
         req = Request(request.method, path, dict(request.query),
                       dict(request.headers), body, json_body)
         model_id = request.headers.get("serve_multiplexed_model_id")
+        # streaming responses are replica-affine (stream_next follow-ups
+        # must hit the replica holding the stream) — keep them dynamic
+        stream = bool(isinstance(json_body, dict) and json_body.get("stream"))
         try:
             result, tag = await router.submit(
                 "__call__", (req,), {}, model_id=model_id, with_tag=True,
-                prefix_key=prompt_prefix_key(json_body))
+                prefix_key=prompt_prefix_key(json_body),
+                allow_compiled=not stream)
         except Exception as e:  # noqa: BLE001 - surface as HTTP 500
             return web.json_response({"error": repr(e)}, status=500)
         if isinstance(result, dict) and "__sse_stream__" in result:
+            if tag is None:
+                # compiled path can't anchor a replica-affine stream; the
+                # deployment opened one for a body without stream=true
+                return web.json_response(
+                    {"error": "streaming response requires "
+                              '"stream": true in the request body'},
+                    status=400)
             return await self._stream_sse(request, router, tag,
                                           result["__sse_stream__"])
         if isinstance(result, web.Response):
@@ -554,7 +702,47 @@ class ProxyActor:
     async def ready(self) -> int:
         return self.port
 
+    # ------------------------------------------------------- test support
+    async def chain_status(self, deployment: str) -> dict:
+        """Compiled-ingress introspection (tests, `ray-tpu top` drills):
+        whether the deployment's chain is live, its per-lane replica
+        spread and lifetime counters."""
+        router = self._routers.get(deployment)
+        if router is None:
+            return {"compiled": False, "chain": False}
+        # a status poll also advances the (rate-limited) table refresh:
+        # an operator watching a degraded chain drives the re-spread
+        # check even when the deployment is idle
+        try:
+            await router._refresh()
+        except Exception:
+            pass
+        return router.chain_status()
+
+    async def rpc_audit_start(self) -> bool:
+        """Head-RPC audit between start/stop, recorded INSIDE the proxy
+        process (the zero-control-plane-RPCs-per-warm-request contract is
+        interposer-verified where the ingress actually runs)."""
+        if not hasattr(self, "_audit"):
+            from ray_tpu.serve.disagg import _RpcAudit
+
+            self._audit = _RpcAudit()
+        return self._audit.start()
+
+    async def rpc_audit_stop(self) -> list:
+        if not hasattr(self, "_audit"):
+            return []
+        return self._audit.stop()
+
     async def stop(self):
+        # chain shutdown joins worker threads — keep it off the loop
+        loop = asyncio.get_running_loop()
+        for router in list(self._routers.values()):
+            try:
+                await asyncio.wait_for(
+                    loop.run_in_executor(None, router.shutdown_chain), 20)
+            except Exception:
+                pass
         if self._runner is not None:
             await self._runner.cleanup()
         return True

@@ -1,0 +1,210 @@
+"""Compiled serving to the wire (ISSUE 19): external HTTP traffic rides
+the proxy's per-deployment CompiledServeChain rings — lanes spread
+across replicas, warm requests make zero control-plane RPCs from the
+proxy process, and a replica SIGKILL under external load never surfaces
+a 500 (the dynamic handle path is the standing failover).
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.native_store import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+    yield info
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+class _Echo:
+    def __call__(self, request):
+        return {"ok": True, "x": request.get("x"), "pid": os.getpid()}
+
+
+def _post(url: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_chain_live(proxy, dep: str, timeout: float = 120.0) -> dict:
+    """Poll the proxy until the deployment's ingress chain is compiled
+    and live; returns the final chain_status payload."""
+    deadline = time.time() + timeout
+    st = {}
+    while time.time() < deadline:
+        st = ray_tpu.get(proxy.chain_status.remote(dep), timeout=30)
+        if st.get("live"):
+            return st
+        time.sleep(0.25)
+    raise AssertionError(f"proxy chain for {dep} never went live: {st}")
+
+
+def _deploy(tag: str, port_holder: dict, replicas: int = 2):
+    dep = f"cproxy-{tag}"
+    serve.run(
+        serve.deployment(_Echo, name=dep).options(
+            num_replicas=replicas, max_ongoing_requests=16,
+            chain_config={"lanes": 2, "max_inflight": 2, "batch_max": 4,
+                          "entry_timeout_s": 60,
+                          "recompile_timeout_s": 120}).bind(),
+        name=dep, route_prefix=f"/{dep}", compiled=True)
+    port = serve.start()
+    port_holder["port"] = port
+    url = f"http://127.0.0.1:{port}/{dep}"
+    # first request primes the router (starts the chain off-loop)
+    assert _post(url, {"x": 0})["ok"]
+    proxy = ray_tpu.get_actor("serve-proxy")
+    st = _wait_chain_live(proxy, dep)
+    return dep, url, proxy, st
+
+
+def test_http_over_compiled_ingress_spreads_lanes(cluster):
+    """External HTTP requests ride the chain rings (stats["compiled"]
+    counts them), the chain's lanes target BOTH replicas, and sequential
+    idle traffic round-robins across them — per-replica request counts
+    balance within tolerance."""
+    dep, url, proxy, st = _deploy("spread", {})
+    try:
+        lane_tags = {t for lane in st["lane_targets"] for _d, t in lane}
+        assert len(lane_tags) == 2, \
+            f"lanes compiled over one replica: {st['lane_targets']}"
+
+        n = 24
+        pids = []
+        for i in range(1, n + 1):
+            out = _post(url, {"x": i})
+            assert out["ok"] and out["x"] == i
+            pids.append(out["pid"])
+        counts = {p: pids.count(p) for p in set(pids)}
+        assert len(counts) == 2, \
+            f"traffic never spread across replicas: {counts}"
+        assert min(counts.values()) >= n // 4, \
+            f"lane spread is imbalanced: {counts}"
+
+        st = ray_tpu.get(proxy.chain_status.remote(dep), timeout=30)
+        assert st["stats"]["compiled"] >= n, \
+            f"requests leaked to the dynamic path: {st['stats']}"
+    finally:
+        serve.delete(dep)
+
+
+def test_warm_proxy_requests_make_zero_head_rpcs(cluster):
+    """The compiled-to-the-wire contract: once the chain is live and the
+    routing table warm, an external HTTP burst is ring writes + condvar
+    wakes INSIDE the proxy process — zero head round trips, proven with
+    the RPC interposer running in the proxy actor."""
+    dep, url, proxy, _st = _deploy("audit", {})
+    try:
+        # warm every lane + refresh the routing table inside the window
+        # the stretched compiled-mode cadence keeps quiet (30s)
+        for i in range(6):
+            assert _post(url, {"x": i})["ok"]
+
+        assert ray_tpu.get(proxy.rpc_audit_start.remote(), timeout=30)
+        try:
+            for i in range(20):
+                out = _post(url, {"x": i})
+                assert out["ok"] and out["x"] == i
+        finally:
+            events = ray_tpu.get(proxy.rpc_audit_stop.remote(), timeout=30)
+        reqs = [m for k, m in events if k == "req"]
+        assert not reqs, \
+            f"warm compiled ingress made head round trips: {reqs}"
+
+        st = ray_tpu.get(proxy.chain_status.remote(dep), timeout=30)
+        assert st["stats"]["dynamic_fallback"] == 0, st["stats"]
+    finally:
+        serve.delete(dep)
+
+
+@pytest.mark.chaos
+def test_replica_sigkill_under_http_load_never_500s(cluster):
+    """Chaos drill (ISSUE 19 acceptance): SIGKILL one of the two spread
+    replicas while external HTTP load is in flight. Every request
+    completes with HTTP 200 (in-flight ring entries fail over to the
+    dynamic handle path; the external client NEVER sees a 500), and the
+    chain recompiles its lanes over the controller's replacement
+    replica — generation bump, one old tag swapped for one new one."""
+    dep, url, proxy, st0 = _deploy("chaos", {})
+    try:
+        gen0 = st0["generation"]
+        old_tags = {t for lane in st0["lane_targets"] for _d, t in lane}
+        victim_pid = _post(url, {"x": 1})["pid"]
+
+        codes, lock = [], threading.Lock()
+        stop = time.monotonic() + 6.0
+
+        def client():
+            i = 0
+            while time.monotonic() < stop:
+                i += 1
+                try:
+                    out = _post(url, {"x": i})
+                    code = 200 if out.get("ok") else -1
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                except Exception:
+                    code = -1
+                with lock:
+                    codes.append(code)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        os.kill(victim_pid, signal.SIGKILL)
+        for t in threads:
+            t.join(120)
+
+        bad = [c for c in codes if c != 200]
+        assert not bad, \
+            f"replica kill surfaced {len(bad)} failures: {set(bad)}"
+        assert len(codes) > 20
+
+        # lanes recompile and RE-SPREAD over the replacement replica:
+        # the first fence may land a degraded compile over the lone
+        # survivor; the proxy's fast degraded-poll + maybe_rebalance
+        # then re-spreads once the controller's replacement registers
+        deadline = time.time() + 120
+        st, new_tags = {}, set()
+        while time.time() < deadline:
+            st = ray_tpu.get(proxy.chain_status.remote(dep), timeout=30)
+            new_tags = {t for lane in st.get("lane_targets") or []
+                        for _d, t in lane}
+            if st.get("live") and st["generation"] > gen0 \
+                    and len(new_tags) == 2:
+                break
+            time.sleep(0.5)
+        assert st.get("live") and st["generation"] > gen0, \
+            f"chain never recompiled after the kill: {st}"
+        assert len(new_tags) == 2, \
+            f"lanes never re-spread over the replacement: {st}"
+        assert len(new_tags - old_tags) == 1 and \
+            len(old_tags - new_tags) == 1, (old_tags, new_tags)
+
+        # compiled traffic resumes over the replacement
+        before = st["stats"]["compiled"]
+        for i in range(8):
+            assert _post(url, {"x": i})["ok"]
+        st = ray_tpu.get(proxy.chain_status.remote(dep), timeout=30)
+        assert st["stats"]["compiled"] > before, st["stats"]
+    finally:
+        serve.delete(dep)

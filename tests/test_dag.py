@@ -226,6 +226,92 @@ def test_attached_channel_reserializes_with_true_counts():
         ch.close(unlink=True)
 
 
+# ------------------------------------------------------- zero-copy slots
+def test_write_serializes_directly_into_slot():
+    """ISSUE 19 pin: write() reserves a writable slot view and serializes
+    INTO it — there is no staging buffer and no to_bytes() memcpy pair on
+    the warm path. Proven by poisoning SerializedObject.to_bytes: the
+    write must still succeed."""
+    from ray_tpu.core import serialization
+
+    ch = Channel(capacity=1 << 16, num_readers=1)
+    orig = serialization.SerializedObject.to_bytes
+    try:
+        def boom(self):
+            raise AssertionError("write() staged through to_bytes()")
+
+        serialization.SerializedObject.to_bytes = boom
+        ch.write({"x": 1, "blob": b"z" * 1024})
+        r = Channel.attach(ch.name)
+        assert r.read(timeout=5) == {"x": 1, "blob": b"z" * 1024}
+    finally:
+        serialization.SerializedObject.to_bytes = orig
+        ch.close(unlink=True)
+
+
+def test_read_zc_view_aliases_slot_until_release():
+    """read_zc() hands the consumer a SlotView whose payload ALIASES the
+    shm slot (no copy-out) and pins the slot — the writer cannot reclaim
+    it — until release(). Proven on a 1-slot ring: a second write blocks
+    while the view is pinned and completes once it's released."""
+    import threading
+
+    import numpy as np
+
+    ch = Channel(capacity=1 << 20, num_readers=1, num_slots=1)
+    try:
+        r = Channel.attach(ch.name)
+        arr = np.arange(512, dtype=np.int64)
+        ch.write({"arr": arr}, timeout=5)
+        sv = r.read_zc(timeout=5)
+        out = sv.value()["arr"]
+        assert np.array_equal(out, arr)
+        # the deserialized array's buffer IS the shm slot (no copy-out):
+        # its memory overlaps the raw frame view
+        frame = np.frombuffer(sv.view(), dtype=np.uint8)
+        assert np.shares_memory(out, frame), \
+            "read_zc value does not alias the slot"
+
+        wrote = threading.Event()
+
+        def writer():
+            ch.write({"arr": arr * 2}, timeout=30)
+            wrote.set()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        # slot is pinned by the unreleased view: the 1-slot ring is full
+        assert not wrote.wait(0.5), "writer reclaimed a pinned slot"
+        sv.release()
+        assert wrote.wait(10), "release() did not unpin the slot"
+        t.join(10)
+        assert np.array_equal(r.read(timeout=5)["arr"], arr * 2)
+        # released view refuses access (its memory may now be rewritten)
+        with pytest.raises(ChannelError):
+            sv.view()
+    finally:
+        ch.close(unlink=True)
+
+
+def test_read_raw_and_zc_context_manager():
+    """read_raw keeps the (seq, bytes) contract for remote forwarding;
+    SlotView is a context manager that releases on exit."""
+    ch = Channel(capacity=1 << 16, num_readers=1, num_slots=2)
+    try:
+        r = Channel.attach(ch.name)
+        ch.write("hello", timeout=5)
+        ch.write("world", timeout=5)
+        with r.read_zc(timeout=5) as sv:
+            assert sv.value() == "hello"
+        seq, data = r.read_raw(r._last_seq, timeout=5)
+        assert seq == 2
+        from ray_tpu.core import serialization
+
+        assert serialization.loads(data) == "world"
+    finally:
+        ch.close(unlink=True)
+
+
 # -------------------------------------------------------------- eager DAGs
 def test_eager_function_dag(cluster):
     @ray_tpu.remote
