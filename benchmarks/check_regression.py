@@ -31,7 +31,13 @@ Suites:
                        external-HTTP windows, per-request handle dispatch
                        vs the proxy writing straight into the compiled
                        chain rings — and proxy_compiled_p99_s, the
-                       compiled path's latency floor)
+                       compiled path's latency floor; plus the ISSUE-20
+                       weight-plane rows: replica_cold_start_s — P2P-
+                       streamed weight materialization off a neighbor
+                       publisher, must beat replica_cold_start_ckpt_s,
+                       the checkpoint-path npz read of the same tree in
+                       the matched window — and weight_store_pull_mb_s,
+                       the weight-plane materialization rate)
   collective        — benchmarks/collective_microbench.json
                       (allreduce_mb_s — flat path; hier_allreduce_mb_s /
                        quant_allreduce_mb_s — two-level + int8 inter hop

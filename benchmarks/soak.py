@@ -51,6 +51,15 @@ FIXED seed, so a failure replays identically:
   chain must recompile its lanes over the replacement replica
   (generation bump observed via `proxy.chain_status`).
 
+  phase 3d — cold-model burst (ISSUE 20): two tenants behind the HTTP
+  proxy — a warm always-on deployment under sustained load, and a
+  second model PARKED AT ZERO (`min_replicas=0`, slow replica init
+  standing in for a checkpoint/weight-plane load). A client burst hits
+  the parked model's route mid-phase: the proxy must QUEUE (never 500),
+  push demand to the controller, and the first replica must wake and
+  answer within the cold-start SLO — while the warm tenant's latency
+  holds and ZERO non-shed failures surface on either route.
+
   phase 4 — elastic-train drill: a 2-worker GPT-2-DDP run
   (microbenchmark._elastic_train_loop); once the gang makes progress, a
   `kill:*:n=1` plan is injected into one daemon over the chaos control
@@ -382,6 +391,127 @@ def serve_soak(seed: int, duration_s: float = 8.0, clients: int = 6) -> dict:
             "chaos": f"seed={seed},kill:*:n=1 (replica self-kill)"}
 
 
+def cold_model_burst_soak(seed: int, duration_s: float = 12.0,
+                          warm_clients: int = 4,
+                          burst_clients: int = 4) -> dict:
+    """Cold-model burst phase (ISSUE 20): a warm tenant under sustained
+    load plus a second model PARKED AT ZERO replicas (min_replicas=0;
+    its replica init sleeps, standing in for the checkpoint/weight-plane
+    load a real model pays). Mid-phase a burst hits the parked model's
+    route: the proxy queues the burst (zero 500s), pushes queue depth to
+    the controller as demand, and the woken replica answers the whole
+    burst within the cold-start SLO — while the warm tenant keeps
+    serving. Reports wake latency + per-tenant rps/p99."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+
+    @serve.deployment
+    class WarmTenant:
+        def __call__(self, request):
+            time.sleep(0.02)
+            return {"ok": True, "tenant": "warm"}
+
+    @serve.deployment
+    class ColdModel:
+        def __init__(self):
+            # stand-in for a replica cold start's weight materialization
+            time.sleep(1.5)
+
+        def __call__(self, request):
+            time.sleep(0.02)
+            return {"ok": True, "tenant": "cold"}
+
+    serve.run(WarmTenant.options(
+        num_replicas=1, max_ongoing_requests=16,
+        slo_config=serve.SLOConfig(slo_s=5.0, max_queue=64,
+                                   retry_after_s=1.0)).bind(),
+        name="soak-warm", route_prefix="/warm")
+    serve.run(ColdModel.options(
+        max_ongoing_requests=16,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=0, max_replicas=1,
+            target_ongoing_requests=8)).bind(),
+        name="soak-cold", route_prefix="/coldmodel")
+    port = serve.start()
+    stop = time.monotonic() + duration_s
+    lock = threading.Lock()
+    stats = {"warm": {"codes": [], "lats": []},
+             "cold": {"codes": [], "lats": []}}
+    first_cold_ok = []
+
+    def client(route: str, tenant: str, until: float):
+        url = f"http://127.0.0.1:{port}{route}"
+        while time.monotonic() < until:
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    url, data=b'{"x": 1}',
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    r.read()
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except Exception:
+                code = -1
+            with lock:
+                stats[tenant]["codes"].append(code)
+                if code == 200:
+                    stats[tenant]["lats"].append(time.perf_counter() - t0)
+                    if tenant == "cold" and not first_cold_ok:
+                        first_cold_ok.append(time.monotonic())
+
+    threads = [threading.Thread(target=client,
+                                args=("/warm", "warm", stop), daemon=True)
+               for _ in range(warm_clients)]
+    for t in threads:
+        t.start()
+    time.sleep(duration_s / 3)           # warm tenant in steady state
+    burst_t0 = time.monotonic()
+    burst = [threading.Thread(target=client,
+                              args=("/coldmodel", "cold", stop),
+                              daemon=True)
+             for _ in range(burst_clients)]
+    for t in burst:
+        t.start()
+    for t in threads + burst:
+        t.join(duration_s + 120)
+    try:
+        cold_final = serve.status().get("soak-cold", {})
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+    report = {}
+    for tenant in ("warm", "cold"):
+        codes, lats = stats[tenant]["codes"], stats[tenant]["lats"]
+        served = sum(1 for c in codes if c == 200)
+        shed = sum(1 for c in codes if c == 429)
+        failed = len(codes) - served - shed
+        assert failed == 0, \
+            f"{tenant}: {failed} non-shed failures (codes={set(codes)})"
+        assert served > 0, f"{tenant} tenant served nothing"
+        report[tenant] = {
+            "served": served, "shed": shed, "failed": failed,
+            "p99_s": round(float(np.percentile(lats, 99)), 4)}
+    assert first_cold_ok, "burst on the parked model never completed"
+    wake_s = first_cold_ok[0] - burst_t0
+    # cold-start SLO: replica init (1.5s) + autoscaler wake detection
+    assert wake_s < 30.0, f"cold model took {wake_s:.1f}s to wake"
+    # tenant isolation: the cold wake must not melt the warm tenant
+    assert report["warm"]["p99_s"] < 5.0, report["warm"]
+    report["cold_wake_s"] = round(wake_s, 2)
+    report["cold_final_replicas"] = cold_final.get("running")
+    return report
+
+
 def compiled_chain_soak(seed: int, duration_s: float = 8.0,
                         clients: int = 6) -> dict:
     """Compiled serve chain phase (ISSUE 14): sustained load through a
@@ -661,6 +791,9 @@ def main(seed: int = 7, out: str | None = None, rounds: int = 6,
     print(f"[soak] serve plane under replica chaos kill (seed={seed})",
           file=sys.stderr)
     report["serve"] = serve_soak(seed)
+    print(f"[soak] cold-model burst on a scaled-to-zero tenant "
+          f"(seed={seed})", file=sys.stderr)
+    report["cold_model_burst"] = cold_model_burst_soak(seed)
     print(f"[soak] compiled chain under replica chaos kill (seed={seed})",
           file=sys.stderr)
     report["compiled_chain"] = compiled_chain_soak(seed)

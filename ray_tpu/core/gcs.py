@@ -1111,6 +1111,31 @@ class Head:
                     objdir.prefix_gone_record(model_key, phash))
             return True
 
+        async def announce_weights(weights_id, oid):
+            """A serve replica published a weight manifest (plus its chunk
+            objects) into the store: bind `weights_id -> manifest oid` and
+            ride it out on the next cluster_view broadcast, so any cold
+            replica resolves the manifest from its cached directory with
+            zero head RPCs (serve/weight_store.py). Pushed fire-and-forget
+            FIFO after the blobs' put_meta, so consumers never see the
+            binding before the manifest's location."""
+            self._dir_announce(objdir.weights_record(weights_id,
+                                                     ObjectID(oid)))
+            return True
+
+        async def withdraw_weights(weights_id, oid=None):
+            """Publisher-side eviction (its published-model LRU rotated a
+            manifest out): retire the binding promptly. `oid` scopes the
+            retire to the publisher's OWN manifest — two replicas racing
+            to publish the same weights rebind last-write-wins, and the
+            loser's later eviction must not delete the winner's live
+            binding."""
+            ent = self.object_dir.weights.get(weights_id)
+            if ent is None or (oid is not None and ent["oid"] != oid):
+                return True           # rebound to another blob: keep it
+            self._dir_announce(objdir.weights_gone_record(weights_id))
+            return True
+
         async def worker_address(worker_id):
             """Direct-server address of a live worker (device-object
             fetches go straight to the owning process)."""

@@ -34,6 +34,16 @@ Record shapes (plain dicts, pickled inside the existing frames):
   {"op": "prefix", "mk": str, "ph": bytes, "oid": bytes,
    "n": int, "bs": int}                            # content-addressed KV
   {"op": "prefix_gone", "mk": str, "ph": bytes}    # binding withdrawn
+  {"op": "weights", "wid": str, "oid": bytes}      # weights_id -> manifest
+  {"op": "weights_gone", "wid": str}               # weights withdrawn
+
+Weights rows are the serve plane's model-fleet index (PR 20,
+serve/weight_store.py): a weights identity (checkpoint path, preset@seed,
+or adapter key) bound to the object id of a published weight-manifest
+blob. A cold replica resolves `weights_id -> manifest` from its cached
+directory with zero head RPCs and streams the manifest's chunk objects
+from peers instead of re-reading the checkpoint from a central path.
+Like prefix rows, a binding dies with its blob.
 
 Prefix rows are the serve plane's cluster-wide KV cache index: a rolling
 content hash of a token prefix (serve/prefix_store.py) bound to the
@@ -96,6 +106,14 @@ def prefix_gone_record(model_key: str, phash: bytes) -> dict:
     return {"op": "prefix_gone", "mk": model_key, "ph": phash}
 
 
+def weights_record(weights_id: str, oid: ObjectID) -> dict:
+    return {"op": "weights", "wid": weights_id, "oid": oid.binary()}
+
+
+def weights_gone_record(weights_id: str) -> dict:
+    return {"op": "weights_gone", "wid": weights_id}
+
+
 def resolve_addrs(directory: "ObjectDirectory", meta, addr_of,
                   default_host: str, exclude: Optional[str] = None) -> list:
     """Shared pull-source resolution: the directory's locations for the
@@ -151,6 +169,11 @@ class ObjectDirectory:
         # that lets free/node-death records purge bindings in O(1)
         self.prefixes: Dict[str, Dict[bytes, dict]] = {}
         self._prefix_by_oid: Dict[ObjectID, Set[tuple]] = {}
+        # content-addressed weight index: weights_id -> {"oid"} of the
+        # published manifest blob; _weights_by_oid mirrors the prefix
+        # reverse index so free/node-death purges bindings in O(1)
+        self.weights: Dict[str, dict] = {}
+        self._weights_by_oid: Dict[ObjectID, Set[str]] = {}
         self.last_v = 0           # highest broadcast version applied
         self.adopted_ts = 0.0     # monotonic ts of the last applied payload
         self.applied_records = 0  # lifetime counter (tests/diagnostics)
@@ -212,6 +235,39 @@ class ObjectDirectory:
     def prefix_count(self) -> int:
         return sum(len(rows) for rows in self.prefixes.values())
 
+    def weights_binding(self, weights_id: str) -> Optional[dict]:
+        """Resident manifest binding for a weights identity, entirely
+        from cache. Residency-checked like `longest_prefix`: a binding
+        whose manifest blob is gone everywhere is never returned — the
+        caller falls back to the checkpoint-path read instead of chasing
+        an unreachable object. Returns {"oid"} or None."""
+        ent = self.weights.get(weights_id)
+        if ent is None:
+            return None
+        if ObjectID(ent["oid"]) not in self.entries:
+            return None
+        return dict(ent)
+
+    def weights_count(self) -> int:
+        return len(self.weights)
+
+    def _drop_weights(self, weights_id: str) -> None:
+        ent = self.weights.pop(weights_id, None)
+        if ent is None:
+            return
+        oid = ObjectID(ent["oid"])
+        wids = self._weights_by_oid.get(oid)
+        if wids is not None:
+            wids.discard(weights_id)
+            if not wids:
+                self._weights_by_oid.pop(oid, None)
+
+    def _purge_weights_for(self, oid: ObjectID) -> None:
+        """The manifest blob's bytes are gone everywhere: its weights
+        bindings must not linger as phantom warm starts."""
+        for wid in list(self._weights_by_oid.pop(oid, ())):
+            self.weights.pop(wid, None)
+
     def _drop_prefix(self, model_key: str, phash: bytes) -> None:
         rows = self.prefixes.get(model_key)
         ent = rows.pop(phash, None) if rows else None
@@ -253,6 +309,7 @@ class ObjectDirectory:
             oid = ObjectID(rec["oid"])
             self.entries.pop(oid, None)
             self._purge_prefixes_for(oid)
+            self._purge_weights_for(oid)
         elif op == "replica":
             ent = self.entries.get(ObjectID(rec["oid"]))
             if ent is not None:
@@ -267,6 +324,7 @@ class ObjectDirectory:
                     # entry must not linger unreachable forever
                     del self.entries[oid]
                     self._purge_prefixes_for(oid)
+                    self._purge_weights_for(oid)
         elif op == "node_dead":
             dead = rec["node"]
             for oid in list(self.entries):
@@ -283,6 +341,7 @@ class ObjectDirectory:
                     # is exactly when replica knowledge matters most
                     del self.entries[oid]
                     self._purge_prefixes_for(oid)
+                    self._purge_weights_for(oid)
         elif op == "prefix":
             mk, phash = rec["mk"], rec["ph"]
             self._drop_prefix(mk, phash)   # rebind: retire the old oid
@@ -292,6 +351,14 @@ class ObjectDirectory:
                 ObjectID(rec["oid"]), set()).add((mk, phash))
         elif op == "prefix_gone":
             self._drop_prefix(rec["mk"], rec["ph"])
+        elif op == "weights":
+            wid = rec["wid"]
+            self._drop_weights(wid)    # rebind: retire the old oid
+            self.weights[wid] = {"oid": rec["oid"]}
+            self._weights_by_oid.setdefault(
+                ObjectID(rec["oid"]), set()).add(wid)
+        elif op == "weights_gone":
+            self._drop_weights(rec["wid"])
         self.applied_records += 1
 
     def apply(self, payload: Optional[dict]) -> bool:
@@ -309,7 +376,11 @@ class ObjectDirectory:
                 for e in full if e["meta"].kind in PULLABLE_KINDS}
             self.prefixes = {}
             self._prefix_by_oid = {}
+            self.weights = {}
+            self._weights_by_oid = {}
             for rec in payload.get("prefixes") or ():
+                self.apply_record(rec)
+            for rec in payload.get("weights") or ():
                 self.apply_record(rec)
             self.last_v = v
             self.adopted_ts = time.monotonic()
@@ -333,4 +404,7 @@ class ObjectDirectory:
                     {"op": "prefix", "mk": mk, "ph": ph, "oid": e["oid"],
                      "n": e["n"], "bs": e["bs"]}
                     for mk, rows in self.prefixes.items()
-                    for ph, e in rows.items()]}
+                    for ph, e in rows.items()],
+                "weights": [
+                    {"op": "weights", "wid": wid, "oid": e["oid"]}
+                    for wid, e in self.weights.items()]}

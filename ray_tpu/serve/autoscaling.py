@@ -40,7 +40,14 @@ def calculate_desired_num_replicas(config: AutoscalingConfig,
                                    total_ongoing_requests: float,
                                    current_num_replicas: int) -> int:
     if current_num_replicas == 0:
-        return max(config.min_replicas, 1)
+        # Scale-to-zero: a parked deployment (explicit min_replicas=0)
+        # stays at zero until demand shows up — the proxy pushes its
+        # queue depth as ongoing requests, which wakes exactly one
+        # replica; the normal error-ratio path grows it from there.
+        # min_replicas>=1 keeps the historical always-on floor.
+        if total_ongoing_requests > 0:
+            return max(config.min_replicas, 1)
+        return max(config.min_replicas, 0)
     per_replica = total_ongoing_requests / current_num_replicas
     error_ratio = per_replica / max(config.target_ongoing_requests, 1e-9)
     if error_ratio > 1:

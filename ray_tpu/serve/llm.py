@@ -146,7 +146,8 @@ class LLMEngine:
                  prefill_chunk_size: int = 16,
                  max_num_batched_tokens: Optional[int] = None,
                  params_override=None, cfg_override=None,
-                 weights_id: Optional[str] = None):
+                 weights_id: Optional[str] = None,
+                 weight_store: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -168,9 +169,44 @@ class LLMEngine:
             self.checkpoint = checkpoint
         elif checkpoint:
             # REAL weights: architecture from the checkpoint sidecar,
-            # runtime knobs (seq len etc.) from the preset/overrides
+            # runtime knobs (seq len etc.) from the preset/overrides.
+            # Cold start tries the P2P weight plane FIRST — the manifest
+            # resolves from the gossiped directory (zero head RPCs) and
+            # the leaves stream from peer replicas under a bounded host
+            # budget (serve/weight_store.py) — and degrades to the
+            # central checkpoint-path read on any miss. The replica that
+            # pays the path read publishes the tree back, so the NEXT
+            # replica of this model pulls from peers.
+            import time as _time
+
             base = gpt2.GPT2Config.preset(preset, **overrides)
-            self.params, self.cfg = gpt2.load_params(checkpoint, cfg=base)
+            self.params = None
+            t0 = _time.perf_counter()
+            if weight_store:
+                try:
+                    from ray_tpu.serve import weight_store as _ws
+
+                    store = _ws.get_store()
+                    loaded = (store.load_params(checkpoint, base_cfg=base)
+                              if store is not None else None)
+                    if loaded is not None:
+                        self.params, self.cfg = loaded
+                        _ws.observe_cold_start(
+                            _time.perf_counter() - t0, "p2p")
+                except Exception:
+                    self.params = None   # never fail init on the store
+            if self.params is None:
+                self.params, self.cfg = gpt2.load_params(checkpoint,
+                                                         cfg=base)
+                if weight_store:
+                    from ray_tpu.serve import weight_store as _ws
+
+                    _ws.observe_cold_start(
+                        _time.perf_counter() - t0, "checkpoint")
+                    _ws.maybe_publish_params_async(
+                        self.params, checkpoint,
+                        arch={k: getattr(self.cfg, k)
+                              for k in gpt2._CFG_FIELDS})
             self.checkpoint = checkpoint
         else:
             self.cfg = gpt2.GPT2Config.preset(preset, **overrides)
@@ -958,10 +994,31 @@ class OpenAIServer(LLMServer):
             self._lora_engines.move_to_end(adapter_id)
             return eng
         from ray_tpu.models.gpt2 import apply_lora, load_lora_npz
+        from ray_tpu.serve import weight_store as _ws
         from ray_tpu.utils import fs as _lfs
 
-        path = _lfs.join(self.lora_root, f"{adapter_id}.npz")
-        merged = apply_lora(self.engine.params, load_lora_npz(path))
+        # hot-swap path: adapter deltas are first-class weight-plane
+        # objects — the first replica to load an adapter publishes it,
+        # every later replica pulls it P2P instead of touching lora_root
+        # (byte-identical merge: the delta arrays are the same bytes).
+        # Any miss falls back to the adapter npz on disk, then publishes.
+        adapter = None
+        store = _ws.get_store()
+        akey = _ws.adapter_store_key(self.engine.weights_id, adapter_id)
+        if store is not None:
+            try:
+                adapter = store.fetch_adapter(akey, tenant=adapter_id)
+            except Exception:
+                adapter = None
+        if adapter is None:
+            path = _lfs.join(self.lora_root, f"{adapter_id}.npz")
+            adapter = load_lora_npz(path)
+            if store is not None:
+                try:
+                    store.publish_adapter(akey, adapter)
+                except Exception:
+                    pass
+        merged = apply_lora(self.engine.params, adapter)
         kwargs = dict(self._engine_kwargs)
         kwargs.pop("checkpoint", None)
         kwargs.pop("cluster_prefix_cache", None)

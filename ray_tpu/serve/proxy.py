@@ -216,6 +216,9 @@ class _AsyncRouter:
         self._chain = None
         self._chain_starting = False
         self._target_replicas = 0
+        # requests queued behind a scaled-to-zero deployment; pushed to
+        # the controller as the wake-up demand signal
+        self._cold_waiters = 0
         from collections import OrderedDict
 
         self._prefix_map: "OrderedDict[str, str]" = OrderedDict()
@@ -398,12 +401,34 @@ class _AsyncRouter:
             result = await _chain_result(chain.submit(args[0]), timeout_s)
             return (result, None) if with_tag else result
         await self._live_cache().refresh_async()
-        deadline = time.monotonic() + 30
-        while not self._table:
-            if time.monotonic() > deadline:
-                raise RuntimeError(f"no replicas for {self._deployment}")
-            await asyncio.sleep(0.1)
-            await self._refresh(force=True)
+        # Cold-start path (scale-to-zero): a deployment parked at zero
+        # replicas has an empty route table. Queue here — NOT 500 — and
+        # push our queue depth to the controller as demand (~1/s): that is
+        # the live signal `calculate_desired_num_replicas` wakes on. The
+        # deadline covers a replica __init__ (checkpoint/P2P weight load),
+        # aligned with the controller's REPLICA_INIT_GRACE_S.
+        if not self._table:
+            deadline = time.monotonic() + live_signals._flag(
+                "serve_cold_start_deadline_s", 120.0)
+            self._cold_waiters = getattr(self, "_cold_waiters", 0) + 1
+            last_push = 0.0
+            try:
+                while not self._table:
+                    now = time.monotonic()
+                    if now > deadline:
+                        raise RuntimeError(
+                            f"no replicas for {self._deployment}")
+                    if now - last_push >= 1.0:
+                        last_push = now
+                        try:
+                            await self._controller.record_handle_metrics \
+                                .remote(self._deployment, self._cold_waiters)
+                        except Exception:
+                            pass    # controller restarting: keep queueing
+                    await asyncio.sleep(0.1)
+                    await self._refresh(force=True)
+            finally:
+                self._cold_waiters -= 1
         if model_id:
             kwargs = {**kwargs, "_multiplexed_model_id": model_id}
         excluded: set = set()

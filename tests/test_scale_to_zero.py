@@ -1,0 +1,204 @@
+"""Scale-to-zero serving (ISSUE 20).
+
+The acceptance surfaces: `min_replicas=0` parks a deployment at zero
+replicas (the historical >=1 floor survives for every other config),
+demand wakes exactly one replica via the proxy's queue-depth push (the
+first request QUEUES, never 500s), the deployment re-parks when idle,
+and an N-model multiplex burst on a parked model cold-starts within the
+SLO while a warm tenant keeps serving — zero non-shed failures on
+either route.
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.autoscaling import (AutoscalingConfig,
+                                       calculate_desired_num_replicas,
+                                       desired_from_live_load)
+
+
+# ------------------------------------------------------------ policy unit
+def test_policy_parks_only_explicit_zero_floor():
+    """min_replicas=0 holds a demandless deployment at zero; ANY demand
+    wakes exactly one replica; the default config keeps the historical
+    always-on floor even from zero."""
+    park = AutoscalingConfig(min_replicas=0, max_replicas=4)
+    legacy = AutoscalingConfig(min_replicas=1, max_replicas=4)
+    # parked, no demand: stays parked
+    assert calculate_desired_num_replicas(park, 0.0, 0) == 0
+    # parked, demand: wakes ONE replica (growth is the error-ratio
+    # path's job once that replica reports load)
+    assert calculate_desired_num_replicas(park, 1.0, 0) == 1
+    assert calculate_desired_num_replicas(park, 50.0, 0) == 1
+    # the historical floor: a zero-replica state self-heals to one even
+    # without demand unless zero was explicitly configured
+    assert calculate_desired_num_replicas(legacy, 0.0, 0) == 1
+    assert calculate_desired_num_replicas(legacy, 1.0, 0) == 1
+    # running deployments may scale DOWN to zero only when parked
+    assert calculate_desired_num_replicas(park, 0.0, 2) == 0
+    assert calculate_desired_num_replicas(legacy, 0.0, 2) == 1
+
+
+def test_live_load_rows_wake_parked_deployment():
+    """The gossiped live-load path honors min_replicas=0: fresh queue
+    depth wakes a parked deployment, stale rows defer to the fallback
+    (which parks it again when the polled counts agree)."""
+    park = AutoscalingConfig(min_replicas=0, max_replicas=4,
+                             target_ongoing_requests=2.0)
+    now = time.time()
+    fresh = [{"queue_depth": 3, "ewma_latency_s": 0.1, "ts": now}]
+    idle = [{"queue_depth": 0, "ewma_latency_s": 0.1, "ts": now}]
+    stale = [{"queue_depth": 9, "ewma_latency_s": 0.1, "ts": now - 300}]
+    assert desired_from_live_load(park, fresh, 0) == 1
+    assert desired_from_live_load(park, idle, 1) == 0
+    assert desired_from_live_load(park, stale, 0) is None
+
+
+# ------------------------------------------------------- live park/wake
+@pytest.mark.slow
+def test_park_wake_on_request_and_repark():
+    """A min_replicas=0 deployment starts PARKED (zero replicas, no
+    init cost paid), the first HTTP request through the proxy queues and
+    wakes one replica (200, not 500), warm requests stay fast, and the
+    deployment re-parks once idle."""
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+
+    @serve.deployment
+    class ColdModel:
+        def __init__(self):
+            time.sleep(0.5)       # stand-in for the weight-plane load
+
+        def __call__(self, request):
+            return {"ok": True}
+
+    try:
+        serve.run(ColdModel.options(
+            max_ongoing_requests=8,
+            autoscaling_config=serve.AutoscalingConfig(
+                min_replicas=0, max_replicas=2,
+                target_ongoing_requests=4)).bind(),
+            name="s2z", route_prefix="/s2z")
+        port = serve.start()
+        url = f"http://127.0.0.1:{port}/s2z"
+
+        # parked: zero running replicas, and it STAYS parked while idle
+        time.sleep(2.0)
+        st = serve.status().get("s2z", {})
+        assert st.get("running") == 0, f"deployment not parked: {st}"
+
+        # first request wakes it: queued by the proxy, never a 500
+        req = urllib.request.Request(
+            url, data=b'{"x": 1}',
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            r.read()
+        wake_s = time.perf_counter() - t0
+        assert wake_s < 30, f"cold wake took {wake_s:.1f}s"
+        assert serve.status().get("s2z", {}).get("running", 0) >= 1
+
+        # warm path: an order of magnitude faster than the wake
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            r.read()
+        assert time.perf_counter() - t0 < max(1.0, wake_s / 2)
+
+        # idle: the autoscaler re-parks it (live rows go stale, polled
+        # fallback sees zero demand and min_replicas=0)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if serve.status().get("s2z", {}).get("running") == 0:
+                break
+            time.sleep(1.0)
+        else:
+            pytest.fail("idle deployment never re-parked to zero")
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------- N-model multiplex drill
+@pytest.mark.slow
+def test_multiplex_cold_burst_holds_warm_slo():
+    """Acceptance drill: a burst on a scaled-to-zero model cold-starts
+    within the SLO while the warm tenant holds its latency — zero
+    non-shed failures on either route. (The same drill runs with a
+    chaos seed as the soak's cold_model_burst phase.)"""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks"))
+    from soak import cold_model_burst_soak
+
+    report = cold_model_burst_soak(seed=7, duration_s=9.0)
+    assert report["warm"]["failed"] == 0
+    assert report["cold"]["failed"] == 0
+    assert report["cold"]["served"] > 0
+    assert report["cold_wake_s"] < 30
+    assert report["warm"]["p99_s"] < 5.0
+
+
+# ---------------------------------------------- proxy queue depth signal
+@pytest.mark.slow
+def test_cold_queue_depth_reaches_controller():
+    """The wake signal is the proxy's queue depth pushed as handle
+    metrics: concurrent cold requests all queue (no shed, no 500) and
+    the deployment wakes with demand recorded."""
+    ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+
+    @serve.deployment
+    class ColdModel:
+        def __init__(self):
+            time.sleep(1.0)
+
+        def __call__(self, request):
+            time.sleep(0.01)
+            return {"ok": True}
+
+    try:
+        serve.run(ColdModel.options(
+            max_ongoing_requests=8,
+            autoscaling_config=serve.AutoscalingConfig(
+                min_replicas=0, max_replicas=2,
+                target_ongoing_requests=4)).bind(),
+            name="s2z-q", route_prefix="/s2zq")
+        port = serve.start()
+        url = f"http://127.0.0.1:{port}/s2zq"
+        codes = []
+        lock = threading.Lock()
+
+        def one():
+            req = urllib.request.Request(
+                url, data=b'{"x": 1}',
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    r.read()
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except Exception:
+                code = -1
+            with lock:
+                codes.append(code)
+
+        threads = [threading.Thread(target=one, daemon=True)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(150)
+        assert codes and all(c == 200 for c in codes), \
+            f"cold burst surfaced failures: {codes}"
+        assert serve.status().get("s2z-q", {}).get("running", 0) >= 1
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()

@@ -33,6 +33,10 @@ def test_soak_single_command(tmp_path):
     assert report["shuffle_kill"]["recovery_s"] > 0
     assert report["serve"]["failed"] == 0
     assert report["serve"]["served"] > 0
+    assert report["cold_model_burst"]["warm"]["failed"] == 0
+    assert report["cold_model_burst"]["cold"]["failed"] == 0
+    assert report["cold_model_burst"]["cold"]["served"] > 0
+    assert report["cold_model_burst"]["cold_wake_s"] < 30
     assert report["compiled_chain"]["failed"] == 0
     assert report["compiled_chain"]["served"] > 0
     assert report["compiled_chain"]["fenced"] >= 1

@@ -1,0 +1,565 @@
+"""Content-addressed weight plane (ISSUE 20).
+
+The acceptance surfaces: weights bindings ride the gossiped object
+directory (weights_id -> manifest blob, residency-checked, purged with
+the blob), a published param tree round-trips bitwise through the
+store's WindowedReaders and through the full `load_params` streaming
+restore (peak host bytes bounded by in_flight x chunk_bytes while
+pulling from a PEER process), `train/checkpoint.open_sharded` windowed
+reads are served identically off the P2P plane, LoRA adapter deltas
+hot-swap byte-identically from the store, a cold LLMEngine materializes
+its checkpoint weights with ZERO head RPCs (interposer-verified inside
+the loading process), and the segment owner dying mid-stream degrades
+to the checkpoint-path read without failing engine construction.
+"""
+
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import object_directory as objdir
+from ray_tpu.core.ids import NodeID, ObjectID
+from ray_tpu.core.store import ObjectMeta
+
+# small but comfortably past the inline threshold (~1.2 MB tree), dense
+# attention so the template builds fast on CPU
+MODEL_OVERRIDES = {"vocab_size": 512, "attn_impl": "dense"}
+
+
+def _meta(node: NodeID, size=1 << 20) -> ObjectMeta:
+    m = ObjectMeta(ObjectID.generate(), size, "shm", segment="seg_w")
+    m.node_id = node
+    return m
+
+
+# ------------------------------------------------ directory weights rows
+def test_directory_weights_rows_bind_lookup_purge():
+    """Weights bindings ride directory records: bind/lookup, rebind
+    retiring the old oid, explicit withdrawal, and free() purging the
+    binding with its blob (no phantom warm starts)."""
+    d = objdir.ObjectDirectory()
+    node = NodeID.generate()
+    m1, m2 = _meta(node), _meta(node)
+    d.apply({"v": 1, "delta": [objdir.seal_record(m1),
+                               objdir.seal_record(m2)]})
+    d.apply({"v": 2, "delta": [objdir.weights_record("ck/a", m1.object_id)]})
+    assert d.weights_count() == 1
+    assert d.weights_binding("ck/a")["oid"] == m1.object_id.binary()
+    assert d.weights_binding("ck/other") is None
+    # rebind (a newer publish of the same weights_id) retires the old oid
+    d.apply({"v": 3, "delta": [objdir.weights_record("ck/a", m2.object_id)]})
+    assert d.weights_binding("ck/a")["oid"] == m2.object_id.binary()
+    assert d.weights_count() == 1
+    # freeing the OLD blob must not disturb the rebound binding...
+    d.apply({"v": 4, "delta": [objdir.free_record(m1.object_id)]})
+    assert d.weights_binding("ck/a")["oid"] == m2.object_id.binary()
+    # ...freeing the live blob purges it
+    d.apply({"v": 5, "delta": [objdir.free_record(m2.object_id)]})
+    assert d.weights_binding("ck/a") is None
+    assert d.weights_count() == 0
+    # explicit withdrawal
+    m3 = _meta(node)
+    d.apply({"v": 6, "delta": [objdir.seal_record(m3),
+                               objdir.weights_record("ck/b", m3.object_id)]})
+    assert d.weights_binding("ck/b") is not None
+    d.apply({"v": 7, "delta": [objdir.weights_gone_record("ck/b")]})
+    assert d.weights_binding("ck/b") is None
+
+
+def test_directory_weights_residency_node_death_and_resync():
+    """A binding whose manifest blob is not resident anywhere is never
+    returned; the owner node dying purges its bindings; a full resync
+    payload carries the surviving rows."""
+    d = objdir.ObjectDirectory()
+    node = NodeID.generate()
+    m = _meta(node)
+    d.apply({"v": 1, "delta": [objdir.seal_record(m)]})
+    ghost = ObjectID.generate()                  # never sealed anywhere
+    d.apply({"v": 2, "delta": [objdir.weights_record("ck/live", m.object_id),
+                               objdir.weights_record("ck/ghost", ghost)]})
+    assert d.weights_binding("ck/live") is not None
+    assert d.weights_binding("ck/ghost") is None, \
+        "non-resident manifest must not serve as a warm start"
+    # full resync round trip preserves weights rows
+    d2 = objdir.ObjectDirectory()
+    d2.apply(d.full_payload(9))
+    assert d2.weights_binding("ck/live")["oid"] == m.object_id.binary()
+    # the owner node dies -> binding purges with the entry (the ghost
+    # row may linger in the map but the residency check keeps it inert)
+    d.apply({"v": 3, "delta": [objdir.node_dead_record(node.hex())]})
+    assert d.weights_binding("ck/live") is None
+    assert d.weights_binding("ck/ghost") is None
+
+
+# --------------------------------------------------------- cluster tier
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, num_tpu_chips=0, max_workers=16)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _tiny_tree(seed=0, mb=1.5):
+    """A >inline-threshold pytree of deterministic float32 leaves."""
+    rng = np.random.default_rng(seed)
+    rows = int(mb * 1e6 / 4 / 256 / 3)
+    return {f"layer{i}/w": rng.normal(size=(rows, 256)).astype(np.float32)
+            for i in range(3)}
+
+
+def test_publish_open_windows_bitwise(cluster):
+    """Tentpole round trip at the reader tier: a published tree's
+    WindowedReaders serve exact row windows bitwise-equal to the source
+    arrays (full reads, interior windows, and the scalar path)."""
+    from ray_tpu.serve import weight_store as ws
+
+    store = ws.get_store()
+    assert store is not None
+    tree = _tiny_tree(seed=1)
+    tree["scale"] = np.float32(0.25)             # scalar leaf
+    manifest = store.publish_params(tree, "wid/open-test")
+    assert manifest is not None, store.stats()
+    assert manifest["total_bytes"] > 1 << 20
+    opened = store.open("wid/open-test")
+    assert opened is not None
+    readers, got_manifest = opened
+    assert got_manifest["hash"] == manifest["hash"]
+    for key, arr in tree.items():
+        arr = np.asarray(arr)
+        r = readers[key]
+        assert tuple(r.shape) == arr.shape
+        if not arr.shape:
+            assert r.read(()).tobytes() == arr.tobytes()
+            continue
+        full = tuple((0, s) for s in arr.shape)
+        assert r.read(full).tobytes() == arr.tobytes()
+        # an interior window: rows [3, 7) only
+        lo, hi = 3, 7
+        win = ((lo, hi),) + full[1:]
+        assert r.read(win).tobytes() == arr[lo:hi].tobytes()
+
+
+def test_sub_inline_tree_skips_publication(cluster):
+    """A tree below the inline threshold cannot live on the object
+    plane: publish declines (and counts it) instead of minting a
+    binding no P2P pull could serve."""
+    from ray_tpu.serve import weight_store as ws
+
+    store = ws.get_store()
+    before = store.stats()["inline_skipped"]
+    tiny = {"w": np.ones((8, 8), np.float32)}
+    assert store.publish_params(tiny, "wid/tiny") is None
+    assert store.stats()["inline_skipped"] == before + 1
+    assert store.resolve("wid/tiny") is None
+
+
+def test_load_params_from_peer_bounded_host_bytes(cluster):
+    """Acceptance: a full streaming restore off a PEER process's store
+    is bitwise-equal to the source tree and holds peak host bytes <=
+    max_in_flight x chunk_bytes while pulling."""
+    import jax
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.serve import weight_store as ws
+
+    cfg = gpt2.GPT2Config.preset("gpt2-tiny", max_seq_len=96,
+                                 **MODEL_OVERRIDES)
+    arch = {k: getattr(cfg, k) for k in gpt2._CFG_FIELDS}
+
+    @ray_tpu.remote
+    class Publisher:
+        def publish(self, arch):
+            import jax
+
+            from ray_tpu.models import gpt2
+            from ray_tpu.serve import weight_store as ws
+
+            cfg = gpt2.GPT2Config(**arch)
+            params = gpt2.init_params(jax.random.key(7), cfg)
+            store = ws.get_store()
+            m = store.publish_params(params, "wid/peer-load", arch=arch)
+            leaves = [np.asarray(l)
+                      for l in jax.tree_util.tree_leaves(params)]
+            return m is not None, [l.tobytes() for l in leaves]
+
+    pub = Publisher.remote()
+    ok, want_bytes = ray_tpu.get(pub.publish.remote(arch), timeout=180)
+    assert ok
+    store = ws.get_store()
+    deadline = time.time() + 30
+    while time.time() < deadline and store.resolve("wid/peer-load") is None:
+        time.sleep(0.2)          # binding rides the directory broadcast
+    assert store.resolve("wid/peer-load") is not None, \
+        "weights binding never gossiped to the consumer"
+    loaded = store.load_params("wid/peer-load", base_cfg=cfg)
+    assert loaded is not None, store.stats()
+    params, got_cfg = loaded
+    assert got_cfg.n_layer == cfg.n_layer
+    got = [np.asarray(l).tobytes()
+           for l in jax.tree_util.tree_leaves(params)]
+    assert got == want_bytes, "streamed restore diverged from source"
+    st = store.last_load_stats
+    budget = st["max_in_flight"] * st["chunk_bytes"]
+    assert 0 < st["peak_host_bytes"] <= budget, st
+    assert store.stats()["store_hits"] >= 1
+    ray_tpu.kill(pub)
+
+
+def test_open_sharded_windows_match_store_windows(cluster):
+    """Satellite: `train/checkpoint.open_sharded` windowed reads and the
+    store's WindowedReaders serve IDENTICAL bytes for the same windows —
+    the sharded checkpoint publishes straight from its seek-readers
+    (bounded host memory) and any windowed consumer can swap sources."""
+    from ray_tpu.serve import weight_store as ws
+    from ray_tpu.train.checkpoint import open_sharded, save_sharded
+
+    tree = _tiny_tree(seed=3)
+    path = os.path.join(tempfile.mkdtemp(prefix="ws_shard_"), "ck")
+    save_sharded(tree, path)
+    store = ws.get_store()
+    manifest = store.publish_sharded(path, weights_id="wid/sharded")
+    assert manifest is not None, store.stats()
+    local_readers, _ = open_sharded(path)
+    opened = store.open("wid/sharded")
+    assert opened is not None
+    store_readers, _ = opened
+    assert set(store_readers) == set(local_readers)
+    for key, lr in local_readers.items():
+        sr = store_readers[key]
+        assert tuple(sr.shape) == tuple(lr.shape)
+        full = tuple((0, s) for s in lr.shape)
+        assert sr.read(full).tobytes() == lr.read(full).tobytes()
+        rows = lr.shape[0]
+        lo, hi = rows // 3, max(rows // 3 + 2, rows // 2)
+        win = ((lo, hi),) + full[1:]
+        assert sr.read(win).tobytes() == lr.read(win).tobytes(), \
+            f"store window diverged from npz seek-read for {key}"
+
+
+def test_adapter_publish_fetch_cross_process(cluster):
+    """LoRA adapter deltas are weight-plane objects: published by one
+    process, fetched bitwise by another (per-tenant hit accounting)."""
+    from ray_tpu.serve import weight_store as ws
+
+    rng = np.random.default_rng(5)
+    adapter = {"blocks.attn.wqkv": {
+        "A": rng.normal(size=(2, 128, 4)).astype(np.float32),
+        "B": rng.normal(size=(2, 4, 384)).astype(np.float32),
+        "alpha": 8.0}}
+    akey = ws.adapter_store_key("ck/base", "a1")
+    store = ws.get_store()
+    assert store.publish_adapter(akey, adapter) is not None
+
+    @ray_tpu.remote
+    def fetch(akey):
+        from ray_tpu.serve import weight_store as ws
+
+        store = ws.get_store()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            got = store.fetch_adapter(akey, tenant="a1")
+            if got is not None:
+                return ({p: {k: (np.asarray(v).tobytes()
+                                 if k in ("A", "B") else v)
+                             for k, v in spec.items()}
+                         for p, spec in got.items()},
+                        store.stats())
+            time.sleep(0.2)
+        return None, store.stats()
+
+    got, stats = ray_tpu.get(fetch.remote(akey), timeout=120)
+    assert got is not None, stats
+    for p, spec in adapter.items():
+        for k, v in spec.items():
+            want = np.asarray(v).tobytes() if k in ("A", "B") else v
+            assert got[p][k] == want, f"adapter {p}.{k} diverged"
+    assert stats["store_hits"] >= 1
+    assert stats["store_bytes_fetched"] > 0
+
+
+# ------------------------------------------------- cold engine, zero RPCs
+@pytest.mark.slow
+def test_cold_engine_zero_head_rpcs(cluster):
+    """Tentpole acceptance: a cold LLMEngine whose checkpoint is already
+    on the weight plane materializes its params with ZERO head round
+    trips (interposer-verified inside the loading process) and
+    bitwise-identical to the checkpoint-path read."""
+    import jax
+
+    from ray_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.preset("gpt2-tiny", max_seq_len=96,
+                                 **MODEL_OVERRIDES)
+    params = gpt2.init_params(jax.random.key(11), cfg)
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="ws_cold_"), "ck")
+    gpt2.save_params(ckpt, params, cfg)
+    want = [np.asarray(l).tobytes()
+            for l in jax.tree_util.tree_leaves(params)]
+
+    @ray_tpu.remote
+    class Publisher:
+        def publish(self, ckpt):
+            from ray_tpu.models import gpt2
+            from ray_tpu.serve import weight_store as ws
+
+            params, cfg = gpt2.load_params(ckpt)
+            store = ws.get_store()
+            m = store.publish_params(
+                params, ckpt,
+                arch={k: getattr(cfg, k) for k in gpt2._CFG_FIELDS})
+            return m is not None
+
+    @ray_tpu.remote
+    class ColdReplica:
+        def wait_binding(self, ckpt):
+            from ray_tpu.serve import weight_store as ws
+
+            store = ws.get_store()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if store.resolve(ckpt) is not None:
+                    return True
+                time.sleep(0.2)
+            return False
+
+        def cold_start(self, ckpt):
+            """The path under test: engine init with the head connection
+            watched from inside THIS process."""
+            import jax
+            import numpy as np
+
+            from ray_tpu.serve.disagg import _RpcAudit
+            from ray_tpu.serve import weight_store as ws
+            from ray_tpu.serve.llm import LLMEngine
+            from ray_tpu.utils.platform import ensure_virtual_cpu
+
+            ensure_virtual_cpu(1)
+            audit = _RpcAudit()
+            assert audit.start()
+            eng = LLMEngine(checkpoint=ckpt, max_seq_len=96,
+                            model_overrides={"vocab_size": 512,
+                                             "attn_impl": "dense"},
+                            enable_prefix_caching=False, max_batch=2,
+                            kv_blocks=16, kv_block_size=8)
+            events = audit.stop()
+            leaves = [np.asarray(l).tobytes()
+                      for l in jax.tree_util.tree_leaves(eng.params)]
+            stats = ws.get_store().stats()
+            eng.shutdown()
+            return {"reqs": [m for k, m in events if k == "req"],
+                    "leaves": leaves, "stats": stats}
+
+    pub = Publisher.remote()
+    assert ray_tpu.get(pub.publish.remote(ckpt), timeout=300)
+    replica = ColdReplica.remote()
+    assert ray_tpu.get(replica.wait_binding.remote(ckpt), timeout=60), \
+        "weights binding never reached the replica's directory"
+    out = ray_tpu.get(replica.cold_start.remote(ckpt), timeout=300)
+    assert out["stats"]["store_hits"] >= 1, out["stats"]
+    assert out["leaves"] == want, \
+        "P2P cold start diverged from the checkpoint bytes"
+    assert not out["reqs"], \
+        f"cold engine made head round trips on the warm path: {out['reqs']}"
+    ray_tpu.kill(pub)
+    ray_tpu.kill(replica)
+
+
+# --------------------------------------------------- LoRA hot-swap drill
+@pytest.mark.slow
+def test_lora_hot_swap_byte_identical(cluster):
+    """Acceptance: an adapter hot-swapped from the weight plane (second
+    server has a BOGUS lora_root, so the store is its only source)
+    produces merged params byte-identical to the locally-loaded npz."""
+    import jax
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.serve.llm import OpenAIServer
+    from ray_tpu.utils.platform import ensure_virtual_cpu
+
+    ensure_virtual_cpu(1)
+    cfg = gpt2.GPT2Config.preset("gpt2-tiny", max_seq_len=96,
+                                 **MODEL_OVERRIDES)
+    params = gpt2.init_params(jax.random.key(13), cfg)
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="ws_lora_"), "ck")
+    gpt2.save_params(ckpt, params, cfg)
+    root = tempfile.mkdtemp(prefix="ws_lora_root_")
+    rng = np.random.default_rng(17)
+    L, D = cfg.n_layer, cfg.d_model
+    np.savez(os.path.join(root, "a1.npz"), **{
+        "blocks.attn.wqkv.A": (rng.normal(size=(L, D, 4))
+                               * 0.05).astype(np.float32),
+        "blocks.attn.wqkv.B": (rng.normal(size=(L, 4, 3 * D))
+                               * 0.05).astype(np.float32)})
+    kw = dict(checkpoint=ckpt, max_seq_len=96,
+              model_overrides=dict(MODEL_OVERRIDES), max_batch=2,
+              kv_blocks=16, kv_block_size=8, cluster_prefix_cache=False,
+              enable_prefix_caching=False)
+    srv1 = OpenAIServer(model_id="tiny", lora_root=root, **kw)
+    srv2 = None
+    try:
+        body = {"prompt_ids": [1, 2, 3, 4], "max_tokens": 2,
+                "model": "tiny:a1"}
+        srv1(body)                       # loads npz, publishes the delta
+        # second server: store-or-bust adapter source
+        srv2 = OpenAIServer(model_id="tiny",
+                            lora_root="/nonexistent-lora-root", **kw)
+        out = srv2(body)
+        assert out["choices"], out
+        e1, e2 = srv1._lora_engines["a1"], srv2._lora_engines["a1"]
+        l1 = [np.asarray(l).tobytes()
+              for l in jax.tree_util.tree_leaves(e1.params)]
+        l2 = [np.asarray(l).tobytes()
+              for l in jax.tree_util.tree_leaves(e2.params)]
+        assert l1 == l2, \
+            "store-sourced LoRA merge diverged from the local npz merge"
+    finally:
+        srv1.engine.shutdown()
+        for e in srv1._lora_engines.values():
+            e.shutdown()
+        if srv2 is not None:
+            srv2.engine.shutdown()
+            for e in srv2._lora_engines.values():
+                e.shutdown()
+
+
+# ------------------------------------------------------- chaos drill
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_weight_owner_death_mid_stream_falls_back():
+    """Chaos satellite: the node owning the weight segments is SIGKILLed
+    between stream windows; the consumer's next window read fails, the
+    full streaming restore degrades to a miss, and a cold LLMEngine on
+    the consumer node still constructs — via the checkpoint-path read."""
+    from ray_tpu.cluster_utils import Cluster
+
+    # needs its own multi-node cluster with store isolation; an
+    # in-process module cluster cannot coexist (idempotent teardown)
+    ray_tpu.shutdown()
+    saved = os.environ.get("RAY_TPU_STORE_ISOLATION")
+    os.environ["RAY_TPU_STORE_ISOLATION"] = "1"
+    cluster = Cluster(num_cpus=0)
+    owner_node = cluster.add_node(num_cpus=2, resources={"owner_pool": 4})
+    cluster.add_node(num_cpus=2, resources={"consumer_pool": 4})
+
+    import jax
+
+    from ray_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.preset("gpt2-tiny", max_seq_len=96,
+                                 **MODEL_OVERRIDES)
+    params = gpt2.init_params(jax.random.key(23), cfg)
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="ws_chaos_"), "ck")
+    gpt2.save_params(ckpt, params, cfg)
+    want = [np.asarray(l).tobytes()
+            for l in jax.tree_util.tree_leaves(params)]
+
+    def _actor_src():
+        class _Peer:
+            def __init__(self):
+                from ray_tpu.utils.platform import ensure_virtual_cpu
+
+                ensure_virtual_cpu(1)
+
+            def publish(self, ckpt):
+                from ray_tpu.models import gpt2
+                from ray_tpu.serve import weight_store as ws
+
+                params, cfg = gpt2.load_params(ckpt)
+                m = ws.get_store().publish_params(
+                    params, ckpt,
+                    arch={k: getattr(cfg, k) for k in gpt2._CFG_FIELDS})
+                return m is not None
+
+            def probe(self, ckpt):
+                from ray_tpu.serve import weight_store as ws
+
+                return ws.get_store().resolve(ckpt) is not None
+
+            def read_first_window(self, ckpt):
+                """One live stream window off the owner: proves the P2P
+                source is serving before the kill."""
+                from ray_tpu.serve import weight_store as ws
+
+                readers, _m = ws.get_store().open(ckpt)
+                key = sorted(readers)[0]
+                r = readers[key]
+                win = ((0, min(2, r.shape[0])),) + tuple(
+                    (0, s) for s in r.shape[1:])
+                return len(r.read(win).tobytes())
+
+            def cold_engine_after_owner_death(self, ckpt):
+                """Owner is gone mid-stream: load_params must miss (not
+                hang, not raise) and engine init must fall back to the
+                checkpoint path and still come up."""
+                import numpy as np
+
+                from ray_tpu.serve import weight_store as ws
+                from ray_tpu.serve.llm import LLMEngine
+
+                store = ws.get_store()
+                store.fetch_timeout_s = 10.0      # keep the drill brisk
+                before = store.stats()
+                loaded = store.load_params(ckpt)
+                after = store.stats()
+                eng = LLMEngine(checkpoint=ckpt, max_seq_len=96,
+                                model_overrides={"vocab_size": 512,
+                                                 "attn_impl": "dense"},
+                                enable_prefix_caching=False, max_batch=2,
+                                kv_blocks=16, kv_block_size=8)
+                import jax
+
+                leaves = [np.asarray(l).tobytes()
+                          for l in jax.tree_util.tree_leaves(eng.params)]
+                eng.shutdown()
+                return {"p2p_load": loaded is not None,
+                        "misses": after["store_misses"]
+                        - before["store_misses"],
+                        "leaves": leaves}
+
+        return _Peer
+
+    try:
+        cluster.connect()
+        cluster.wait_for_nodes(3)
+        Peer = _actor_src()
+        owner = ray_tpu.remote(Peer).options(
+            resources={"owner_pool": 1}).remote()
+        consumer = ray_tpu.remote(Peer).options(
+            resources={"consumer_pool": 1}).remote()
+        assert ray_tpu.get(owner.publish.remote(ckpt), timeout=300)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ray_tpu.get(consumer.probe.remote(ckpt), timeout=60):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("binding never reached the consumer node")
+        n = ray_tpu.get(consumer.read_first_window.remote(ckpt),
+                        timeout=120)
+        assert n > 0, "stream source never served a window"
+
+        # the owner dies MID-STREAM (between windows); the consumer's
+        # restore must degrade, and the engine must still construct
+        cluster.kill_node(owner_node)
+        out = ray_tpu.get(
+            consumer.cold_engine_after_owner_death.remote(ckpt),
+            timeout=300)
+        assert out["p2p_load"] is False, \
+            "restore off a dead owner should miss, not fabricate data"
+        assert out["misses"] >= 1, out
+        assert out["leaves"] == want, \
+            "checkpoint-path fallback diverged from the saved weights"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        if saved is None:
+            os.environ.pop("RAY_TPU_STORE_ISOLATION", None)
+        else:
+            os.environ["RAY_TPU_STORE_ISOLATION"] = saved
